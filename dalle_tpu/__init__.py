@@ -1,0 +1,37 @@
+"""dalle_tpu — a TPU-native (JAX/XLA/Pallas/pjit) text→image autoregressive
+transformer framework with the full capability surface of DALLE-pytorch
+(reference: dalle_pytorch/__init__.py:1-2 exports DALLE, CLIP, DiscreteVAE,
+OpenAIDiscreteVAE, VQGanVAE).
+
+Design stance (not a port):
+  * functional core — pure ``init``/``apply`` model functions, explicit PRNG
+    keys, pytree params;
+  * one jitted train step sharded over a ``jax.sharding.Mesh`` (dp/fsdp/tp/sp
+    axes) instead of wrapper-object distributed backends;
+  * ``lax.scan`` + KV-cache autoregressive decoding instead of the reference's
+    recompute-everything loop (reference: dalle_pytorch/dalle_pytorch.py:483-498);
+  * Pallas kernels for the attention zoo's hot paths.
+"""
+
+__version__ = "0.1.0"
+
+_EXPORTS = {
+    "DiscreteVAE": "dalle_tpu.models.vae",
+    "DiscreteVAEConfig": "dalle_tpu.models.vae",
+    "DALLE": "dalle_tpu.models.dalle",
+    "DALLEConfig": "dalle_tpu.models.dalle",
+    "CLIP": "dalle_tpu.models.clip",
+    "CLIPConfig": "dalle_tpu.models.clip",
+    "OpenAIDiscreteVAE": "dalle_tpu.models.pretrained",
+    "VQGanVAE": "dalle_tpu.models.pretrained",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

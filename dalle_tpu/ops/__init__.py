@@ -1,0 +1,1 @@
+from dalle_tpu.ops import attention, masks, rotary, sampling  # noqa: F401

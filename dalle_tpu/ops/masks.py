@@ -1,0 +1,153 @@
+"""Static attention-mask builders for the attention zoo.
+
+Every attention variant in the reference is, semantically, plain attention
+under a structured boolean mask over the joint [text | image] sequence:
+
+  * full causal                 (reference: dalle_pytorch/attention.py:39-86)
+  * conv-like local window      (reference: attention.py:90-207)
+  * axial row / axial column    (reference: attention.py:211-321)
+  * block-sparse "variable" cfg (reference: attention.py:325-384, wrapping
+    DeepSpeed's VariableSparsityConfig: local sliding-window blocks + global
+    blocks over the text prefix + seeded random blocks)
+
+We make that explicit: each builder returns a static ``[seq, seq]`` boolean
+mask (True = may attend) computed in numpy at trace time.  The masks serve
+three roles: (1) the dense-masked fallback implementation, (2) the oracle for
+unit-testing the structured/Pallas implementations, (3) per-row slices drive
+KV-cache decode for *any* variant.
+
+Masks are cached; sequence layout is ``[text_seq_len | fmap**2]`` matching
+DALLE's input (bos-prepended, last-dropped; reference: dalle_pytorch.py:528,556-558).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=64)
+def causal_mask(seq_len: int) -> np.ndarray:
+    i = np.arange(seq_len)
+    return i[None, :] <= i[:, None]
+
+
+@functools.lru_cache(maxsize=64)
+def axial_mask(text_seq_len: int, fmap_size: int, axis: int) -> np.ndarray:
+    """Axial attention mask (axis=0: same row; axis=1: same column).
+
+    Image position attends to: all text, plus causally-earlier image
+    positions sharing its row (axis 0) or column (axis 1), itself included.
+    Text attends causally to text only, mirroring the reference's split
+    text/image computation (reference: attention.py:273-296).
+    """
+    n_img = fmap_size * fmap_size
+    n = text_seq_len + n_img
+    mask = np.zeros((n, n), dtype=bool)
+    t = text_seq_len
+    # text -> text causal
+    mask[:t, :t] = causal_mask(t)
+    # image -> all text
+    mask[t:, :t] = True
+    img = np.arange(n_img)
+    row, col = img // fmap_size, img % fmap_size
+    same = (row[:, None] == row[None, :]) if axis == 0 else (col[:, None] == col[None, :])
+    mask[t:, t:] = same & (img[None, :] <= img[:, None])
+    return mask
+
+
+@functools.lru_cache(maxsize=64)
+def conv_like_mask(
+    text_seq_len: int, fmap_size: int, kernel_size: int, dilation: int = 1
+) -> np.ndarray:
+    """Causal local-window mask matching the reference's unfold construction.
+
+    Image query at (r, c) may attend to image positions inside the
+    ``kernel_size**2`` dilated window whose bottom-right corner is (r, c),
+    restricted to flat index <= the query's (reference: attention.py:156-177),
+    plus all text.  Text attends causally to text.
+    """
+    n_img = fmap_size * fmap_size
+    n = text_seq_len + n_img
+    mask = np.zeros((n, n), dtype=bool)
+    t = text_seq_len
+    mask[:t, :t] = causal_mask(t)
+    mask[t:, :t] = True
+    img = np.arange(n_img)
+    row, col = img // fmap_size, img % fmap_size
+    dr = row[:, None] - row[None, :]  # query_row - key_row
+    dc = col[:, None] - col[None, :]
+    span = (kernel_size - 1) * dilation
+    in_window = (
+        (dr >= 0)
+        & (dr <= span)
+        & (dr % dilation == 0)
+        & (dc >= 0)
+        & (dc <= span)
+        & (dc % dilation == 0)
+    )
+    mask[t:, t:] = in_window & (img[None, :] <= img[:, None])
+    return mask
+
+
+@functools.lru_cache(maxsize=64)
+def block_sparse_mask(
+    seq_len: int,
+    text_seq_len: int,
+    block: int = 16,
+    num_local_blocks: int = 4,
+    num_random_blocks: int | None = None,
+    seed: int = 0,
+) -> np.ndarray:
+    """Variable-sparsity block mask equivalent to the reference's DeepSpeed
+    config (reference: attention.py:335-351): per-query-block —
+
+      * local: the ``num_local_blocks`` most recent key blocks (incl. own),
+      * global: every key block overlapping the text prefix,
+      * random: ``num_random_blocks`` seeded random earlier key blocks
+        (default ``seq_len / block / 4``, reference: attention.py:339-341),
+
+    all intersected with elementwise causality.  Sequence is padded to a block
+    multiple by the caller (reference pads inputs, attention.py:355-361; we
+    instead require seq_len % block == 0 after DALLE's static padding).
+    """
+    assert seq_len % block == 0, "pad sequence to a block multiple"
+    nb = seq_len // block
+    if num_random_blocks is None:
+        num_random_blocks = max(nb // 4, 1)
+    layout = np.zeros((nb, nb), dtype=bool)
+    n_text_blocks = max((text_seq_len + block - 1) // block, 1)
+    rng = np.random.RandomState(seed)
+    for qb in range(nb):
+        layout[qb, max(0, qb - num_local_blocks + 1) : qb + 1] = True
+        layout[qb, :n_text_blocks] = True  # global text blocks
+        if qb > 0:
+            ridx = rng.randint(0, qb + 1, size=num_random_blocks)
+            layout[qb, ridx] = True
+    mask = np.kron(layout, np.ones((block, block), dtype=bool))
+    return mask & causal_mask(seq_len)
+
+
+def mask_for_attn_type(
+    attn_type: str,
+    text_seq_len: int,
+    fmap_size: int,
+    *,
+    kernel_size: int = 5,
+    dilation: int = 1,
+    sparse_block: int = 16,
+) -> np.ndarray:
+    """Dispatch: the [seq, seq] mask a given layer type realizes."""
+    n = text_seq_len + fmap_size * fmap_size
+    if attn_type in ("full", "mlp"):
+        return causal_mask(n)
+    if attn_type == "axial_row":
+        return axial_mask(text_seq_len, fmap_size, 0)
+    if attn_type == "axial_col":
+        return axial_mask(text_seq_len, fmap_size, 1)
+    if attn_type == "conv_like":
+        return conv_like_mask(text_seq_len, fmap_size, kernel_size, dilation)
+    if attn_type == "sparse":
+        return block_sparse_mask(n, text_seq_len, block=sparse_block)
+    raise ValueError(f"unknown attention type {attn_type!r}")

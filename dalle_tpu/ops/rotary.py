@@ -1,0 +1,100 @@
+"""Rotary position embeddings for the joint text+image sequence.
+
+Re-designs the reference's hybrid rotary scheme
+(reference: dalle_pytorch/transformer.py:202-228) TPU-first: all angles are
+precomputed once as a static ``[seq_len, half_rot_dim]`` table at model build
+time, so inside ``jit`` the application is a single fused multiply-add — no
+gather, no dynamic shapes.
+
+Scheme (matching the reference's capability):
+  * ``dim_head // 3`` (rounded down to even) channels get 1-D rotary over
+    *text* positions; image positions are pinned to a constant far position
+    (8192) for those channels (reference: transformer.py:214).
+  * 2 * (dim_head // 3) channels get 2-D axial rotary over the image feature
+    map with coordinates in ``linspace(-1, 1)``; text positions are pinned to
+    the constant -10 (reference: transformer.py:221).
+  * Remaining channels are left unrotated.
+
+Angles are applied to q and k only (standard RoPE; the reference also rotates
+v, which mixes value channels for no modelling benefit — deliberate deviation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+TEXT_CONST_IMG_POS = 8192.0  # image tokens' constant position in text freqs
+IMG_CONST_TEXT_COORD = -10.0  # text tokens' constant coordinate in image freqs
+
+
+def _even(n: int) -> int:
+    return n - (n % 2)
+
+
+@functools.lru_cache(maxsize=32)
+def dalle_rotary_angles(
+    text_seq_len: int,
+    fmap_size: int,
+    dim_head: int,
+    theta: float = 10000.0,
+) -> np.ndarray:
+    """Angle table ``[seq_len, R]`` where ``2R`` leading head channels rotate.
+
+    Sequence layout is the transformer's input layout: position ``p`` holds
+    <bos>/text for ``p < text_seq_len`` and image token ``p - text_seq_len``
+    otherwise (reference: dalle_pytorch/dalle_pytorch.py:528,556-558).
+    """
+    n_img = fmap_size * fmap_size
+    seq_len = text_seq_len + n_img
+    dt = _even(dim_head // 3)  # 1-D text channels
+    da = _even(dim_head // 3)  # per-axis 2-D image channels (row and col each)
+
+    pos = np.arange(seq_len, dtype=np.float64)
+    is_img = pos >= text_seq_len
+
+    # --- text 1-D rotary ---------------------------------------------------
+    inv_freq = theta ** (-np.arange(0, dt, 2, dtype=np.float64) / max(dt, 1))
+    tpos = np.where(is_img, TEXT_CONST_IMG_POS, pos)
+    text_angles = tpos[:, None] * inv_freq[None, :]  # [seq, dt/2]
+
+    # --- image 2-D axial rotary (pixel-style freqs) ------------------------
+    img_idx = np.maximum(pos - text_seq_len, 0).astype(np.int64)
+    row = img_idx // fmap_size
+    col = img_idx % fmap_size
+    coords = (
+        np.linspace(-1.0, 1.0, fmap_size) if fmap_size > 1 else np.zeros((1,))
+    )
+    rc = np.where(is_img, coords[row], IMG_CONST_TEXT_COORD)
+    cc = np.where(is_img, coords[col], IMG_CONST_TEXT_COORD)
+    ax_freq = np.linspace(1.0, max(fmap_size / 2.0, 1.0), da // 2) * np.pi
+    row_angles = rc[:, None] * ax_freq[None, :]
+    col_angles = cc[:, None] * ax_freq[None, :]
+
+    angles = np.concatenate([text_angles, row_angles, col_angles], axis=-1)
+    assert 2 * angles.shape[-1] <= dim_head
+    return angles.astype(np.float32)
+
+
+def apply_rotary(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
+    """Rotate the leading ``2R`` channels of ``x`` by ``angles``.
+
+    x: ``[..., seq, dim_head]``; angles: ``[seq, R]`` (or ``[..., seq, R]``).
+    Interleaved-pair convention: channels ``(2i, 2i+1)`` rotate by
+    ``angles[..., i]``.
+    """
+    r = angles.shape[-1]
+    if r == 0:
+        return x
+    x_rot = x[..., : 2 * r]
+    x_pass = x[..., 2 * r :]
+    x1 = x_rot[..., 0::2]
+    x2 = x_rot[..., 1::2]
+    cos = jnp.cos(angles).astype(x.dtype)
+    sin = jnp.sin(angles).astype(x.dtype)
+    o1 = x1 * cos - x2 * sin
+    o2 = x1 * sin + x2 * cos
+    out = jnp.stack([o1, o2], axis=-1).reshape(*x_rot.shape)
+    return jnp.concatenate([out, x_pass], axis=-1)

@@ -1,0 +1,39 @@
+"""Sampling helpers: fractional top-k filtering + temperature sampling.
+
+jit-safe re-design of the reference's helpers (reference:
+dalle_pytorch/dalle_pytorch.py:50-56 ``top_k``; generation loop :483-498):
+static k, categorical sampling via Gumbel-max (``jax.random.categorical``)
+instead of ``torch.multinomial``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
+    """Keep the top ``ceil((1 - thres) * vocab)`` logits, -inf the rest.
+
+    Matches the reference's fractional-threshold semantics
+    (reference: dalle_pytorch.py:50-56).  ``thres`` is static.
+    """
+    vocab = logits.shape[-1]
+    k = max(int(math.ceil((1 - thres) * vocab)), 1)
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def sample_logits(
+    key: jax.Array,
+    logits: jnp.ndarray,
+    *,
+    temperature: float = 1.0,
+    filter_thres: float = 0.5,
+) -> jnp.ndarray:
+    """Top-k filter → temperature → categorical sample.  Returns int32 ids."""
+    filtered = top_k_filter(logits, filter_thres)
+    t = jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
+    return jax.random.categorical(key, filtered / t, axis=-1)

@@ -1,0 +1,36 @@
+"""Test rig: force an 8-device virtual CPU mesh before JAX initializes.
+
+The reference exercises its distributed code paths through a no-op
+``DummyBackend`` (reference: dalle_pytorch/distributed_backends/dummy_backend.py:4-52).
+We go further: XLA's host-platform device-count flag gives *real* multi-device
+semantics on CPU, so collectives and shardings are tested for real.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The env var alone is not enough under the axon TPU plugin (its site hook
+# re-exports JAX_PLATFORMS=axon); the config update after import wins.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,139 @@
+"""Unit tests: attention variants vs. the masked-dense oracle, rotary, sampling.
+
+Mirrors the test strategy SURVEY.md §4 prescribes (the reference itself ships
+no tests): every structured op is pinned to a brute-force reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.ops import attention as A
+from dalle_tpu.ops import masks as M
+from dalle_tpu.ops.rotary import apply_rotary, dalle_rotary_angles
+from dalle_tpu.ops.sampling import sample_logits, top_k_filter
+
+B, H, D = 2, 3, 8
+T, F = 6, 4  # text len, fmap size
+N = T + F * F
+
+
+def qkv(key, n=N):
+    ks = jax.random.split(key, 3)
+    return [jax.random.normal(k, (B, H, n, D)) for k in ks]
+
+
+def test_causal_mask_lower_triangular():
+    m = M.causal_mask(5)
+    assert m[3, 3] and m[3, 0] and not m[0, 3]
+
+
+def test_axial_mask_semantics():
+    m = M.axial_mask(T, F, 0)
+    # image pos (1,2) = flat T + 6 attends to (1,0) [same row, earlier]
+    assert m[T + 6, T + 4]
+    # ... not to (0,2) [different row] under row attention
+    assert not m[T + 6, T + 2]
+    # column attention: (1,2) attends to (0,2), not (1,0)
+    mc = M.axial_mask(T, F, 1)
+    assert mc[T + 6, T + 2] and not mc[T + 6, T + 4]
+    # image attends to all text; text never attends to image
+    assert m[T + 6, :T].all() and not m[:T, T:].any()
+
+
+def test_conv_like_mask_semantics():
+    m = M.conv_like_mask(T, F, kernel_size=2)
+    q = T + 5  # image (1,1)
+    assert m[q, q] and m[q, T + 4] and m[q, T + 0] and m[q, T + 1]
+    assert not m[q, T + 2]  # (0,2) outside window
+    assert m[q, :T].all()
+
+
+def test_block_sparse_mask_causal_and_text_global():
+    m = M.block_sparse_mask(128, 16, block=16, num_local_blocks=2, num_random_blocks=1)
+    assert not np.triu(m, 1).any()  # causal
+    assert m[100, :16].sum() > 0  # text block reachable (global)
+    assert m[127, 112]  # own block local
+
+
+@pytest.mark.parametrize("attn_type", ["axial_row", "axial_col"])
+def test_axial_matches_masked_dense(rng, attn_type):
+    q, k, v = qkv(rng)
+    axis = 0 if attn_type == "axial_row" else 1
+    mask = M.axial_mask(T, F, axis)
+    want = A.masked_attention(q, k, v, mask)
+    got = A.axial_attention(q, k, v, T, F, axis)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("kernel,dilation", [(2, 1), (3, 1), (2, 2)])
+def test_conv_like_matches_masked_dense(rng, kernel, dilation):
+    q, k, v = qkv(rng)
+    mask = M.conv_like_mask(T, F, kernel, dilation)
+    want = A.masked_attention(q, k, v, mask)
+    got = A.conv_like_attention(q, k, v, T, F, kernel, dilation)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_full_causal_matches_masked_dense(rng):
+    q, k, v = qkv(rng)
+    want = A.masked_attention(q, k, v, M.causal_mask(N))
+    got = A.full_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_key_pad_mask_consistency(rng):
+    q, k, v = qkv(rng)
+    # only text positions are ever padded (the mask comes from the text
+    # tokenizer; image tokens are always valid)
+    pad = jnp.asarray(np.random.RandomState(0).rand(B, N) > 0.3)
+    pad = pad.at[:, 0].set(True)  # row 0 must attend to something
+    pad = pad.at[:, T:].set(True)
+    mask = M.axial_mask(T, F, 0)
+    want = A.masked_attention(q, k, v, mask, key_pad_mask=pad)
+    got = A.axial_attention(q, k, v, T, F, 0, key_pad_mask=pad)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_rotary_preserves_norm_and_is_position_dependent(rng):
+    angles = jnp.asarray(dalle_rotary_angles(T, F, D))
+    x = jax.random.normal(rng, (B, H, N, D))
+    y = apply_rotary(x, angles)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        atol=1e-4,
+    )
+    # identical inputs at different text positions rotate differently
+    x0 = jnp.broadcast_to(x[:, :, :1], x.shape)
+    y0 = apply_rotary(x0, angles)
+    assert not np.allclose(np.asarray(y0[0, 0, 0]), np.asarray(y0[0, 0, 1]))
+
+
+def test_rotary_dot_product_is_relative():
+    """q·k after rotation depends only on relative text position."""
+    # text positions only (constant image coords don't break relativity)
+    angles = jnp.asarray(dalle_rotary_angles(16, 1, 12))[:16]
+    q = jnp.ones((1, 1, 16, 12))
+    k = jnp.ones((1, 1, 16, 12))
+    qr = apply_rotary(q, angles)
+    kr = apply_rotary(k, angles)
+    d03 = float(jnp.dot(qr[0, 0, 0], kr[0, 0, 3]))
+    d58 = float(jnp.dot(qr[0, 0, 5], kr[0, 0, 8]))
+    np.testing.assert_allclose(d03, d58, atol=1e-4)
+
+
+def test_top_k_filter_keeps_fraction():
+    logits = jnp.arange(10.0)[None]
+    out = top_k_filter(logits, thres=0.5)
+    assert int(jnp.isfinite(out).sum()) == 5
+    assert bool(jnp.isinf(out[0, 0])) and bool(jnp.isfinite(out[0, 9]))
+
+
+def test_sample_logits_respects_filter(rng):
+    logits = jnp.asarray([[0.0, 0.0, 0.0, 10.0]])
+    ids = jax.vmap(lambda k: sample_logits(k, logits, filter_thres=0.9))(
+        jax.random.split(rng, 32)
+    )
+    assert (np.asarray(ids) == 3).all()
