@@ -1,0 +1,4 @@
+from dalle_tpu.models.clip import CLIP, CLIPConfig  # noqa: F401
+from dalle_tpu.models.dalle import DALLE, DALLEConfig  # noqa: F401
+from dalle_tpu.models.transformer import Transformer, TransformerConfig  # noqa: F401
+from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig  # noqa: F401

@@ -1,0 +1,136 @@
+"""CLIP: contrastive text/image encoders for generation reranking.
+
+Capability parity with the reference CLIP
+(reference: dalle_pytorch/dalle_pytorch.py:229-305): non-causal text
+transformer + ViT-style patch transformer, masked-mean/mean pooling, learned
+temperature, symmetric InfoNCE loss or elementwise similarity.
+
+TPU notes: patchify is a reshape (free), the similarity matrix is one MXU
+matmul.  For data-parallel contrastive training at scale, embeddings should
+be all-gathered across the dp axis before the similarity matrix — see
+dalle_tpu/parallel for the axis names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.models.transformer import Transformer, TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    dim_text: int = 512
+    dim_image: int = 512
+    dim_latent: int = 512
+    num_text_tokens: int = 10000
+    text_enc_depth: int = 6
+    text_seq_len: int = 256
+    text_heads: int = 8
+    visual_enc_depth: int = 6
+    visual_heads: int = 8
+    visual_image_size: int = 256
+    visual_patch_size: int = 32
+    channels: int = 3
+    dtype: Any = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.visual_image_size // self.visual_patch_size) ** 2
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d.pop("dtype")
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**dict(d))
+
+
+def _enc_config(dim, depth, heads, seq_len, dtype) -> TransformerConfig:
+    return TransformerConfig(
+        dim=dim,
+        depth=depth,
+        heads=heads,
+        dim_head=64,
+        text_seq_len=seq_len,
+        fmap_size=0,
+        attn_types=("full",),
+        causal=False,
+        dtype=dtype,
+    )
+
+
+class CLIP(nn.Module):
+    cfg: CLIPConfig
+
+    def setup(self):
+        c = self.cfg
+        init = nn.initializers.normal(0.02)
+        self.text_emb = nn.Embed(c.num_text_tokens, c.dim_text, embedding_init=init)
+        self.text_pos_emb = nn.Embed(c.text_seq_len, c.dim_text, embedding_init=init)
+        self.text_transformer = Transformer(
+            _enc_config(c.dim_text, c.text_enc_depth, c.text_heads, c.text_seq_len, c.dtype)
+        )
+        self.to_text_latent = nn.Dense(c.dim_latent, use_bias=False, dtype=c.dtype)
+
+        self.patch_emb = nn.Dense(c.dim_image, dtype=c.dtype)
+        self.image_pos_emb = nn.Embed(c.num_patches, c.dim_image, embedding_init=init)
+        self.visual_transformer = Transformer(
+            _enc_config(c.dim_image, c.visual_enc_depth, c.visual_heads, c.num_patches, c.dtype)
+        )
+        self.to_visual_latent = nn.Dense(c.dim_latent, use_bias=False, dtype=c.dtype)
+
+        # learned temperature (reference: dalle_pytorch.py:263,296)
+        self.temperature = self.param("temperature", nn.initializers.ones, ())
+
+    def encode_text(self, text, deterministic=True):
+        c = self.cfg
+        mask = text != 0
+        x = self.text_emb(text) + self.text_pos_emb(jnp.arange(c.text_seq_len))[None]
+        x = self.text_transformer(
+            x, key_pad_mask=mask, deterministic=deterministic
+        )
+        # masked mean pool (reference: dalle_pytorch.py:284-289,:31-33)
+        denom = jnp.maximum(mask.sum(-1, keepdims=True), 1)
+        pooled = (x * mask[..., None]).sum(axis=1) / denom
+        lat = self.to_text_latent(pooled)
+        return lat / jnp.linalg.norm(lat, axis=-1, keepdims=True)
+
+    def encode_image(self, image, deterministic=True):
+        """image: [b, H, W, C] in [0, 1]."""
+        c = self.cfg
+        p = c.visual_patch_size
+        b, h, w, ch = image.shape
+        g = h // p
+        patches = image.reshape(b, g, p, g, p, ch).transpose(0, 1, 3, 2, 4, 5)
+        patches = patches.reshape(b, g * g, p * p * ch)
+        x = self.patch_emb(patches) + self.image_pos_emb(jnp.arange(c.num_patches))[None]
+        x = self.visual_transformer(x, deterministic=deterministic)
+        pooled = x.mean(axis=1)
+        lat = self.to_visual_latent(pooled)
+        return lat / jnp.linalg.norm(lat, axis=-1, keepdims=True)
+
+    def __call__(self, text, image, *, return_loss=False, deterministic=True):
+        tl = self.encode_text(text, deterministic)
+        il = self.encode_image(image, deterministic)
+        temp = jnp.exp(self.temperature)
+        if not return_loss:
+            # elementwise similarity for reranking (reference: :298-300)
+            return jnp.einsum("nd,nd->n", tl, il) * temp
+        sim = jnp.einsum("id,jd->ij", tl, il) * temp  # [b, b]
+        labels = jnp.arange(sim.shape[0])
+        def ce(s):
+            return -jnp.mean(
+                jnp.take_along_axis(
+                    jax.nn.log_softmax(s, axis=-1), labels[:, None], axis=-1
+                )
+            )
+        # symmetric InfoNCE (reference: :302-305)
+        return (ce(sim) + ce(sim.T)) / 2

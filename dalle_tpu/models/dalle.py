@@ -1,0 +1,272 @@
+"""DALLE: text→image autoregressive transformer over discrete VAE codes.
+
+Capability parity with the reference DALLE
+(reference: dalle_pytorch/dalle_pytorch.py:309-591):
+  * joint sequence [<bos> | text | image codes], last token dropped
+    (reference: dalle_pytorch.py:528,556-558);
+  * one unique pad token per text position, remapped from pad id 0
+    (reference: dalle_pytorch.py:339,523-524);
+  * learned text positions + learned 2-D axial image positions, both replaced
+    by rotary when enabled (reference: dalle_pytorch.py:344-345);
+  * static logits mask — text positions emit text tokens, image positions
+    emit image tokens (reference: dalle_pytorch.py:390-401,573-575);
+  * loss = (CE_text + w·CE_image)/(w+1), image labels offset by the text
+    vocab size (reference: dalle_pytorch.py:582-590);
+  * optional stability tricks: 0.1/0.9 stop-grad mix and DivideMax
+    (reference: dalle_pytorch.py:560-567).
+
+Functional re-design: DALLE does NOT own the VAE.  The reference freezes an
+embedded VAE module and encodes raw pixels inside forward
+(reference: dalle_pytorch.py:358-359,535-542); here the train/generate steps
+compose ``vae.get_codebook_indices`` (under ``stop_gradient``) with a DALLE
+apply that consumes integer codes — params stay separate pytrees, which is
+what clean pjit sharding wants.  Generation lives in
+:mod:`dalle_tpu.models.generate` as a jitted ``lax.scan`` with KV cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.models.transformer import DivideMax, Transformer, TransformerConfig
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class DALLEConfig:
+    num_text_tokens: int = 10000  # BEFORE the +text_seq_len pad reservation
+    text_seq_len: int = 256
+    num_image_tokens: int = 512  # vae codebook size
+    image_fmap_size: int = 32  # image_size // 2**vae.num_layers
+    dim: int = 512
+    depth: int = 2
+    heads: int = 8
+    dim_head: int = 64
+    ff_mult: int = 4
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    attn_types: tuple = ("full",)
+    loss_img_weight: float = 7.0
+    stable: bool = False
+    sandwich_norm: bool = False
+    shift_tokens: bool = False
+    rotary_emb: bool = False
+    reversible: bool = False
+    use_remat: bool = False
+    kernel_size: int = 5
+    dilation: int = 1
+    sparse_block: int = 16
+    sparse_local_blocks: int = 4
+    sparse_random_blocks: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
+    @property
+    def image_seq_len(self) -> int:
+        return self.image_fmap_size**2
+
+    @property
+    def total_text_tokens(self) -> int:
+        """Text vocab incl. per-position pad tokens (reference: :339)."""
+        return self.num_text_tokens + self.text_seq_len
+
+    @property
+    def total_tokens(self) -> int:
+        return self.total_text_tokens + self.num_image_tokens
+
+    @property
+    def total_seq_len(self) -> int:
+        """Transformer input length (bos-prepended, last dropped)."""
+        return self.text_seq_len + self.image_seq_len
+
+    def transformer_config(self) -> TransformerConfig:
+        return TransformerConfig(
+            dim=self.dim,
+            depth=self.depth,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            text_seq_len=self.text_seq_len,
+            fmap_size=self.image_fmap_size,
+            attn_types=self.attn_types,
+            ff_mult=self.ff_mult,
+            attn_dropout=self.attn_dropout,
+            ff_dropout=self.ff_dropout,
+            causal=True,
+            reversible=self.reversible,
+            use_remat=self.use_remat,
+            rotary=self.rotary_emb,
+            shift_tokens=self.shift_tokens,
+            sandwich_norm=self.sandwich_norm,
+            kernel_size=self.kernel_size,
+            dilation=self.dilation,
+            sparse_block=self.sparse_block,
+            sparse_local_blocks=self.sparse_local_blocks,
+            sparse_random_blocks=self.sparse_random_blocks,
+            dtype=self.dtype,
+        )
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d.pop("dtype")
+        d["attn_types"] = list(self.attn_types)
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        d["attn_types"] = tuple(d.get("attn_types", ("full",)))
+        return cls(**d)
+
+
+class AxialPositionalEmbedding(nn.Module):
+    """Learned 2-D factorized position embedding for the image grid —
+    replaces the external ``axial_positional_embedding`` dependency
+    (reference: dalle_pytorch.py:7,345)."""
+
+    fmap_size: int
+    dim: int
+
+    def setup(self):
+        init = nn.initializers.normal(0.02)
+        self.rows = self.param("rows", init, (self.fmap_size, self.dim))
+        self.cols = self.param("cols", init, (self.fmap_size, self.dim))
+
+    def __call__(self, img_index):
+        """img_index: int array of flat grid indices → [..., dim]."""
+        f = self.fmap_size
+        return self.rows[img_index // f] + self.cols[img_index % f]
+
+
+class DALLE(nn.Module):
+    cfg: DALLEConfig
+
+    def setup(self):
+        c = self.cfg
+        init = nn.initializers.normal(0.02)
+        self.text_emb = nn.Embed(c.total_text_tokens, c.dim, embedding_init=init)
+        self.image_emb = nn.Embed(c.num_image_tokens, c.dim, embedding_init=init)
+        if not c.rotary_emb:
+            # +1 for <bos> (reference: dalle_pytorch.py:344)
+            self.text_pos_emb = nn.Embed(c.text_seq_len + 1, c.dim, embedding_init=init)
+            self.image_pos_emb = AxialPositionalEmbedding(c.image_fmap_size, c.dim)
+        self.transformer = Transformer(c.transformer_config(), name="transformer")
+        self.final_norm = nn.LayerNorm(dtype=c.dtype, name="final_norm")
+        self.to_logits = nn.Dense(c.total_tokens, dtype=c.dtype, name="to_logits")
+        if c.stable:
+            self.norm_by_max = DivideMax(axis=-1)
+
+    # --- shared pieces -----------------------------------------------------
+    def remap_pad_tokens(self, text):
+        """pad id 0 → unique per-position pad token
+        (reference: dalle_pytorch.py:523-524)."""
+        c = self.cfg
+        pad_range = jnp.arange(c.text_seq_len) + c.num_text_tokens
+        return jnp.where(text == 0, pad_range[None, :], text)
+
+    def logits_mask_row(self, pos):
+        """Allowed-token mask for logits at input position ``pos``
+        (True = allowed).  Text positions (< text_seq_len) emit text tokens,
+        the rest emit image tokens (reference: dalle_pytorch.py:390-401)."""
+        c = self.cfg
+        vocab = jnp.arange(c.total_tokens)
+        is_text_tok = vocab < c.total_text_tokens
+        is_text_pos = pos < c.text_seq_len
+        return jnp.where(is_text_pos[..., None], is_text_tok[None], ~is_text_tok[None])
+
+    def embed_sequence(self, text, image_codes):
+        """[bos | text | codes], drop last → [b, total_seq_len, dim]."""
+        c = self.cfg
+        b = text.shape[0]
+        text = self.remap_pad_tokens(text)
+        bos = jnp.zeros((b, 1), jnp.int32)  # bos id 0 (reference: :528)
+        tok_text = jnp.concatenate([bos, text], axis=1)  # [b, t+1]
+        x_text = self.text_emb(tok_text)
+        x_img = self.image_emb(image_codes)  # [b, n_img, dim]
+        if not c.rotary_emb:
+            x_text = x_text + self.text_pos_emb(jnp.arange(c.text_seq_len + 1))[None]
+            x_img = x_img + self.image_pos_emb(jnp.arange(c.image_seq_len))[None]
+        x = jnp.concatenate([x_text, x_img], axis=1)
+        return x[:, : c.total_seq_len]  # drop last (reference: :556-558)
+
+    def embed_token(self, combined_id, pos):
+        """Embed one combined-vocab token id at sequence position ``pos``
+        (decode path).  combined_id: [b] int; pos: scalar int."""
+        c = self.cfg
+        pos = jnp.asarray(pos)
+        text_e = self.text_emb(jnp.clip(combined_id, 0, c.total_text_tokens - 1))
+        img_e = self.image_emb(
+            jnp.clip(combined_id - c.total_text_tokens, 0, c.num_image_tokens - 1)
+        )
+        if not c.rotary_emb:
+            text_e = text_e + self.text_pos_emb(jnp.minimum(pos, c.text_seq_len))
+            img_e = img_e + self.image_pos_emb(
+                jnp.clip(pos - c.text_seq_len - 1, 0, c.image_seq_len - 1)
+            )
+        return jnp.where((pos <= c.text_seq_len)[..., None], text_e, img_e)
+
+    def head(self, x, pos=None):
+        """final norm + projection + logits mask."""
+        c = self.cfg
+        if c.stable:
+            x = self.norm_by_max(x)
+        logits = self.to_logits(self.final_norm(x)).astype(jnp.float32)
+        if pos is None:
+            pos = jnp.arange(logits.shape[-2])
+        allowed = self.logits_mask_row(pos)
+        return jnp.where(allowed, logits, NEG_INF)
+
+    # --- training forward (reference: dalle_pytorch.py:511-591) ------------
+    def __call__(
+        self,
+        text,
+        image_codes,
+        *,
+        return_loss: bool = False,
+        key_pad_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ):
+        """text: int [b, text_seq_len] (pad id 0); image_codes: int
+        [b, image_seq_len].  Returns logits [b, n, total_tokens] or scalar
+        loss."""
+        c = self.cfg
+        x = self.embed_sequence(text, image_codes)
+        if c.stable:
+            # 0.1/0.9 stop-grad mix (reference: dalle_pytorch.py:560-562)
+            x = x * 0.1 + jax.lax.stop_gradient(x) * 0.9
+        x = self.transformer(
+            x, key_pad_mask=key_pad_mask, deterministic=deterministic
+        )
+        logits = self.head(x)
+        if not return_loss:
+            return logits
+
+        labels_text = self.remap_pad_tokens(text)  # toks[1..t]
+        labels_img = image_codes + c.total_text_tokens  # offset (reference: :582)
+        labels = jnp.concatenate([labels_text, labels_img], axis=1)  # [b, n]
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        t = c.text_seq_len
+        loss_text = jnp.mean(nll[:, :t])
+        loss_img = jnp.mean(nll[:, t:])
+        return (loss_text + c.loss_img_weight * loss_img) / (c.loss_img_weight + 1)
+
+    # --- decode-mode pieces (used by models/generate.py) -------------------
+    def init_cache(self, batch: int):
+        return self.transformer.init_cache(batch)
+
+    def decode_step(self, combined_id, pos, cache, deterministic=True):
+        """One AR step: embed token at ``pos``, run transformer decode, return
+        (masked logits for position ``pos``, new cache)."""
+        x = self.embed_token(combined_id, pos)
+        x, cache = self.transformer.decode_step(
+            x, pos, cache, deterministic=deterministic
+        )
+        logits = self.head(x[:, None], pos=jnp.asarray(pos)[None])[:, 0]
+        return logits, cache

@@ -1,0 +1,32 @@
+"""Pretrained VAE wrappers: OpenAI discrete VAE and taming VQGAN.
+
+The reference wraps externally-released torch checkpoints
+(reference: dalle_pytorch/vae.py:103-133 OpenAIDiscreteVAE, :150-220
+VQGanVAE) downloaded with rank-0 coordination (reference: vae.py:53-94).
+Here the architectures are re-implemented in Flax and weights are converted
+from the torch pickles when present on disk (zero-egress environments can't
+download; pass ``ckpt_path``).  Until the converters land (build plan §7
+stage 8) these raise a clear error on use; the in-tree DiscreteVAE covers
+training end-to-end.
+"""
+
+from __future__ import annotations
+
+
+class _PendingPretrained:
+    """Placeholder that fails loudly on use, not on import."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            f"{type(self).__name__} weight conversion is not wired up yet; "
+            "train an in-tree DiscreteVAE or pass converted flax params. "
+            "See dalle_tpu/models/pretrained.py."
+        )
+
+
+class OpenAIDiscreteVAE(_PendingPretrained):
+    """reference: dalle_pytorch/vae.py:103-133."""
+
+
+class VQGanVAE(_PendingPretrained):
+    """reference: dalle_pytorch/vae.py:150-220."""

@@ -1,0 +1,209 @@
+"""Discrete VAE with a Gumbel-softmax codebook.
+
+Capability parity with the reference DiscreteVAE
+(reference: dalle_pytorch/dalle_pytorch.py:60-225): stride-2 conv
+encoder/decoder stacks with optional ResBlocks, ``num_tokens`` codebook with
+Gumbel-softmax (optionally straight-through) sampling, recon (mse/smooth-l1)
++ weighted KL(q‖uniform) loss, channelwise normalization buffers,
+``get_codebook_indices`` (argmax) and ``decode``.
+
+TPU-first choices:
+  * NHWC layout throughout (XLA's native TPU conv layout) — the CLIs convert
+    from PIL;
+  * gumbel sampling takes an explicit PRNG key (flax rng collection
+    ``gumbel``), temperature is a traced scalar so annealing doesn't retrigger
+    compilation (the reference threads a Python float, train_vae.py:227-232);
+  * the codebook lookup is a single one-hot einsum the MXU eats whole.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteVAEConfig:
+    image_size: int = 256
+    num_tokens: int = 512
+    codebook_dim: int = 512
+    num_layers: int = 3
+    num_resnet_blocks: int = 0
+    hidden_dim: int = 64
+    channels: int = 3
+    smooth_l1_loss: bool = False
+    temperature: float = 0.9
+    straight_through: bool = False
+    kl_div_loss_weight: float = 0.0
+    # channelwise normalization (mean, std), e.g. ImageNet stats
+    # (reference: dalle_pytorch.py:154-162)
+    normalization: Optional[Tuple[Tuple[float, ...], Tuple[float, ...]]] = None
+    dtype: Any = jnp.float32
+
+    @property
+    def fmap_size(self) -> int:
+        return self.image_size // (2**self.num_layers)
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d.pop("dtype")
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        if d.get("normalization") is not None:
+            d["normalization"] = tuple(tuple(x) for x in d["normalization"])
+        return cls(**d)
+
+
+class ResBlock(nn.Module):
+    """conv3-relu-conv3-relu-conv1 + skip (reference: dalle_pytorch.py:60-72)."""
+
+    chan: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.chan, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        y = jax.nn.relu(y)
+        y = nn.Conv(self.chan, (3, 3), padding="SAME", dtype=self.dtype)(y)
+        y = jax.nn.relu(y)
+        y = nn.Conv(self.chan, (1, 1), dtype=self.dtype)(y)
+        return y + x
+
+
+class Encoder(nn.Module):
+    cfg: DiscreteVAEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        for _ in range(c.num_layers):
+            x = nn.Conv(c.hidden_dim, (4, 4), strides=(2, 2), padding="SAME", dtype=c.dtype)(x)
+            x = jax.nn.relu(x)
+        for _ in range(c.num_resnet_blocks):
+            x = ResBlock(c.hidden_dim, c.dtype)(x)
+        return nn.Conv(c.num_tokens, (1, 1), dtype=c.dtype)(x)  # logits
+
+
+class Decoder(nn.Module):
+    cfg: DiscreteVAEConfig
+
+    @nn.compact
+    def __call__(self, z):
+        c = self.cfg
+        if c.num_resnet_blocks > 0:
+            z = nn.Conv(c.hidden_dim, (1, 1), dtype=c.dtype)(z)
+            for _ in range(c.num_resnet_blocks):
+                z = ResBlock(c.hidden_dim, c.dtype)(z)
+        for _ in range(c.num_layers):
+            z = nn.ConvTranspose(
+                c.hidden_dim, (4, 4), strides=(2, 2), padding="SAME", dtype=c.dtype
+            )(z)
+            z = jax.nn.relu(z)
+        return nn.Conv(c.channels, (1, 1), dtype=c.dtype)(z)
+
+
+class DiscreteVAE(nn.Module):
+    cfg: DiscreteVAEConfig
+
+    def setup(self):
+        c = self.cfg
+        self.encoder = Encoder(c, name="encoder")
+        self.decoder = Decoder(c, name="decoder")
+        self.codebook = nn.Embed(c.num_tokens, c.codebook_dim, name="codebook")
+
+    # --- helpers ----------------------------------------------------------
+    @property
+    def num_layers(self):
+        return self.cfg.num_layers
+
+    @property
+    def num_tokens(self):
+        return self.cfg.num_tokens
+
+    @property
+    def image_size(self):
+        return self.cfg.image_size
+
+    def norm(self, img):
+        c = self.cfg
+        if c.normalization is None:
+            return img
+        means = jnp.asarray(c.normalization[0], img.dtype)
+        stds = jnp.asarray(c.normalization[1], img.dtype)
+        return (img - means) / stds
+
+    # --- public API (reference: dalle_pytorch.py:164-225) -----------------
+    def get_codebook_indices(self, img):
+        """img: [b, H, W, C] → int32 [b, fmap*fmap] (argmax over logits)."""
+        logits = self.encoder(self.norm(img))
+        b, h, w, _ = logits.shape
+        return jnp.argmax(logits, axis=-1).reshape(b, h * w).astype(jnp.int32)
+
+    def decode(self, img_seq):
+        """img_seq: int [b, fmap*fmap] → images [b, H, W, C]."""
+        b, n = img_seq.shape
+        f = self.cfg.fmap_size
+        assert n == f * f, f"expected {f*f} tokens, got {n}"
+        z = self.codebook(img_seq).reshape(b, f, f, -1)
+        return self.decoder(z)
+
+    def __call__(
+        self,
+        img,
+        *,
+        return_loss: bool = False,
+        return_recons: bool = False,
+        temp: Optional[jnp.ndarray] = None,
+    ):
+        """Forward (reference: dalle_pytorch.py:183-225).
+
+        With ``return_loss``: returns ``(loss, recons?)`` where loss =
+        recon + kl_weight * KL(q ‖ uniform) (batchmean).  Gumbel noise uses
+        the flax rng collection ``gumbel``.
+        """
+        c = self.cfg
+        img = self.norm(img)
+        logits = self.encoder(img)  # [b, f, f, num_tokens]
+        if not return_loss:
+            return logits
+
+        tau = jnp.asarray(c.temperature if temp is None else temp, jnp.float32)
+        g = jax.random.gumbel(
+            self.make_rng("gumbel"), logits.shape, dtype=jnp.float32
+        )
+        soft = jax.nn.softmax((logits.astype(jnp.float32) + g) / tau, axis=-1)
+        if c.straight_through:
+            hard = jax.nn.one_hot(
+                jnp.argmax(soft, axis=-1), c.num_tokens, dtype=soft.dtype
+            )
+            soft = hard + soft - jax.lax.stop_gradient(soft)
+        sampled = jnp.einsum(
+            "bhwn,nd->bhwd", soft.astype(c.dtype), self.codebook.embedding
+        )
+        out = self.decoder(sampled)
+
+        if c.smooth_l1_loss:
+            d = out - img
+            ad = jnp.abs(d)
+            recon = jnp.mean(jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5))
+        else:
+            recon = jnp.mean((out - img) ** 2)
+
+        logq = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        q = jnp.exp(logq)
+        log_uniform = -jnp.log(float(c.num_tokens))
+        # batchmean: sum over positions+tokens, mean over batch
+        # (reference: dalle_pytorch.py:213-220)
+        kl = jnp.sum(q * (logq - log_uniform)) / img.shape[0]
+        loss = recon + c.kl_div_loss_weight * kl
+
+        if return_recons:
+            return loss, out
+        return loss
