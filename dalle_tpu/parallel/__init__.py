@@ -1,0 +1,12 @@
+from dalle_tpu.parallel.mesh import (  # noqa: F401
+    AXES,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    single_device_mesh,
+)
+from dalle_tpu.parallel.partition import (  # noqa: F401
+    param_shardings,
+    param_specs,
+    shard_params,
+)

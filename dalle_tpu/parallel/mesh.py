@@ -1,0 +1,72 @@
+"""Device-mesh construction: the TPU-native substrate for all parallelism.
+
+Replaces the reference's launcher-spawned process groups + NCCL rendezvous
+(reference: dalle_pytorch/distributed_backends/deepspeed_backend.py:36-39,
+horovod_backend.py:20-23) with one logical 4-axis mesh:
+
+  * ``dp``   — data parallelism (gradient psum rides ICI)
+  * ``fsdp`` — ZeRO-equivalent: params/optimizer-state sharded, batch also
+               split along this axis (the reference reaches ZeRO via the
+               DeepSpeed JSON config, train_dalle.py:483-488)
+  * ``tp``   — tensor parallelism (attention heads / FF inner dim; absent in
+               the reference, SURVEY.md §2.10 "NOT present")
+  * ``sp``   — sequence/context parallelism (ring attention; absent in the
+               reference, SURVEY.md §5.7)
+
+XLA's GSPMD inserts the collectives; multi-host slices map the mesh so that
+dp/fsdp inner axes ride ICI and any DCN boundary lands on the outermost axis
+(`jax.experimental.mesh_utils` hybrid ordering).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES = ("dp", "fsdp", "tp", "sp")
+BATCH_AXES = ("dp", "fsdp")  # batch dim is split over both
+
+
+def make_mesh(
+    dp: int = -1,
+    fsdp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the 4-axis mesh; a single -1 axis absorbs remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = [dp, fsdp, tp, sp]
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if unknown:
+        assert len(unknown) == 1, "at most one mesh axis may be -1"
+        assert n % known == 0, f"{n} devices not divisible by {known}"
+        sizes[unknown[0]] = n // known
+    total = int(np.prod(sizes))
+    assert total == n, f"mesh {dict(zip(AXES, sizes))} != {n} devices"
+    if jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(tuple(sizes), devices=devices)
+    else:
+        dev_array = np.asarray(devices).reshape(tuple(sizes))
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    dev = device if device is not None else jax.devices()[0]
+    return Mesh(np.asarray([dev]).reshape(1, 1, 1, 1), AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs: batch dim split over (dp, fsdp)."""
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,91 @@
+"""Parameter partition specs: tensor parallelism + ZeRO-equivalent FSDP.
+
+The reference reaches sharded optimizer state only through DeepSpeed ZeRO
+config (reference: train_dalle.py:483-488; external-param registration for
+ZeRO-3, dalle_pytorch.py:142-152) and has no tensor parallelism at all
+(SURVEY.md §2.10).  Here both are just PartitionSpecs:
+
+  * **tp** — Megatron-style: column-parallel into attention qkv / FF-in /
+    logits head, row-parallel out of attention-out / FF-out, so each
+    layer's pair of matmuls needs a single psum that XLA inserts;
+  * **fsdp** — every remaining large parameter is sharded on its first
+    divisible axis; optimizer state follows params (ZeRO-1/2/3 collapse into
+    one concept under GSPMD: the all-gather happens where needed).
+
+Specs are derived from parameter *path + shape*, so they apply uniformly to
+params, Adam moments, and checkpoints.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# (path-suffix substring, spec) — first match wins.  Axis names refer to the
+# logical mesh axes in mesh.AXES.
+_TP_RULES = (
+    ("qkv/kernel", PartitionSpec(None, "tp")),  # column parallel
+    ("out/kernel", PartitionSpec("tp", None)),  # row parallel
+    ("wi/kernel", PartitionSpec(None, "tp")),
+    ("wo/kernel", PartitionSpec("tp", None)),
+    ("to_logits/kernel", PartitionSpec(None, "tp")),
+    ("proj_in/kernel", PartitionSpec(None, "tp")),  # gMLP
+    ("proj_out/kernel", PartitionSpec("tp", None)),
+)
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+    )
+
+
+def _spec_for(path: str, shape, mesh_shape) -> PartitionSpec:
+    tp = mesh_shape.get("tp", 1)
+    fsdp = mesh_shape.get("fsdp", 1)
+    spec = None
+    if tp > 1:
+        for suffix, rule in _TP_RULES:
+            if path.endswith(suffix):
+                ax = [rule.index(a) for a in rule if a == "tp"]
+                if shape[ax[0]] % tp == 0:
+                    spec = rule
+                break
+    dims = list(spec) if spec is not None else [None] * len(shape)
+    while len(dims) < len(shape):
+        dims.append(None)
+    if fsdp > 1:
+        # shard the first still-free axis divisible by fsdp (largest params
+        # first benefit automatically: embeddings/kernels have axis0 = vocab
+        # or fan-in)
+        for i, d in enumerate(dims):
+            if d is None and shape[i] % fsdp == 0 and shape[i] >= fsdp:
+                dims[i] = "fsdp"
+                break
+    return PartitionSpec(*dims)
+
+
+def param_specs(params: Any, mesh: Mesh):
+    """PartitionSpec pytree for a param (or Adam-moment) pytree."""
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def leaf_spec(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0:
+            return PartitionSpec()
+        return _spec_for(_path_str(path), shape, mesh_shape)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def param_shardings(params: Any, mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(params, mesh)
+    )
+
+
+def shard_params(params: Any, mesh: Mesh):
+    """Place a param pytree onto the mesh per its specs."""
+    return jax.device_put(params, param_shardings(params, mesh))

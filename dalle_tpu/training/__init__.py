@@ -1,0 +1,10 @@
+from dalle_tpu.training.train_lib import (  # noqa: F401
+    count_params,
+    get_learning_rate,
+    init_train_state,
+    make_dalle_eval_step,
+    make_dalle_train_step,
+    make_optimizer,
+    make_vae_train_step,
+    set_learning_rate,
+)

@@ -1,0 +1,69 @@
+"""Host-side LR schedulers with reference semantics.
+
+The reference uses torch's ReduceLROnPlateau stepped on epoch-average loss
+(reference: train_dalle.py:428-439,632-633) and ExponentialLR stepped every
+logging interval for the VAE (reference: train_vae.py:150-151,276-277).
+Both live on the host and poke the injected learning rate between steps —
+no recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ReduceLROnPlateau:
+    """Parity with torch defaults used by the reference: factor 0.5,
+    patience 10, cooldown 10, min_lr 1e-6 (reference: train_dalle.py:430-437)."""
+
+    lr: float
+    factor: float = 0.5
+    patience: int = 10
+    cooldown: int = 10
+    threshold: float = 1e-4
+    min_lr: float = 1e-6
+    best: float = float("inf")
+    num_bad: int = 0
+    cooldown_left: int = 0
+
+    def step(self, metric: float) -> float:
+        if metric < self.best * (1 - self.threshold):
+            self.best = metric
+            self.num_bad = 0
+        elif self.cooldown_left > 0:
+            self.cooldown_left -= 1
+            self.num_bad = 0
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.lr = max(self.lr * self.factor, self.min_lr)
+                self.cooldown_left = self.cooldown
+                self.num_bad = 0
+        return self.lr
+
+    def state_dict(self):
+        return dataclasses.asdict(self)
+
+    def load_state_dict(self, d):
+        for k, v in d.items():
+            setattr(self, k, v)
+
+
+@dataclasses.dataclass
+class ExponentialDecay:
+    """lr *= gamma per step() call (reference: train_vae.py:150-151)."""
+
+    lr: float
+    gamma: float = 0.98
+
+    def step(self, _metric: float = 0.0) -> float:
+        self.lr *= self.gamma
+        return self.lr
+
+    def state_dict(self):
+        return dataclasses.asdict(self)
+
+    def load_state_dict(self, d):
+        for k, v in d.items():
+            setattr(self, k, v)

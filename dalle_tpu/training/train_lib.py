@@ -1,0 +1,187 @@
+"""Jitted, mesh-sharded train steps for DALLE and DiscreteVAE.
+
+The reference's train loop does per-step: host→device transfer, forward,
+backward, allreduce (inside DeepSpeed/Horovod), clip, Adam
+(reference: train_dalle.py:564-644; train_vae.py:223-296).  Here the whole
+step is ONE compiled XLA program over the mesh: the VAE encode (frozen,
+argmax — no gradients by construction, superseding the reference's
+``set_requires_grad(vae, False)`` + no_grad, dalle_pytorch.py:358-359,542),
+loss, backward, gradient psum over dp/fsdp, clip, and Adam update all fuse;
+params and Adam moments stay sharded per partition.py (ZeRO-equivalent).
+
+Buffer donation reuses the param/opt-state memory every step; GSPMD infers
+all intermediate shardings from the input placements.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dalle_tpu.models.dalle import DALLE
+from dalle_tpu.models.vae import DiscreteVAE
+from dalle_tpu.parallel import batch_sharding, param_shardings, shard_params
+
+
+def make_optimizer(
+    learning_rate: float = 3e-4,
+    *,
+    clip_grad_norm: Optional[float] = 0.5,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Adam with global-norm clipping (reference: train_dalle.py:424,581-582;
+    clip default 0.5 mirrors --clip_grad_norm).  The learning rate is an
+    injected hyperparam so host-side schedulers (plateau/exponential decay)
+    can adjust it without recompiling."""
+    chain = []
+    if clip_grad_norm:
+        chain.append(optax.clip_by_global_norm(clip_grad_norm))
+    if weight_decay:
+        opt = optax.inject_hyperparams(optax.adamw)(
+            learning_rate=learning_rate, b1=b1, b2=b2, weight_decay=weight_decay
+        )
+    else:
+        opt = optax.inject_hyperparams(optax.adam)(
+            learning_rate=learning_rate, b1=b1, b2=b2
+        )
+    chain.append(opt)
+    return optax.chain(*chain)
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Mutate the injected learning rate (host-side scheduler hook)."""
+    inner = opt_state[-1]
+    inner.hyperparams["learning_rate"] = jnp.asarray(
+        lr, inner.hyperparams["learning_rate"].dtype
+    )
+    return opt_state
+
+
+def get_learning_rate(opt_state) -> float:
+    return float(opt_state[-1].hyperparams["learning_rate"])
+
+
+def init_train_state(model, tx, mesh, init_rng, *example_args, **example_kw):
+    """Init params on host, shard onto the mesh, init opt state (inherits
+    sharding via zeros_like).  Returns (params, opt_state)."""
+    params = model.init(init_rng, *example_args, **example_kw)["params"]
+    params = shard_params(params, mesh)
+    # Adam moments carry the param path as a suffix, so the same partition
+    # rules shard them identically (ZeRO-equivalent optimizer sharding).
+    opt_shapes = jax.eval_shape(tx.init, params)
+    opt_state = jax.jit(tx.init, out_shardings=param_shardings(opt_shapes, mesh))(
+        params
+    )
+    return params, opt_state
+
+
+def make_dalle_train_step(
+    model: DALLE,
+    tx: optax.GradientTransformation,
+    mesh,
+    vae: Optional[DiscreteVAE] = None,
+):
+    """Returns ``step(params, opt_state, vae_params, text, images_or_codes,
+    dropout_key) -> (params, opt_state, loss)``.
+
+    When ``vae`` is given, the image input is raw pixels [b,H,W,C] encoded to
+    codes inside the step (reference: dalle_pytorch.py:535-542); otherwise it
+    must already be int codes [b, image_seq_len].
+    """
+    bspec = batch_sharding(mesh)
+
+    def step(params, opt_state, vae_params, text, images, key):
+        if vae is not None:
+            codes = vae.apply(
+                {"params": vae_params},
+                images,
+                method=DiscreteVAE.get_codebook_indices,
+            )
+        else:
+            codes = images
+
+        def loss_fn(p):
+            return model.apply(
+                {"params": p},
+                text,
+                codes,
+                return_loss=True,
+                deterministic=False,
+                rngs={"dropout": key},
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+
+    def wrapped(params, opt_state, vae_params, text, images, key):
+        text = jax.device_put(text, bspec)
+        images = jax.device_put(images, bspec)
+        return jstep(params, opt_state, vae_params, text, images, key)
+
+    return wrapped
+
+
+def make_dalle_eval_step(model: DALLE, mesh, vae: Optional[DiscreteVAE] = None):
+    bspec = batch_sharding(mesh)
+
+    def step(params, vae_params, text, images):
+        codes = (
+            vae.apply(
+                {"params": vae_params}, images, method=DiscreteVAE.get_codebook_indices
+            )
+            if vae is not None
+            else images
+        )
+        return model.apply({"params": params}, text, codes, return_loss=True)
+
+    jstep = jax.jit(step)
+
+    def wrapped(params, vae_params, text, images):
+        return jstep(
+            params, vae_params, jax.device_put(text, bspec), jax.device_put(images, bspec)
+        )
+
+    return wrapped
+
+
+def make_vae_train_step(model: DiscreteVAE, tx: optax.GradientTransformation, mesh):
+    """Returns ``step(params, opt_state, images, temp, key) ->
+    (params, opt_state, loss, recons)``.  Temperature is traced so Gumbel
+    annealing (reference: train_vae.py:218-221,269-271) never recompiles."""
+    bspec = batch_sharding(mesh)
+
+    def step(params, opt_state, images, temp, key):
+        def loss_fn(p):
+            return model.apply(
+                {"params": p},
+                images,
+                return_loss=True,
+                return_recons=True,
+                temp=temp,
+                rngs={"gumbel": key},
+            )
+
+        (loss, recons), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, loss, recons
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+
+    def wrapped(params, opt_state, images, temp, key):
+        return jstep(params, opt_state, jax.device_put(images, bspec), temp, key)
+
+    return wrapped
+
+
+def count_params(params: Any) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
