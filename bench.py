@@ -1,0 +1,120 @@
+"""Benchmark: DALLE train-step throughput on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": "train_img_tokens_per_sec_per_chip", "value": N,
+   "unit": "img_tokens/s/chip", "vs_baseline": M, ...}
+
+The reference publishes no quantitative baseline (BASELINE.md); the
+north-star target is >=45% MFU on the 12-layer config (BASELINE.json), so
+``vs_baseline`` reports measured MFU / 0.45 — >1.0 beats the target.
+The throughput metric itself matches the reference's ``sample_per_sec``
+idea scaled to tokens (reference: train_dalle.py:621-624).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.parallel import make_mesh
+from dalle_tpu.training import (
+    count_params,
+    init_train_state,
+    make_dalle_train_step,
+    make_optimizer,
+)
+
+# bf16 peak TFLOP/s per chip by TPU generation (public specs)
+PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0, "cpu": 1.0}
+
+
+def detect_peak() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for name, peak in PEAK_TFLOPS.items():
+        if name in kind.replace(" ", ""):
+            return peak
+    if "lite" in kind:  # "TPU v5 lite" == v5e
+        return PEAK_TFLOPS["v5e"]
+    return PEAK_TFLOPS["v4"]
+
+
+def transformer_flops_per_token(cfg: DALLEConfig) -> float:
+    """Forward+backward FLOPs per sequence token (6N rule + attention)."""
+    d = cfg.dim
+    inner = cfg.heads * cfg.dim_head
+    per_layer = 2 * (d * 3 * inner + inner * d + 2 * d * 4 * d * 2 // 2 + 4 * d * d)
+    # ^ qkv + out + GEGLU in (2x for gate) + ff out, as MACs*2
+    matmul = cfg.depth * per_layer
+    attn = cfg.depth * 2 * 2 * cfg.total_seq_len * inner  # qk^T + pv
+    head = 2 * d * cfg.total_tokens
+    emb = 2 * d  # lookups are gathers; negligible
+    fwd = matmul + attn + head + emb
+    return 3.0 * fwd  # fwd + 2x bwd
+
+
+def main():
+    cfg = DALLEConfig(
+        num_text_tokens=10000,
+        text_seq_len=256,
+        num_image_tokens=8192,
+        image_fmap_size=32,
+        dim=512,
+        depth=12,
+        heads=8,
+        dim_head=64,
+        attn_types=("full",),
+        dtype=jnp.bfloat16,
+    )
+    n_dev = len(jax.devices())
+    mesh = make_mesh(dp=-1)
+    batch = 8 * n_dev
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0, 10000)
+    codes = jax.random.randint(rng, (batch, cfg.image_seq_len), 0, 8192)
+
+    model = DALLE(cfg)
+    tx = make_optimizer(3e-4, clip_grad_norm=0.5)
+    params, opt_state = init_train_state(model, tx, mesh, {"params": rng}, text, codes)
+    step = make_dalle_train_step(model, tx, mesh)
+
+    # warmup/compile
+    params, opt_state, loss = step(params, opt_state, None, text, codes, rng)
+    jax.block_until_ready(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt_state, loss = step(
+            params, opt_state, None, text, codes, jax.random.fold_in(rng, i)
+        )
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    img_tokens_per_sec = batch * cfg.image_seq_len / dt / n_dev
+    seq_tokens = batch * cfg.total_seq_len
+    flops = transformer_flops_per_token(cfg) * seq_tokens
+    mfu = flops / dt / (detect_peak() * 1e12 * n_dev)
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_img_tokens_per_sec_per_chip",
+                "value": round(img_tokens_per_sec, 1),
+                "unit": "img_tokens/s/chip",
+                "vs_baseline": round(mfu / 0.45, 4),
+                "mfu": round(mfu, 4),
+                "step_time_s": round(dt, 4),
+                "batch": batch,
+                "n_devices": n_dev,
+                "params": count_params(params),
+                "device": jax.devices()[0].device_kind,
+                "loss": round(float(loss), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

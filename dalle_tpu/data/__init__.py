@@ -1,0 +1,6 @@
+from dalle_tpu.data.loader import (  # noqa: F401
+    DataLoader,
+    ImageFolderDataset,
+    TextImageDataset,
+)
+from dalle_tpu.data.wds import BatchedWebLoader, WebDataset  # noqa: F401

@@ -1,0 +1,221 @@
+"""Folder-based text-image dataset + fixed-shape batch loader.
+
+Capability parity with the reference's TextImageDataset
+(reference: dalle_pytorch/loader.py:10-99):
+  * recursive glob of ``*.txt`` and png/jpg/jpeg/bmp, paired by filename stem
+    intersection (reference: loader.py:28-41);
+  * per-item: random caption line choice (loader.py:77-81), tokenize to fixed
+    ``text_len`` (loader.py:86-90), RandomResizedCrop with 1:1 aspect and a
+    ``resize_ratio`` lower scale bound (loader.py:46-53);
+  * corrupt images / empty captions skip to a neighbor sample instead of
+    raising (loader.py:58-69,79-84,91-96).
+
+TPU-first loader design (replaces torch DataLoader): fixed-shape NHWC
+float32 batches (XLA needs static shapes), deterministic per-epoch
+shuffling from an integer seed, process sharding for multi-host (the
+reference uses DistributedSampler, train_dalle.py:391-398), and a
+background-thread prefetcher so host decode overlaps device compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from pathlib import Path
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+class TextImageDataset:
+    def __init__(
+        self,
+        folder: str,
+        *,
+        text_len: int = 256,
+        image_size: int = 128,
+        truncate_captions: bool = False,
+        resize_ratio: float = 0.75,
+        tokenizer=None,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        self.shuffle = shuffle
+        self.text_len = text_len
+        self.image_size = image_size
+        self.resize_ratio = resize_ratio
+        self.truncate_captions = truncate_captions
+        self.tokenizer = tokenizer
+        self._rng = np.random.RandomState(seed)
+
+        path = Path(folder)
+        text_files = {p.stem: p for p in path.glob("**/*.txt")}
+        image_files = {
+            p.stem: p
+            for p in path.glob("**/*")
+            if p.suffix.lower() in IMAGE_EXTS
+        }
+        self.keys = sorted(text_files.keys() & image_files.keys())
+        self.text_files = {k: text_files[k] for k in self.keys}
+        self.image_files = {k: image_files[k] for k in self.keys}
+
+    def __len__(self):
+        return len(self.keys)
+
+    def random_sample(self):
+        return self[self._rng.randint(0, len(self))]
+
+    def sequential_sample(self, ind):
+        return self[(ind + 1) % len(self)]
+
+    def skip_sample(self, ind):
+        """Neighbor fallback (reference: loader.py:58-69)."""
+        return self.random_sample() if self.shuffle else self.sequential_sample(ind)
+
+    def _load_image(self, key) -> np.ndarray:
+        from PIL import Image
+
+        img = Image.open(self.image_files[key]).convert("RGB")
+        w, h = img.size
+        # RandomResizedCrop, aspect 1:1, scale in [resize_ratio**2, 1]
+        side = min(w, h)
+        scale = self._rng.uniform(self.resize_ratio, 1.0)
+        crop = max(int(side * scale), 1)
+        x0 = self._rng.randint(0, w - crop + 1)
+        y0 = self._rng.randint(0, h - crop + 1)
+        img = img.crop((x0, y0, x0 + crop, y0 + crop)).resize(
+            (self.image_size, self.image_size), Image.BILINEAR
+        )
+        return np.asarray(img, dtype=np.float32) / 255.0  # NHWC [0,1]
+
+    def __getitem__(self, ind) -> Tuple[np.ndarray, np.ndarray]:
+        key = self.keys[ind]
+        try:
+            descriptions = [
+                l for l in self.text_files[key].read_text().split("\n") if l.strip()
+            ]
+            description = descriptions[self._rng.randint(0, len(descriptions))]
+        except (IndexError, OSError, UnicodeDecodeError):
+            return self.skip_sample(ind)
+        try:
+            tokens = self.tokenizer.tokenize(
+                description, self.text_len, truncate_text=self.truncate_captions
+            )[0]
+        except RuntimeError:
+            return self.skip_sample(ind)
+        try:
+            image = self._load_image(key)
+        except Exception:
+            return self.skip_sample(ind)
+        return tokens.astype(np.int32), image
+
+
+class ImageFolderDataset:
+    """Unlabeled image folder for VAE training (the reference uses
+    torchvision ImageFolder + resize/center-crop, train_vae.py:107-115)."""
+
+    def __init__(self, folder: str, *, image_size: int = 128):
+        path = Path(folder)
+        self.files = sorted(
+            p for p in path.glob("**/*") if p.suffix.lower() in IMAGE_EXTS
+        )
+        self.image_size = image_size
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, ind) -> np.ndarray:
+        from PIL import Image
+
+        try:
+            img = Image.open(self.files[ind]).convert("RGB")
+        except Exception:
+            # corrupt image → neighbor fallback, same policy as
+            # TextImageDataset (reference: loader.py:58-69)
+            return self[(ind + 1) % len(self)]
+        w, h = img.size
+        side = min(w, h)
+        img = img.crop(
+            (
+                (w - side) // 2,
+                (h - side) // 2,
+                (w + side) // 2,
+                (h + side) // 2,
+            )
+        ).resize((self.image_size, self.image_size), Image.BILINEAR)
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+
+class DataLoader:
+    """Deterministic, sharded, prefetching batch iterator.
+
+    Yields tuples of stacked numpy arrays with STATIC leading dim
+    ``batch_size`` (drop_last always true — XLA recompiles on shape change).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        rank: int = 0,
+        world: int = 1,
+        prefetch: int = 2,
+    ):
+        assert batch_size % world == 0, "global batch must divide by world"
+        self.dataset = dataset
+        self.global_batch = batch_size
+        self.local_batch = batch_size // world
+        self.shuffle = shuffle
+        self.seed = seed
+        self.rank = rank
+        self.world = world
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return len(self.dataset) // self.global_batch
+
+    def _indices(self):
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(idx)
+        usable = (n // self.global_batch) * self.global_batch
+        idx = idx[:usable].reshape(-1, self.global_batch)
+        # contiguous per-rank slice of every global batch
+        lo = self.rank * self.local_batch
+        return idx[:, lo : lo + self.local_batch]
+
+    def _make_batch(self, rows):
+        samples = [self.dataset[int(i)] for i in rows]
+        if isinstance(samples[0], tuple):
+            return tuple(np.stack(parts) for parts in zip(*samples))
+        return np.stack(samples)
+
+    def __iter__(self) -> Iterator:
+        batches = self._indices()
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+
+        def worker():
+            try:
+                for rows in batches:
+                    q.put(self._make_batch(rows))
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            yield item

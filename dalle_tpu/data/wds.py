@@ -1,0 +1,221 @@
+"""Tar-shard streaming dataset (WebDataset-equivalent, first-party).
+
+The reference streams training data from tar shards via the external
+``webdataset`` package — dirs of tars, ``http(s)://`` via ``pipe:curl``, or
+``gs://`` via ``pipe:gsutil`` (reference: train_dalle.py:202-216,353-374,
+400-405).  That library isn't a JAX citizen, so this module implements the
+same capability directly on ``tarfile``:
+
+  * shard sources: local paths / globs / directories, ``pipe:<cmd>`` and
+    ``http(s)://``/``gs://`` URLs (shelling out to curl/gsutil);
+  * within a shard, successive members sharing a basename stem form one
+    sample dict (``{"jpg": bytes, "txt": bytes, ...}``) — the WebDataset
+    grouping convention;
+  * samples missing the caption or image key are filtered
+    (reference: train_dalle.py:361-368), decode errors warn-and-continue
+    (reference: :372);
+  * shards are sharded across (rank, world) and shuffled per epoch with a
+    sample-level shuffle buffer;
+  * ``BatchedWebLoader`` yields fixed-shape numpy batches with a nominal
+    epoch length (reference: :400-405 WebLoader semantics).
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import io
+import subprocess
+import tarfile
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+CAPTION_KEYS = ("txt", "text", "caption")
+IMAGE_KEYS = ("png", "jpg", "jpeg", "bmp")
+
+
+def expand_shards(spec: str) -> List[str]:
+    """A source spec → list of shard urls/paths."""
+    if spec.startswith(("http://", "https://", "gs://", "pipe:")):
+        return [spec]
+    p = Path(spec)
+    if p.is_dir():
+        return sorted(str(x) for x in p.glob("*.tar"))
+    matches = sorted(globlib.glob(spec))
+    return matches if matches else [spec]
+
+
+def _open_shard(url: str):
+    if url.startswith("pipe:"):
+        proc = subprocess.Popen(url[5:], shell=True, stdout=subprocess.PIPE)
+        return proc.stdout
+    if url.startswith(("http://", "https://")):
+        proc = subprocess.Popen(
+            ["curl", "-s", "-L", url], stdout=subprocess.PIPE
+        )
+        return proc.stdout
+    if url.startswith("gs://"):
+        proc = subprocess.Popen(
+            ["gsutil", "cat", url], stdout=subprocess.PIPE
+        )
+        return proc.stdout
+    return open(url, "rb")
+
+
+def iter_tar_samples(url: str) -> Iterator[Dict[str, bytes]]:
+    """Group successive tar members by basename stem (WebDataset layout)."""
+    stream = _open_shard(url)
+    current_key: Optional[str] = None
+    sample: Dict[str, bytes] = {}
+    with tarfile.open(fileobj=stream, mode="r|*") as tar:
+        for member in tar:
+            if not member.isfile():
+                continue
+            name = Path(member.name)
+            stem = str(name.parent / name.stem)
+            ext = name.suffix.lstrip(".").lower()
+            if stem != current_key:
+                if sample:
+                    yield sample
+                current_key, sample = stem, {"__key__": stem.encode()}
+            f = tar.extractfile(member)
+            if f is not None:
+                sample[ext] = f.read()
+        if sample:
+            yield sample
+
+
+class WebDataset:
+    """Sample-level iterator over tar shards with filter/shuffle/shard."""
+
+    def __init__(
+        self,
+        spec: str,
+        *,
+        caption_key: Optional[str] = None,
+        image_key: Optional[str] = None,
+        rank: int = 0,
+        world: int = 1,
+        shuffle_buffer: int = 256,
+        seed: int = 0,
+    ):
+        self.shards = expand_shards(spec)
+        assert self.shards, f"no shards found for {spec!r}"
+        self.caption_key = caption_key
+        self.image_key = image_key
+        self.rank = rank
+        self.world = world
+        self.shuffle_buffer = shuffle_buffer
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _keys(self, sample):
+        ck = self.caption_key or next(
+            (k for k in CAPTION_KEYS if k in sample), None
+        )
+        ik = self.image_key or next((k for k in IMAGE_KEYS if k in sample), None)
+        return ck, ik
+
+    def __iter__(self) -> Iterator[Dict[str, bytes]]:
+        rng = np.random.RandomState(self.seed + self.epoch)
+        order = rng.permutation(len(self.shards))
+        my_shards = [self.shards[i] for i in order[self.rank :: self.world]]
+        buf: List[Dict[str, bytes]] = []
+        for url in my_shards:
+            try:
+                it = iter_tar_samples(url)
+            except (OSError, tarfile.TarError) as e:
+                print(f"[wds] shard {url}: {e}; skipping")
+                continue
+            for sample in it:
+                ck, ik = self._keys(sample)
+                if ck is None or ik is None:
+                    continue  # filtered (reference: train_dalle.py:361-368)
+                buf.append(sample)
+                if len(buf) >= self.shuffle_buffer:
+                    j = rng.randint(0, len(buf))
+                    buf[j], out = buf[-1], buf[j]
+                    buf.pop()
+                    yield out
+        rng.shuffle(buf)
+        yield from buf
+
+
+class BatchedWebLoader:
+    """Decode + tokenize + fixed-shape batching over a WebDataset.
+
+    ``nominal_length``: batches per "epoch" for endless tar streams
+    (reference: train_dalle.py:400-405)."""
+
+    def __init__(
+        self,
+        ds: WebDataset,
+        *,
+        batch_size: int,
+        tokenizer,
+        text_len: int = 256,
+        image_size: int = 128,
+        truncate_captions: bool = True,
+        nominal_length: Optional[int] = None,
+    ):
+        self.ds = ds
+        self.batch_size = batch_size
+        self.tokenizer = tokenizer
+        self.text_len = text_len
+        self.image_size = image_size
+        self.truncate_captions = truncate_captions
+        self.nominal_length = nominal_length
+
+    def __len__(self):
+        if self.nominal_length is None:
+            raise TypeError("stream has no length; pass nominal_length")
+        return self.nominal_length
+
+    def _decode(self, sample):
+        from PIL import Image
+
+        ck, ik = self.ds._keys(sample)
+        caption = sample[ck].decode("utf-8", errors="replace").strip()
+        if not caption:
+            return None
+        tokens = self.tokenizer.tokenize(
+            caption.split("\n")[0], self.text_len, truncate_text=self.truncate_captions
+        )[0]
+        img = Image.open(io.BytesIO(sample[ik])).convert("RGB")
+        w, h = img.size
+        side = min(w, h)
+        img = img.crop(
+            ((w - side) // 2, (h - side) // 2, (w + side) // 2, (h + side) // 2)
+        ).resize((self.image_size, self.image_size), Image.BILINEAR)
+        return tokens.astype(np.int32), np.asarray(img, np.float32) / 255.0
+
+    def __iter__(self):
+        texts, images = [], []
+        produced = 0
+        while self.nominal_length is None or produced < self.nominal_length:
+            for sample in self.ds:
+                try:
+                    item = self._decode(sample)
+                except Exception as e:  # warn-and-continue (reference: :372)
+                    print(f"[wds] decode error: {e}; continuing")
+                    continue
+                if item is None:
+                    continue
+                texts.append(item[0])
+                images.append(item[1])
+                if len(texts) == self.batch_size:
+                    yield np.stack(texts), np.stack(images)
+                    texts, images = [], []
+                    produced += 1
+                    if (
+                        self.nominal_length is not None
+                        and produced >= self.nominal_length
+                    ):
+                        return
+            if self.nominal_length is None:
+                return  # single pass for finite local shards
+            self.ds.set_epoch(self.ds.epoch + 1)

@@ -1,0 +1,33 @@
+"""Tokenizer registry mirroring the reference's selection flags
+(reference: train_dalle.py:228-232, generate.py:69-73)."""
+
+from dalle_tpu.tokenizers.fallback import (  # noqa: F401
+    ByteTokenizer,
+    ChineseTokenizer,
+    HugTokenizer,
+    YttmTokenizer,
+)
+from dalle_tpu.tokenizers.simple import SimpleTokenizer  # noqa: F401
+
+
+def get_tokenizer(
+    *,
+    bpe_path=None,
+    hug: bool = False,
+    chinese: bool = False,
+    yttm: bool = False,
+):
+    """Flag-compatible selection: --chinese / --hug (json path) / yttm model
+    path / default CLIP BPE, with byte fallback when no merges exist."""
+    if chinese:
+        return ChineseTokenizer()
+    if hug:
+        assert bpe_path, "--bpe_path (a HF tokenizers JSON) required with --hug"
+        return HugTokenizer(bpe_path)
+    if yttm:
+        assert bpe_path, "a yttm model path is required"
+        return YttmTokenizer(bpe_path)
+    try:
+        return SimpleTokenizer(bpe_path)
+    except FileNotFoundError:
+        return ByteTokenizer()

@@ -1,0 +1,156 @@
+"""Vocab-free byte tokenizer + adapter tokenizers.
+
+``ByteTokenizer`` needs no merges file: ids are raw UTF-8 bytes + 1 (0 stays
+the pad id).  It fills the SimpleTokenizer contract for tests and for
+zero-download environments.
+
+Adapters mirror the reference's alternatives, gated on their libraries:
+  * ``HugTokenizer``     (reference: dalle_pytorch/tokenizer.py:158-192)
+  * ``ChineseTokenizer`` (reference: tokenizer.py:196-228)
+  * ``YttmTokenizer``    (reference: tokenizer.py:232-266; youtokentome is a
+    C++ BPE — our native-path equivalent is the C BPE in
+    dalle_tpu/tokenizers/native/, with this Python adapter kept for
+    drop-in compatibility when the library is installed)
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import numpy as np
+
+
+class ByteTokenizer:
+    vocab_size = 257  # 256 bytes + pad
+
+    def encode(self, text: str) -> List[int]:
+        return [b + 1 for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int], pad_tokens: frozenset = frozenset()) -> str:
+        data = bytes(
+            int(t) - 1 for t in ids if int(t) > 0 and int(t) not in pad_tokens
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def tokenize(
+        self,
+        texts: Union[str, Sequence[str]],
+        context_length: int = 256,
+        truncate_text: bool = False,
+    ) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if truncate_text:
+                    ids = ids[:context_length]
+                else:
+                    raise RuntimeError(
+                        f"input {text!r} too long for context length {context_length}"
+                    )
+            out[i, : len(ids)] = ids
+        return out
+
+
+class HugTokenizer:
+    """HF `tokenizers` JSON file adapter (reference: tokenizer.py:158-192)."""
+
+    def __init__(self, bpe_path: str):
+        from tokenizers import Tokenizer  # gated import
+
+        self.tok = Tokenizer.from_file(str(bpe_path))
+        self.vocab_size = self.tok.get_vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        return self.tok.encode(text).ids
+
+    def decode(self, ids, pad_tokens: frozenset = frozenset()) -> str:
+        ids = [int(t) for t in ids if int(t) not in pad_tokens and int(t) != 0]
+        return self.tok.decode(ids, skip_special_tokens=True)
+
+    def tokenize(self, texts, context_length=256, truncate_text=False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if truncate_text:
+                    ids = ids[:context_length]
+                else:
+                    raise RuntimeError(
+                        f"input {text!r} too long for context length {context_length}"
+                    )
+            out[i, : len(ids)] = ids
+        return out
+
+
+class ChineseTokenizer:
+    """bert-base-chinese adapter (reference: tokenizer.py:196-228)."""
+
+    def __init__(self):
+        from transformers import BertTokenizer  # gated import
+
+        self.tok = BertTokenizer.from_pretrained("bert-base-chinese")
+        self.vocab_size = self.tok.vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return self.tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids, pad_tokens: frozenset = frozenset()) -> str:
+        ids = [int(t) for t in ids if int(t) not in pad_tokens and int(t) != 0]
+        return self.tok.decode(ids)
+
+    def tokenize(self, texts, context_length=256, truncate_text=False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if truncate_text:
+                    ids = ids[:context_length]
+                else:
+                    raise RuntimeError(
+                        f"input {text!r} too long for context length {context_length}"
+                    )
+            out[i, : len(ids)] = ids
+        return out
+
+
+class YttmTokenizer:
+    """youtokentome adapter (reference: tokenizer.py:232-266)."""
+
+    def __init__(self, bpe_path: str):
+        import youtokentome as yttm  # gated import
+
+        self.tok = yttm.BPE(model=str(bpe_path))
+        self.vocab_size = self.tok.vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        import youtokentome as yttm
+
+        return self.tok.encode([text], output_type=yttm.OutputType.ID)[0]
+
+    def decode(self, ids, pad_tokens: frozenset = frozenset()) -> str:
+        return self.tok.decode(
+            [[int(t) for t in ids]], ignore_ids=list(pad_tokens) + [0]
+        )[0]
+
+    def tokenize(self, texts, context_length=256, truncate_text=False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if truncate_text:
+                    ids = ids[:context_length]
+                else:
+                    raise RuntimeError(
+                        f"input {text!r} too long for context length {context_length}"
+                    )
+            out[i, : len(ids)] = ids
+        return out

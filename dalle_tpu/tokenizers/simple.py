@@ -1,0 +1,196 @@
+"""CLIP-style byte-level BPE tokenizer (pure Python, host-side).
+
+Capability parity with the reference's SimpleTokenizer
+(reference: dalle_pytorch/tokenizer.py:55-152): byte→unicode table, greedy
+lowest-rank pair merges, ``</w>`` end-of-word markers, whitespace/ftfy-lite
+cleanup, and the shared contract
+``tokenize(texts, context_length, truncate_text) -> int32 [b, ctx]`` with
+0-padding (pad id 0 is load-bearing: DALLE remaps it per position,
+see models/dalle.py).
+
+The reference ships OpenAI's 3.2 MB merges file as package data
+(reference: dalle_pytorch/data/bpe_simple_vocab_16e6.txt, MANIFEST.in:1).
+We do NOT vendor that file; pass ``bpe_path`` (searched in
+``$DALLE_TPU_BPE_PATH`` and ``~/.cache/dalle`` by default), or use
+``tokenizers/fallback.py``'s byte tokenizer when no merges are available.
+The BPE *algorithm* here is the standard public one, written fresh.
+"""
+
+from __future__ import annotations
+
+import functools
+import gzip
+import html
+import os
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+DEFAULT_SEARCH = (
+    os.environ.get("DALLE_TPU_BPE_PATH", ""),
+    str(Path.home() / ".cache" / "dalle" / "bpe_simple_vocab_16e6.txt"),
+)
+
+
+@functools.lru_cache()
+def bytes_to_unicode():
+    """Reversible byte→printable-unicode map (standard GPT-2/CLIP table)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+def get_pairs(word):
+    return {(a, b) for a, b in zip(word[:-1], word[1:])}
+
+
+def basic_clean(text: str) -> str:
+    # ftfy-lite: unescape entities twice, strip
+    return html.unescape(html.unescape(text)).strip()
+
+
+def whitespace_clean(text: str) -> str:
+    return re.sub(r"\s+", " ", text).strip()
+
+
+# stdlib `re` has no \p{L}; unicode letters are matched via str.isalpha in
+# the byte encoder path, ASCII classes suffice for the word splitter
+_WORD_PAT = re.compile(
+    r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"
+    r"|[^\W\d_]+|[0-9]|[^\s\w]+",
+    re.IGNORECASE | re.UNICODE,
+)
+
+
+class SimpleTokenizer:
+    """Byte-level BPE with CLIP merge semantics."""
+
+    def __init__(self, bpe_path: Optional[str] = None):
+        path = self._resolve(bpe_path)
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        merges = self._load_merges(path)
+        vocab = list(self.byte_encoder.values())
+        vocab = vocab + [v + "</w>" for v in vocab]
+        for m in merges:
+            vocab.append("".join(m))
+        vocab.extend(["<|startoftext|>", "<|endoftext|>"])
+        self.encoder = {tok: i for i, tok in enumerate(vocab)}
+        self.decoder = {i: tok for tok, i in self.encoder.items()}
+        self.bpe_ranks = {m: i for i, m in enumerate(merges)}
+        self.cache = {
+            "<|startoftext|>": "<|startoftext|>",
+            "<|endoftext|>": "<|endoftext|>",
+        }
+        self.vocab_size = len(self.encoder)
+
+    @staticmethod
+    def _resolve(bpe_path):
+        candidates = ([bpe_path] if bpe_path else []) + [
+            p for p in DEFAULT_SEARCH if p
+        ]
+        for p in candidates:
+            if p and Path(p).exists():
+                return p
+        raise FileNotFoundError(
+            "no BPE merges file found; pass bpe_path=, set $DALLE_TPU_BPE_PATH, "
+            "or place the CLIP merges at ~/.cache/dalle/bpe_simple_vocab_16e6.txt. "
+            "For a vocab-free alternative use dalle_tpu.tokenizers.ByteTokenizer."
+        )
+
+    @staticmethod
+    def _load_merges(path):
+        raw = Path(path).read_bytes()
+        if path.endswith(".gz"):
+            raw = gzip.decompress(raw)
+        lines = raw.decode("utf-8").split("\n")
+        # CLIP merges file layout: header line, then merge pairs; the
+        # published file is truncated to 49152-256-2+1 entries
+        merges = [tuple(l.split()) for l in lines[1:] if len(l.split()) == 2]
+        return merges[: 49152 - 256 - 2]
+
+    def bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word = tuple(token[:-1]) + (token[-1] + "</w>",)
+        pairs = get_pairs(word)
+        if not pairs:
+            return token + "</w>"
+        while True:
+            pair = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if pair not in self.bpe_ranks:
+                break
+            first, second = pair
+            new_word: List[str] = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(first, i)
+                except ValueError:
+                    new_word.extend(word[i:])
+                    break
+                new_word.extend(word[i:j])
+                i = j
+                if i < len(word) - 1 and word[i + 1] == second:
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+            if len(word) == 1:
+                break
+            pairs = get_pairs(word)
+        out = " ".join(word)
+        self.cache[token] = out
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        text = whitespace_clean(basic_clean(text)).lower()
+        for token in _WORD_PAT.findall(text):
+            token = "".join(self.byte_encoder[b] for b in token.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self.bpe(token).split(" "))
+        return ids
+
+    def decode(self, ids: Sequence[int], pad_tokens: frozenset = frozenset()) -> str:
+        text = "".join(
+            self.decoder[int(t)] for t in ids if int(t) not in pad_tokens and int(t) != 0
+        )
+        data = bytearray(self.byte_decoder[c] for c in text if c in self.byte_decoder)
+        return data.decode("utf-8", errors="replace").replace("</w>", " ")
+
+    def tokenize(
+        self,
+        texts: Union[str, Sequence[str]],
+        context_length: int = 256,
+        truncate_text: bool = False,
+    ) -> np.ndarray:
+        """→ int32 [b, context_length], 0-padded
+        (reference contract: tokenizer.py:119-152)."""
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if truncate_text:
+                    ids = ids[:context_length]
+                else:
+                    raise RuntimeError(
+                        f"input {text!r} too long for context length {context_length}"
+                    )
+            out[i, : len(ids)] = ids
+        return out

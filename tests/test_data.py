@@ -1,0 +1,169 @@
+"""Tokenizer + data pipeline tests (contract: SURVEY.md §2.9)."""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dalle_tpu.data import (
+    BatchedWebLoader,
+    DataLoader,
+    ImageFolderDataset,
+    TextImageDataset,
+    WebDataset,
+)
+from dalle_tpu.tokenizers import ByteTokenizer, SimpleTokenizer, get_tokenizer
+
+
+def _png_bytes(size=16, color=(255, 0, 0)):
+    img = Image.new("RGB", (size, size), color)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture
+def merges_file(tmp_path):
+    """Tiny synthetic CLIP-format merges file."""
+    lines = ["#version: synthetic", "t h", "th e</w>", "c a", "ca t</w>", "d o", "do g</w>"]
+    p = tmp_path / "merges.txt"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+@pytest.fixture
+def image_folder(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    for i, color in enumerate([(255, 0, 0), (0, 255, 0), (0, 0, 255), (9, 9, 9)]):
+        (d / f"sample{i}.png").write_bytes(_png_bytes(24, color))
+        (d / f"sample{i}.txt").write_text(f"a photo number {i}\nsecond caption {i}")
+    # unpaired files must be ignored
+    (d / "orphan.txt").write_text("no image")
+    (d / "orphan2.png").write_bytes(_png_bytes(24))
+    # corrupt image with a caption: must be skipped to a neighbor
+    (d / "bad.png").write_bytes(b"not a png")
+    (d / "bad.txt").write_text("broken image")
+    return str(d)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("hello world")
+    assert tok.decode(ids) == "hello world"
+    arr = tok.tokenize(["hi", "there"], context_length=8)
+    assert arr.shape == (2, 8) and arr.dtype == np.int32
+    assert arr[0, 2] == 0  # 0-padded
+    with pytest.raises(RuntimeError):
+        tok.tokenize("x" * 100, context_length=8)
+    assert tok.tokenize("x" * 100, context_length=8, truncate_text=True).shape == (1, 8)
+
+
+def test_simple_tokenizer_bpe(merges_file):
+    tok = SimpleTokenizer(bpe_path=merges_file)
+    ids = tok.encode("the cat")
+    assert ids, "nonempty encoding"
+    # merges applied: 'the' collapses to one token
+    assert len(tok.encode("the")) == 1
+    out = tok.decode(ids)
+    assert "the" in out and "cat" in out
+    arr = tok.tokenize("the dog", context_length=6)
+    assert arr.shape == (1, 6)
+
+
+def test_get_tokenizer_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv("DALLE_TPU_BPE_PATH", str(tmp_path / "missing.txt"))
+    tok = get_tokenizer()
+    assert isinstance(tok, ByteTokenizer)
+
+
+def test_text_image_dataset_pairing_and_skip(image_folder):
+    tok = ByteTokenizer()
+    ds = TextImageDataset(
+        image_folder, text_len=32, image_size=16, tokenizer=tok,
+        truncate_captions=True,
+    )
+    # 4 good pairs + 1 corrupt pair; orphans excluded
+    assert len(ds) == 5
+    tokens, image = ds[0]
+    assert tokens.shape == (32,) and image.shape == (16, 16, 3)
+    assert image.dtype == np.float32 and image.max() <= 1.0
+    # the corrupt pair falls back to a neighbor instead of raising
+    bad_idx = ds.keys.index("bad")
+    tokens_b, image_b = ds[bad_idx]
+    assert image_b.shape == (16, 16, 3)
+
+
+def test_dataloader_sharding_and_determinism(tmp_path):
+    # single-caption files + resize_ratio 1.0 → fully deterministic samples
+    d = tmp_path / "det"
+    d.mkdir()
+    for i in range(8):
+        (d / f"s{i}.png").write_bytes(_png_bytes(16, (i * 50, 10, 10)))
+        (d / f"s{i}.txt").write_text(f"caption {i}")
+
+    def make_ds():
+        return TextImageDataset(
+            str(d), text_len=16, image_size=16, tokenizer=ByteTokenizer(),
+            truncate_captions=True, resize_ratio=1.0,
+        )
+
+    full = DataLoader(make_ds(), batch_size=4, shuffle=True, seed=7)
+    b0 = next(iter(full))
+    assert b0[0].shape == (4, 16) and b0[1].shape == (4, 16, 16, 3)
+    b0_again = next(iter(DataLoader(make_ds(), batch_size=4, shuffle=True, seed=7)))
+    np.testing.assert_array_equal(b0[0], b0_again[0])  # same seed+epoch → same batch
+    # two ranks partition each global batch
+    r0 = next(iter(DataLoader(make_ds(), batch_size=4, shuffle=True, seed=7, rank=0, world=2)))
+    r1 = next(iter(DataLoader(make_ds(), batch_size=4, shuffle=True, seed=7, rank=1, world=2)))
+    assert r0[0].shape == (2, 16)
+    np.testing.assert_array_equal(np.concatenate([r0[0], r1[0]]), b0[0])
+    loader2 = DataLoader(make_ds(), batch_size=4, shuffle=True, seed=7)
+    loader2.set_epoch(1)
+    b1 = next(iter(loader2))
+    assert not np.array_equal(b0[0], b1[0])  # new epoch → new order
+
+
+def test_image_folder_dataset(image_folder):
+    ds = ImageFolderDataset(image_folder, image_size=8)
+    assert len(ds) >= 4
+    img = ds[0]
+    assert img.shape == (8, 8, 3)
+
+
+def test_webdataset_tar_streaming(tmp_path):
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for i in range(6):
+            png = _png_bytes(16, (i * 20, 0, 0))
+            info = tarfile.TarInfo(f"sample{i}.png")
+            info.size = len(png)
+            tar.addfile(info, io.BytesIO(png))
+            txt = f"caption {i}".encode()
+            info = tarfile.TarInfo(f"sample{i}.txt")
+            info.size = len(txt)
+            tar.addfile(info, io.BytesIO(txt))
+        # sample missing a caption: filtered out
+        png = _png_bytes(16)
+        info = tarfile.TarInfo("lonely.png")
+        info.size = len(png)
+        tar.addfile(info, io.BytesIO(png))
+
+    ds = WebDataset(str(tmp_path), shuffle_buffer=4)
+    samples = list(ds)
+    assert len(samples) == 6  # lonely.png filtered
+
+    loader = BatchedWebLoader(
+        WebDataset(str(tmp_path), shuffle_buffer=4),
+        batch_size=2,
+        tokenizer=ByteTokenizer(),
+        text_len=16,
+        image_size=8,
+        nominal_length=3,
+    )
+    batches = list(loader)
+    assert len(batches) == 3
+    t, im = batches[0]
+    assert t.shape == (2, 16) and im.shape == (2, 8, 8, 3)
