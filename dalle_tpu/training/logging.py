@@ -1,0 +1,146 @@
+"""Observability facade: wandb when available, JSONL + PNG files otherwise.
+
+The reference hard-depends on wandb ("Quit early if user doesn't have wandb
+installed", reference: train_dalle.py:9) for scalars, recon grids, generated
+samples, codebook histograms, and model artifacts (SURVEY.md §5.5).  This
+facade keeps that whole capability surface but degrades gracefully: without
+wandb, scalars append to ``<dir>/metrics.jsonl`` and images save under
+``<dir>/media/`` — so training is observable on a bare TPU VM.
+
+Root-worker gating is the caller's job, same idiom as the reference
+(``if backend.is_root_worker():``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+
+def _to_uint8(img: np.ndarray) -> np.ndarray:
+    img = np.asarray(img, dtype=np.float32)
+    return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def make_grid(images: np.ndarray, ncol: int = 4) -> np.ndarray:
+    """[n, h, w, c] → one grid image (torchvision.make_grid-equivalent)."""
+    n, h, w, c = images.shape
+    ncol = min(ncol, n)
+    nrow = (n + ncol - 1) // ncol
+    grid = np.zeros((nrow * h, ncol * w, c), dtype=images.dtype)
+    for i in range(n):
+        r, col = divmod(i, ncol)
+        grid[r * h : (r + 1) * h, col * w : (col + 1) * w] = images[i]
+    return grid
+
+
+class Run:
+    """One experiment run."""
+
+    def __init__(
+        self,
+        project: str,
+        *,
+        config: Optional[dict] = None,
+        log_dir: str = "logs",
+        name: Optional[str] = None,
+        use_wandb: bool = True,
+        resume: bool = False,
+    ):
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(
+                    project=project,
+                    config=config or {},
+                    name=name,
+                    resume=resume,
+                )
+            except Exception:
+                self._wandb = None
+        self.dir = Path(log_dir) / (name or f"{project}-{int(time.time())}")
+        self.dir.mkdir(parents=True, exist_ok=True)
+        (self.dir / "media").mkdir(exist_ok=True)
+        self._metrics = open(self.dir / "metrics.jsonl", "a")
+        if config:
+            (self.dir / "config.json").write_text(json.dumps(config, indent=2))
+
+    def log(self, metrics: dict, step: Optional[int] = None):
+        scalars = {
+            k: float(v)
+            for k, v in metrics.items()
+            if isinstance(v, (int, float)) or (hasattr(v, "shape") and v.shape == ())
+        }
+        rec = {"_time": time.time(), **({"step": step} if step is not None else {}), **scalars}
+        self._metrics.write(json.dumps(rec) + "\n")
+        self._metrics.flush()
+        if self._wandb:
+            self._wandb.log(metrics, step=step)
+
+    def log_images(self, tag: str, images: np.ndarray, step: int, *, captions=None):
+        """images: [n, h, w, c] floats in [0,1]."""
+        from PIL import Image
+
+        grid = make_grid(_to_uint8(images))
+        fname = self.dir / "media" / f"{tag.replace('/', '_')}_{step:08d}.png"
+        Image.fromarray(grid).save(fname)
+        if self._wandb:
+            self._wandb.log(
+                {
+                    tag: [
+                        self._wandb.Image(
+                            np.asarray(img),
+                            caption=None if captions is None else captions[i],
+                        )
+                        for i, img in enumerate(_to_uint8(images))
+                    ]
+                },
+                step=step,
+            )
+
+    def log_histogram(self, tag: str, values: np.ndarray, step: int, bins: int = 64):
+        """Codebook-collapse monitoring (reference: train_vae.py:255-264)."""
+        hist, edges = np.histogram(np.asarray(values).ravel(), bins=bins)
+        rec = {
+            "_time": time.time(),
+            "step": step,
+            f"{tag}/hist": hist.tolist(),
+            f"{tag}/edges": edges.tolist(),
+        }
+        self._metrics.write(json.dumps(rec) + "\n")
+        self._metrics.flush()
+        if self._wandb:
+            self._wandb.log(
+                {tag: self._wandb.Histogram(np_histogram=(hist, edges))}, step=step
+            )
+
+    def log_artifact(self, path: str, *, name: str, kind: str = "model"):
+        """Model artifact upload (reference: train_dalle.py:637-649); local
+        fallback records the path."""
+        if self._wandb:
+            try:
+                art = self._wandb.Artifact(name, type=kind)
+                p = Path(path)
+                if p.is_dir():
+                    art.add_dir(str(p))
+                else:
+                    art.add_file(str(p))
+                self._wandb.log_artifact(art)
+                return
+            except Exception:
+                pass
+        (self.dir / "artifacts.jsonl").open("a").write(
+            json.dumps({"name": name, "path": str(path), "time": time.time()}) + "\n"
+        )
+
+    def finish(self):
+        self._metrics.close()
+        if self._wandb:
+            self._wandb.finish()

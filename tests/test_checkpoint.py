@@ -1,0 +1,106 @@
+"""Checkpoint/resume + logging-facade tests (SURVEY.md §5.4, §5.5)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.parallel import make_mesh, param_shardings
+from dalle_tpu.training import init_train_state, make_optimizer
+from dalle_tpu.training.checkpoint import (
+    is_checkpoint,
+    load_checkpoint,
+    load_meta,
+    save_checkpoint,
+)
+from dalle_tpu.training.logging import Run, make_grid
+
+
+def cfg():
+    return DALLEConfig(
+        num_text_tokens=16, text_seq_len=4, num_image_tokens=8,
+        image_fmap_size=2, dim=16, depth=1, heads=2, dim_head=8,
+    )
+
+
+def test_checkpoint_roundtrip_self_describing(tmp_path, rng):
+    c = cfg()
+    model = DALLE(c)
+    text = jnp.zeros((2, 4), jnp.int32)
+    codes = jnp.zeros((2, 4), jnp.int32)
+    params = model.init({"params": rng}, text, codes)["params"]
+    tx = make_optimizer(1e-3)
+    opt_state = tx.init(params)
+
+    p = save_checkpoint(
+        str(tmp_path / "ckpt-step10"),
+        params=params,
+        opt_state=opt_state,
+        hparams=c.to_dict(),
+        epoch=3,
+        step=10,
+        scheduler_state={"lr": 1e-3},
+    )
+    assert is_checkpoint(p)
+    out = load_checkpoint(p)
+    # self-describing: model rebuilds from hparams alone
+    c2 = DALLEConfig.from_dict(out["hparams"])
+    assert c2 == c and out["epoch"] == 3 and out["step"] == 10
+    restored = out["params"]
+    np.testing.assert_allclose(
+        np.asarray(restored["text_emb"]["embedding"]),
+        np.asarray(params["text_emb"]["embedding"]),
+    )
+    assert "opt_state" in out["subtrees"]
+
+
+def test_checkpoint_restore_sharded(tmp_path, rng, devices):
+    c = cfg()
+    model = DALLE(c)
+    text = jnp.zeros((2, 4), jnp.int32)
+    codes = jnp.zeros((2, 4), jnp.int32)
+    mesh = make_mesh(dp=2, fsdp=2, tp=2)
+    tx = make_optimizer(1e-3)
+    params, _ = init_train_state(model, tx, mesh, {"params": rng}, text, codes)
+    p = save_checkpoint(str(tmp_path / "ck"), params=params, hparams=c.to_dict())
+
+    shardings = param_shardings(jax.eval_shape(lambda: params), mesh)
+    target = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        params,
+        shardings,
+    )
+    out = load_checkpoint(p, params_target=target)
+    leaf = out["params"]["transformer"]["layer_0_attn"]["fn"]["qkv"]["kernel"]
+    assert leaf.sharding.spec == shardings["transformer"]["layer_0_attn"]["fn"]["qkv"]["kernel"].spec
+
+
+def test_checkpoint_pruning(tmp_path, rng):
+    c = cfg()
+    params = {"w": jnp.ones((2,))}
+    for step in range(5):
+        save_checkpoint(
+            str(tmp_path / f"run-step{step}"),
+            params=params,
+            hparams=c.to_dict(),
+            step=step,
+            keep_n=2,
+        )
+    left = sorted(d.name for d in tmp_path.iterdir() if d.is_dir())
+    assert len(left) == 2 and "run-step4" in left
+
+
+def test_logging_facade(tmp_path):
+    run = Run("proj", config={"a": 1}, log_dir=str(tmp_path), name="t", use_wandb=False)
+    run.log({"loss": 1.5, "lr": 1e-3}, step=1)
+    run.log_images("recon", np.random.rand(4, 8, 8, 3).astype(np.float32), step=1)
+    run.log_histogram("codebook", np.random.randint(0, 16, 100), step=1)
+    run.log_artifact(str(tmp_path), name="ckpt")
+    run.finish()
+    lines = [json.loads(l) for l in (tmp_path / "t" / "metrics.jsonl").read_text().splitlines()]
+    assert any("loss" in l for l in lines)
+    assert list((tmp_path / "t" / "media").glob("*.png"))
+    grid = make_grid(np.zeros((5, 4, 4, 3)))
+    assert grid.shape == (8, 16, 3)
