@@ -48,7 +48,8 @@ def make_mesh(
         assert n % known == 0, f"{n} devices not divisible by {known}"
         sizes[unknown[0]] = n // known
     total = int(np.prod(sizes))
-    assert total == n, f"mesh {dict(zip(AXES, sizes))} != {n} devices"
+    assert total <= n, f"mesh {dict(zip(AXES, sizes))} needs {total} > {n} devices"
+    devices = devices[:total]  # explicit sizes may use a device subset
     if jax.process_count() > 1:
         from jax.experimental import mesh_utils
 

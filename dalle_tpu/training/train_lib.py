@@ -54,7 +54,12 @@ def make_optimizer(
 
 
 def set_learning_rate(opt_state, lr: float):
-    """Mutate the injected learning rate (host-side scheduler hook)."""
+    """Mutate the injected learning rate (host-side scheduler hook).
+    Handles plain chains and optax.MultiSteps wrappers."""
+    if hasattr(opt_state, "inner_opt_state"):  # optax.MultiSteps
+        return opt_state._replace(
+            inner_opt_state=set_learning_rate(opt_state.inner_opt_state, lr)
+        )
     inner = opt_state[-1]
     inner.hyperparams["learning_rate"] = jnp.asarray(
         lr, inner.hyperparams["learning_rate"].dtype
@@ -63,6 +68,8 @@ def set_learning_rate(opt_state, lr: float):
 
 
 def get_learning_rate(opt_state) -> float:
+    if hasattr(opt_state, "inner_opt_state"):
+        return get_learning_rate(opt_state.inner_opt_state)
     return float(opt_state[-1].hyperparams["learning_rate"])
 
 

@@ -1,0 +1,93 @@
+"""End-to-end CLI tests: train a tiny VAE, train a tiny DALLE on it, resume,
+generate images — the full reference workflow on synthetic data
+(the reference's analogue is the rainbow notebook, SURVEY.md §4.2)."""
+
+import io
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+
+@pytest.fixture(scope="module")
+def tiny_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pairs")
+    rng = np.random.RandomState(0)
+    names = ["red square", "green circle", "blue cross", "dark blob"]
+    for i in range(12):
+        arr = (rng.rand(16, 16, 3) * 255).astype(np.uint8)
+        arr[:, :, i % 3] = 255  # dominant channel keyed to caption
+        Image.fromarray(arr).save(d / f"img{i}.png")
+        (d / f"img{i}.txt").write_text(names[i % 4])
+    return str(d)
+
+
+def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
+    import train_vae
+
+    vae_out = str(tmp_path / "vae_ckpt")
+    train_vae.main([
+        "--image_folder", tiny_data,
+        "--image_size", "16",
+        "--batch_size", "4",
+        "--epochs", "2",
+        "--num_tokens", "32",
+        "--num_layers", "2",
+        "--num_resnet_blocks", "0",
+        "--emb_dim", "16",
+        "--hidden_dim", "16",
+        "--output_path", vae_out,
+        "--no_wandb",
+        "--learning_rate", "3e-3",
+        "--mesh_dp", "4",
+    ])
+    import dalle_tpu.training.checkpoint as ck
+
+    assert ck.is_checkpoint(vae_out + "/vae-final")
+
+    import train_dalle
+
+    dalle_out = str(tmp_path / "dalle_ckpt")
+    common = [
+        "--image_text_folder", tiny_data,
+        "--vae_path", vae_out + "/vae-final",
+        "--batch_size", "4",
+        "--dim", "32",
+        "--depth", "2",
+        "--heads", "2",
+        "--dim_head", "16",
+        "--text_seq_len", "16",
+        "--attn_types", "full,axial_row",
+        "--truncate_captions",
+        "--output_path", dalle_out,
+        "--no_wandb",
+        "--mesh_dp", "2",
+        "--mesh_tp", "2",
+    ]
+    train_dalle.main(common + ["--epochs", "1"])
+    assert ck.is_checkpoint(dalle_out + "/dalle-final")
+
+    # resume from the final checkpoint for one more epoch
+    resume = [a for a in common if a != "--vae_path" and a != vae_out + "/vae-final"]
+    train_dalle.main(
+        resume + ["--epochs", "2", "--dalle_path", dalle_out + "/dalle-final"]
+    )
+
+    import generate
+
+    out_dir = str(tmp_path / "outputs")
+    generate.main([
+        "--dalle_path", dalle_out + "/dalle-final",
+        "--text", "red square|green circle",
+        "--num_images", "3",
+        "--batch_size", "2",
+        "--outputs_dir", out_dir,
+    ])
+    from pathlib import Path
+
+    reds = list((Path(out_dir) / "red_square").glob("*.jpg"))
+    greens = list((Path(out_dir) / "green_circle").glob("*.jpg"))
+    assert len(reds) == 3 and len(greens) == 3
+    img = Image.open(reds[0])
+    assert img.size == (16, 16)

@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""DALL-E training CLI.
+
+Flag-compatible re-design of the reference trainer (reference:
+train_dalle.py:29-137 args, :235-289 VAE resolution, :564-644 loop):
+resume with self-describing checkpoints, folder or tar-shard (webdataset)
+data, tokenizer selection, fail-early checkpoint, in-loop sampling,
+throughput meter, profiler window, plateau LR decay, retention pruning.
+
+TPU-native core: one jitted train step over the backend's mesh (VAE encode
+fused in), gradient accumulation via optax.MultiSteps, bf16 compute policy
+instead of fp16+loss-scaling (reference: --fp16/--amp, train_dalle.py:466-472).
+"""
+
+import argparse
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dalle_tpu.data import BatchedWebLoader, DataLoader, TextImageDataset, WebDataset
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.generate import generate_images
+from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+from dalle_tpu.parallel import backend as backend_lib
+from dalle_tpu.training import (
+    count_params,
+    init_train_state,
+    make_dalle_train_step,
+    make_optimizer,
+    set_learning_rate,
+)
+from dalle_tpu.training.checkpoint import (
+    is_checkpoint,
+    load_checkpoint,
+    load_meta,
+    save_checkpoint,
+)
+from dalle_tpu.training.logging import Run
+from dalle_tpu.training.schedule import ReduceLROnPlateau
+from dalle_tpu.tokenizers import get_tokenizer
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="Train DALL-E (TPU-native)")
+    # --- data / tokenizer / VAE selection (reference: train_dalle.py:31-87)
+    group = parser.add_mutually_exclusive_group(required=False)
+    group.add_argument("--vae_path", type=str, default=None,
+                       help="path to a trained DiscreteVAE checkpoint dir")
+    group.add_argument("--dalle_path", type=str, default=None,
+                       help="resume: path to a DALLE checkpoint dir")
+    parser.add_argument("--image_text_folder", type=str, required=True,
+                        help="folder of paired files, or tar-shard spec (--wds)")
+    parser.add_argument("--wds", type=str, default="",
+                        help="comma-sep caption,image keys to enable webdataset mode")
+    parser.add_argument("--truncate_captions", action="store_true")
+    parser.add_argument("--random_resize_crop_lower_ratio", dest="resize_ratio",
+                        type=float, default=0.75)
+    parser.add_argument("--chinese", action="store_true")
+    parser.add_argument("--taming", action="store_true")
+    parser.add_argument("--hug", action="store_true")
+    parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
+    parser.add_argument("--wandb_name", type=str, default="dalle_train_transformer")
+    parser.add_argument("--wandb_entity", type=str, default=None)
+    parser.add_argument("--no_wandb", action="store_true")
+    # --- training (reference: train_dalle.py:91-109)
+    parser.add_argument("--flops_profiler", action="store_true",
+                        help="jax.profiler trace at step 200 (reference parity)")
+    parser.add_argument("--epochs", type=int, default=20)
+    parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--keep_n_checkpoints", type=int, default=None)
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--ga_steps", type=int, default=1)
+    parser.add_argument("--learning_rate", type=float, default=3e-4)
+    parser.add_argument("--clip_grad_norm", type=float, default=0.5)
+    parser.add_argument("--lr_decay", action="store_true")
+    parser.add_argument("--bf16", "--fp16", dest="bf16", action="store_true",
+                        help="bf16 compute (supersedes the reference's fp16)")
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--output_path", type=str, default="dalle_ckpt")
+    # --- model (reference: train_dalle.py:111-135)
+    parser.add_argument("--dim", type=int, default=512)
+    parser.add_argument("--text_seq_len", type=int, default=256)
+    parser.add_argument("--depth", type=int, default=2)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--dim_head", type=int, default=64)
+    parser.add_argument("--reversible", action="store_true")
+    parser.add_argument("--use_remat", action="store_true",
+                        help="rematerialize layer activations (memory lever)")
+    parser.add_argument("--loss_img_weight", type=int, default=7)
+    parser.add_argument("--attn_types", type=str, default="full",
+                        help="comma-sep cycle: full,axial_row,axial_col,conv_like,sparse,mlp")
+    parser.add_argument("--shift_tokens", action="store_true")
+    parser.add_argument("--rotary_emb", action="store_true")
+    parser.add_argument("--shared_attn_ids", type=str, default=None,
+                        help="unsupported (reference janEbert extension); ignored")
+    parser.add_argument("--stable_softmax", dest="stable", action="store_true")
+    parser.add_argument("--sandwich_norm", action="store_true")
+    parser.add_argument("--attn_dropout", type=float, default=0.0)
+    parser.add_argument("--ff_dropout", type=float, default=0.0)
+    parser.add_argument("--num_text_tokens", type=int, default=None,
+                        help="default: tokenizer vocab size")
+    parser = backend_lib.wrap_arg_parser(parser)
+    return parser.parse_args(argv)
+
+
+def resolve_vae(args, resume_meta):
+    """VAE resolution order (reference: train_dalle.py:235-289):
+    resume ckpt's embedded vae → --vae_path → --taming → OpenAI default."""
+    if resume_meta is not None and resume_meta.get("vae_hparams"):
+        cfg = DiscreteVAEConfig.from_dict(resume_meta["vae_hparams"])
+        return DiscreteVAE(cfg), resume_meta["vae_params"], cfg
+    if args.vae_path:
+        assert is_checkpoint(args.vae_path), f"{args.vae_path} is not a checkpoint"
+        out = load_checkpoint(args.vae_path)
+        cfg = DiscreteVAEConfig.from_dict(out["hparams"])
+        return DiscreteVAE(cfg), out["params"], cfg
+    if args.taming:
+        from dalle_tpu.models.pretrained import VQGanVAE
+
+        vq = VQGanVAE()  # raises with guidance until converters land
+        return vq, None, None
+    from dalle_tpu.models.pretrained import OpenAIDiscreteVAE
+
+    oa = OpenAIDiscreteVAE()
+    return oa, None, None
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    distr = backend_lib.set_backend_from_args(args)
+    mesh_kw = {
+        ax: getattr(args, f"mesh_{ax}")
+        for ax in ("dp", "fsdp", "tp", "sp")
+        if getattr(args, f"mesh_{ax}", None)
+    }
+    distr.initialize(**mesh_kw)
+    distr.check_batch_size(args.batch_size)
+    is_root = distr.is_root_worker()
+    rank, world = distr.get_rank(), distr.get_world_size()
+
+    tokenizer = get_tokenizer(
+        bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese
+    )
+
+    resume_meta = None
+    start_epoch = 0
+    if args.dalle_path:
+        assert is_checkpoint(args.dalle_path), f"{args.dalle_path}: no checkpoint"
+        resume_meta = load_checkpoint(args.dalle_path)
+        start_epoch = resume_meta.get("epoch", 0)
+
+    vae, vae_params, vae_cfg = resolve_vae(args, resume_meta)
+
+    if resume_meta is not None:
+        cfg = DALLEConfig.from_dict(resume_meta["hparams"])
+    else:
+        num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
+        cfg = DALLEConfig(
+            num_text_tokens=num_text_tokens,
+            text_seq_len=args.text_seq_len,
+            num_image_tokens=vae_cfg.num_tokens,
+            image_fmap_size=vae_cfg.fmap_size,
+            dim=args.dim,
+            depth=args.depth,
+            heads=args.heads,
+            dim_head=args.dim_head,
+            ff_mult=4,
+            attn_dropout=args.attn_dropout,
+            ff_dropout=args.ff_dropout,
+            attn_types=tuple(args.attn_types.split(",")),
+            loss_img_weight=args.loss_img_weight,
+            stable=args.stable,
+            sandwich_norm=args.sandwich_norm,
+            shift_tokens=args.shift_tokens,
+            rotary_emb=args.rotary_emb,
+            reversible=args.reversible,
+            use_remat=args.use_remat,
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        )
+    model = DALLE(cfg)
+    image_size = vae_cfg.image_size
+
+    # --- data (reference: train_dalle.py:331-408) --------------------------
+    if args.wds:
+        keys = [k.strip() for k in args.wds.split(",")]
+        ck = keys[0] if keys and keys[0] else None
+        ik = keys[1] if len(keys) > 1 and keys[1] else None
+        loader = BatchedWebLoader(
+            WebDataset(
+                args.image_text_folder,
+                caption_key=ck,
+                image_key=ik,
+                rank=rank,
+                world=world,
+                seed=args.seed,
+            ),
+            batch_size=args.batch_size // world,
+            tokenizer=tokenizer,
+            text_len=cfg.text_seq_len,
+            image_size=image_size,
+            truncate_captions=args.truncate_captions,
+            nominal_length=int(1e9 // args.batch_size),
+        )
+        epoch_len = None
+    else:
+        ds = TextImageDataset(
+            args.image_text_folder,
+            text_len=cfg.text_seq_len,
+            image_size=image_size,
+            truncate_captions=args.truncate_captions,
+            resize_ratio=args.resize_ratio,
+            tokenizer=tokenizer,
+            shuffle=True,
+            seed=args.seed,
+        )
+        assert len(ds) > 0, f"no image-text pairs at {args.image_text_folder}"
+        loader = DataLoader(
+            ds, args.batch_size, shuffle=True, seed=args.seed, rank=rank, world=world
+        )
+        epoch_len = len(loader)
+
+    # --- model/optimizer/train step ----------------------------------------
+    rng = jax.random.PRNGKey(args.seed)
+    tx = make_optimizer(args.learning_rate, clip_grad_norm=args.clip_grad_norm)
+    if args.ga_steps > 1:  # (reference: --ga_steps, train_dalle.py:103,464)
+        tx = optax.MultiSteps(tx, every_k_schedule=args.ga_steps)
+    text0 = jnp.zeros((args.batch_size // world, cfg.text_seq_len), jnp.int32)
+    codes0 = jnp.zeros((args.batch_size // world, cfg.image_seq_len), jnp.int32)
+    params, opt_state = init_train_state(
+        model, tx, distr.mesh, {"params": rng}, text0, codes0
+    )
+    if resume_meta is not None:
+        params = jax.device_put(
+            resume_meta["params"],
+            jax.tree_util.tree_map(lambda x: x.sharding, params),
+        )
+    vae_params = jax.device_put(vae_params) if vae_params is not None else None
+    step_fn = make_dalle_train_step(model, tx, distr.mesh, vae=vae)
+
+    sched = ReduceLROnPlateau(lr=args.learning_rate) if args.lr_decay else None
+    if sched and resume_meta and resume_meta.get("scheduler_state"):
+        sched.load_state_dict(resume_meta["scheduler_state"])
+
+    run = Run(
+        "dalle_train_transformer",
+        config={**cfg.to_dict(), "batch_size": args.batch_size,
+                "learning_rate": args.learning_rate},
+        name=args.wandb_name,
+        use_wandb=not args.no_wandb,
+        resume=resume_meta is not None,
+    ) if is_root else None
+    if is_root:
+        print(f"DALLE params: {count_params(params):,}")
+
+    ckpt_dir = Path(args.output_path)
+    global_step = 0
+
+    def save(tag):
+        if is_root:
+            save_checkpoint(
+                str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}"),
+                params=params,
+                hparams=cfg.to_dict(),
+                vae_params=vae_params,
+                vae_hparams=vae_cfg.to_dict() if vae_cfg else None,
+                epoch=epoch,
+                step=global_step,
+                scheduler_state=sched.state_dict() if sched else None,
+                keep_n=args.keep_n_checkpoints,
+            )
+
+    # fail-early checkpoint (reference: train_dalle.py:561-563)
+    epoch = start_epoch
+    save("init")
+
+    lr = args.learning_rate
+    t10 = time.perf_counter()
+    for epoch in range(start_epoch, args.epochs):
+        if hasattr(loader, "set_epoch"):
+            loader.set_epoch(epoch)
+        epoch_losses = []
+        for i, (text, images) in enumerate(loader):
+            if args.flops_profiler and global_step == 200 and is_root:
+                jax.profiler.start_trace(str(ckpt_dir / "profile"))
+            params, opt_state, loss = step_fn(
+                params, opt_state, vae_params, text, images,
+                jax.random.fold_in(rng, global_step),
+            )
+            if args.flops_profiler and global_step == 201 and is_root:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                print(f"profiler trace written to {ckpt_dir/'profile'}")
+            epoch_losses.append(float(loss))
+
+            if global_step != 0 and global_step % args.save_every_n_steps == 0:
+                save(f"step{global_step}")
+            if is_root and global_step % 10 == 0:
+                avg_loss = float(distr.average_all(loss))
+                dt = time.perf_counter() - t10
+                t10 = time.perf_counter()
+                sps = args.batch_size * 10 / dt if global_step else 0.0
+                print(
+                    f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
+                    f"lr {lr:.2e} ({sps:.1f} samples/s)"
+                )
+                run.log(
+                    {"loss": avg_loss, "lr": lr, "epoch": epoch,
+                     "sample_per_sec": sps},
+                    step=global_step,
+                )
+            if is_root and global_step % 100 == 0 and global_step != 0:
+                # in-loop sample generation (reference: train_dalle.py:604-619)
+                sample_text = jnp.asarray(text[:1])
+                imgs = generate_images(
+                    model, params, vae, vae_params, sample_text,
+                    jax.random.fold_in(rng, -global_step), filter_thres=0.9,
+                )
+                caption = tokenizer.decode(np.asarray(sample_text)[0])
+                run.log_images(
+                    "image", np.asarray(imgs, np.float32), global_step,
+                    captions=[caption],
+                )
+            global_step += 1
+
+        if sched is not None and epoch_losses:
+            lr = sched.step(float(np.mean(epoch_losses)))
+            opt_state = set_learning_rate(opt_state, lr)
+        save(f"epoch{epoch}")
+        if is_root:
+            run.log_artifact(
+                str(ckpt_dir / f"{args.dalle_output_file_name}-epoch{epoch}"),
+                name="trained-dalle",
+            )
+    save("final")
+    if is_root:
+        run.finish()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""DiscreteVAE training CLI.
+
+Flag-compatible re-design of the reference trainer
+(reference: train_vae.py:26-100 args, :223-296 loop): Gumbel temperature
+annealing every 100 steps, recon-grid + codebook-histogram logging,
+exponential LR decay per logging interval, self-describing checkpoints,
+distributed via the backend registry.  The whole step (forward, Gumbel
+sample, backward, Adam) is one jitted XLA program on the mesh.
+"""
+
+import argparse
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.data import DataLoader, ImageFolderDataset
+from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+from dalle_tpu.parallel import backend as backend_lib
+from dalle_tpu.training import (
+    count_params,
+    init_train_state,
+    make_optimizer,
+    make_vae_train_step,
+    set_learning_rate,
+)
+from dalle_tpu.training.checkpoint import save_checkpoint
+from dalle_tpu.training.logging import Run
+from dalle_tpu.training.schedule import ExponentialDecay
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="Train a DiscreteVAE (TPU-native)")
+    # (reference: train_vae.py:30-98 argument surface)
+    parser.add_argument("--image_folder", type=str, required=True)
+    parser.add_argument("--image_size", type=int, default=128)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--epochs", type=int, default=20)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--lr_decay_rate", type=float, default=0.98)
+    parser.add_argument("--starting_temp", type=float, default=1.0)
+    parser.add_argument("--temp_min", type=float, default=0.5)
+    parser.add_argument("--anneal_rate", type=float, default=1e-6)
+    parser.add_argument("--num_tokens", type=int, default=8192)
+    parser.add_argument("--num_layers", type=int, default=3)
+    parser.add_argument("--num_resnet_blocks", type=int, default=2)
+    parser.add_argument("--smooth_l1_loss", action="store_true")
+    parser.add_argument("--emb_dim", type=int, default=512)
+    parser.add_argument("--hidden_dim", type=int, default=256)
+    parser.add_argument("--kl_loss_weight", type=float, default=0.0)
+    parser.add_argument("--straight_through", action="store_true")
+    parser.add_argument("--num_images_save", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--output_path", type=str, default="vae_ckpt")
+    parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--wandb_name", type=str, default="dalle_tpu_train_vae")
+    parser.add_argument("--no_wandb", action="store_true")
+    parser = backend_lib.wrap_arg_parser(parser)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    distr = backend_lib.set_backend_from_args(args)
+    mesh_kw = {
+        ax: getattr(args, f"mesh_{ax}")
+        for ax in ("dp", "fsdp", "tp", "sp")
+        if getattr(args, f"mesh_{ax}", None)
+    }
+    distr.initialize(**mesh_kw)
+    distr.check_batch_size(args.batch_size)
+    is_root = distr.is_root_worker()
+
+    cfg = DiscreteVAEConfig(
+        image_size=args.image_size,
+        num_tokens=args.num_tokens,
+        codebook_dim=args.emb_dim,
+        num_layers=args.num_layers,
+        num_resnet_blocks=args.num_resnet_blocks,
+        hidden_dim=args.hidden_dim,
+        smooth_l1_loss=args.smooth_l1_loss,
+        temperature=args.starting_temp,
+        straight_through=args.straight_through,
+        kl_div_loss_weight=args.kl_loss_weight,
+    )
+    vae = DiscreteVAE(cfg)
+
+    dataset = ImageFolderDataset(args.image_folder, image_size=args.image_size)
+    assert len(dataset) > 0, f"no images found in {args.image_folder}"
+    loader = DataLoader(
+        dataset,
+        args.batch_size,
+        shuffle=True,
+        seed=args.seed,
+        rank=distr.get_rank(),
+        world=distr.get_world_size(),
+    )
+
+    rng = jax.random.PRNGKey(args.seed)
+    sample = jnp.zeros((args.batch_size, args.image_size, args.image_size, 3))
+    tx = make_optimizer(args.learning_rate, clip_grad_norm=None)
+    params, opt_state = init_train_state(
+        vae, tx, distr.mesh, {"params": rng, "gumbel": rng}, sample, return_loss=True
+    )
+    step_fn = make_vae_train_step(vae, tx, distr.mesh)
+    encode_fn = jax.jit(
+        lambda p, img: vae.apply({"params": p}, img, method=DiscreteVAE.get_codebook_indices)
+    )
+    decode_fn = jax.jit(lambda p, ids: vae.apply({"params": p}, ids, method=DiscreteVAE.decode))
+
+    run = Run(
+        "dalle_tpu_train_vae",
+        config={**cfg.to_dict(), "batch_size": args.batch_size, "lr": args.learning_rate},
+        name=args.wandb_name,
+        use_wandb=not args.no_wandb,
+    ) if is_root else None
+    if is_root:
+        print(f"VAE params: {count_params(params):,}; dataset: {len(dataset)} images")
+
+    sched = ExponentialDecay(lr=args.learning_rate, gamma=args.lr_decay_rate)
+    temp = args.starting_temp
+    global_step = 0
+    t10 = time.perf_counter()
+
+    def save(name):
+        if is_root:
+            save_checkpoint(
+                f"{args.output_path}/{name}",
+                params=params,
+                hparams=cfg.to_dict(),
+                step=global_step,
+                scheduler_state=sched.state_dict(),
+            )
+
+    for epoch in range(args.epochs):
+        loader.set_epoch(epoch)
+        for images in loader:
+            params, opt_state, loss, recons = step_fn(
+                params, opt_state, images, temp, jax.random.fold_in(rng, global_step)
+            )
+            if global_step % 100 == 0:
+                # temperature anneal (reference: train_vae.py:218-221,269-271)
+                temp = max(
+                    args.starting_temp * math.exp(-args.anneal_rate * global_step),
+                    args.temp_min,
+                )
+                lr = sched.step()
+                opt_state = set_learning_rate(opt_state, lr)
+                if is_root:
+                    k = args.num_images_save
+                    images_np = np.asarray(images[:k])
+                    codes = encode_fn(params, images[:k])
+                    hard = np.asarray(decode_fn(params, codes))
+                    run.log_images("original", images_np, global_step)
+                    run.log_images("hard_recon", np.clip(hard, 0, 1), global_step)
+                    run.log_images(
+                        "soft_recon", np.clip(np.asarray(recons[:k]), 0, 1), global_step
+                    )
+                    run.log_histogram(
+                        "codebook_indices", np.asarray(codes), global_step
+                    )
+                    run.log({"temperature": temp, "lr": lr}, step=global_step)
+            if global_step % args.save_every_n_steps == 0:
+                save("vae")
+            if is_root and global_step % 10 == 0:
+                avg_loss = float(distr.average_all(loss))
+                dt = time.perf_counter() - t10
+                t10 = time.perf_counter()
+                sps = args.batch_size * 10 / dt if global_step else 0.0
+                print(
+                    f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
+                    f"({sps:.1f} samples/s)"
+                )
+                run.log({"loss": avg_loss, "epoch": epoch, "samples_per_sec": sps},
+                        step=global_step)
+            global_step += 1
+    save("vae-final")
+    if is_root:
+        run.log_artifact(args.output_path + "/vae-final", name="trained-vae")
+        run.finish()
+
+
+if __name__ == "__main__":
+    main()
