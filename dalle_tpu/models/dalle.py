@@ -64,6 +64,7 @@ class DALLEConfig:
     sparse_block: int = 16
     sparse_local_blocks: int = 4
     sparse_random_blocks: Optional[int] = None
+    use_flash: Optional[bool] = None  # None = auto (Pallas kernel on TPU)
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -108,6 +109,7 @@ class DALLEConfig:
             sparse_block=self.sparse_block,
             sparse_local_blocks=self.sparse_local_blocks,
             sparse_random_blocks=self.sparse_random_blocks,
+            use_flash=self.use_flash,
             dtype=self.dtype,
         )
 

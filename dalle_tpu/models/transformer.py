@@ -72,6 +72,9 @@ class TransformerConfig:
     sparse_block: int = 16
     sparse_local_blocks: int = 4
     sparse_random_blocks: Optional[int] = None
+    # Pallas flash kernel for full/sparse layers: None = auto (on for TPU),
+    # True/False force.  Dense-masked XLA attention is the fallback.
+    use_flash: Optional[bool] = None
     dtype: Any = jnp.float32
 
     @property
@@ -235,13 +238,34 @@ class JointAttention(nn.Module):
             out = attn_ops.conv_like_attention(
                 q, k, v, t, f, c.kernel_size, c.dilation, key_pad_mask
             )
-        elif self.attn_type == "sparse":
-            mask = jnp.asarray(_static_mask(c, "sparse"))
-            out = attn_ops.masked_attention(q, k, v, mask, key_pad_mask)
-        else:  # full
-            out = attn_ops.full_causal_attention(q, k, v, key_pad_mask)
+        elif self.attn_type in ("sparse", "full"):
+            out = self._full_or_sparse(q, k, v, key_pad_mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
         return self.drop(self.to_out(out), deterministic=deterministic)
+
+    def _full_or_sparse(self, q, k, v, key_pad_mask):
+        """Pallas flash path when eligible; dense-masked XLA fallback."""
+        import jax as _jax
+
+        from dalle_tpu.ops.flash import flash_attention, flash_plan
+
+        c = self.cfg
+        use_flash = (
+            c.use_flash
+            if c.use_flash is not None
+            else _jax.default_backend() == "tpu"
+        )
+        if use_flash and key_pad_mask is None:
+            if self.attn_type == "full":
+                return flash_attention(q, k, v)
+            plan = flash_plan(_static_mask(c, "sparse"))
+            if plan is not None:
+                layout, blk = plan
+                return flash_attention(q, k, v, layout=layout, block_q=blk, block_k=blk)
+        mask = jnp.asarray(_static_mask(c, self.attn_type))
+        if self.attn_type == "full":
+            return attn_ops.full_causal_attention(q, k, v, key_pad_mask)
+        return attn_ops.masked_attention(q, k, v, mask, key_pad_mask)
 
     def init_cache(self, batch: int) -> Cache:
         c = self.cfg

@@ -1,0 +1,388 @@
+"""Pallas flash attention with static block-sparse layouts.
+
+One kernel family serves two members of the attention zoo:
+  * ``full``   — causal flash attention (all lower-triangular blocks live);
+  * ``sparse`` — the DeepSpeed VariableSparsityConfig-equivalent
+    (reference: dalle_pytorch/attention.py:325-384): local + global-text +
+    random blocks, expressed as a static numpy block layout from
+    ops/masks.py.  The reference needs CUDA/Triton for this; here it is the
+    same online-softmax kernel with dead blocks predicated off.
+
+Design (SURVEY.md §7 "hard parts" #1):
+  * grid = (batch*heads, num_q_blocks); K/V stream block-by-block inside a
+    ``fori_loop`` with online softmax (m, l, acc) — the [n, n] score matrix
+    never touches HBM;
+  * the block layout rides in SMEM (tiny int32 table), so dead blocks cost
+    one predicated branch, not a DMA;
+  * within-block causality is reconstructed from ``broadcasted_iota`` —
+    the only elementwise mask ever needed (text-global and random blocks are
+    causal-clipped full blocks);
+  * backward = two kernels (dkv over key blocks, dq over query blocks)
+    recomputing p from the saved logsumexp — standard flash backward,
+    wrapped in ``jax.custom_vjp``.
+
+Falls back to interpreter mode off-TPU so the same tests pin it to the
+masked-dense oracle on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def pick_block(n: int, target: int = 128) -> int:
+    b = min(target, n)
+    while n % b:
+        b -= 1
+    return max(b, 1)
+
+
+def _layout_or_causal(layout, nqb, nkb):
+    if layout is None:
+        layout = np.tril(np.ones((nqb, nkb), dtype=bool))
+    assert layout.shape == (nqb, nkb)
+    return np.asarray(layout, dtype=np.bool_)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(lay_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, nkb, bq, bk, scale, causal):
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+
+    def body(kb, carry):
+        m, l, acc = carry
+
+        def attend(m, l, acc):
+            k_blk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+            v_blk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk]
+            if causal:
+                qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where(qi >= ki, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc_new = acc * corr + jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        return jax.lax.cond(
+            lay_ref[qb, kb] != 0, attend, lambda m, l, a: (m, l, a), m, l, acc
+        )
+
+    d = q_ref.shape[-1]
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, a0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _flash_fwd(q, k, v, layout, bq, bk, scale, causal):
+    bh, n, d = q.shape
+    nqb, nkb = n // bq, n // bk
+    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb), jnp.int32)
+    kernel = functools.partial(
+        _fwd_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nqb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(lay, q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    lay_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, nkb, bq, bk, scale, causal,
+):
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+
+    def body(kb, dq):
+        def attend(dq):
+            k_blk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+            v_blk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where(qi >= ki, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            return dq + jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        return jax.lax.cond(lay_ref[qb, kb] != 0, attend, lambda x: x, dq)
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    lay_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, nqb, bq, bk, scale, causal,
+):
+    kb = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v_blk = v_ref[0].astype(jnp.float32)
+
+    def body(qb, carry):
+        dk, dv = carry
+
+        def attend(dk, dv):
+            q = q_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32) * scale
+            do = do_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32)
+            lse = lse_ref[0, pl.ds(qb * bq, bq)][:, None]
+            delta = delta_ref[0, pl.ds(qb * bq, bq)][:, None]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where(qi >= ki, s, NEG_INF)
+            p = jnp.exp(s - lse)  # [bq, bk]
+            dv_new = dv + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            dk_new = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_new, dv_new
+
+        return jax.lax.cond(lay_ref[qb, kb] != 0, attend, lambda a, b: (a, b), dk, dv)
+
+    d = k_ref.shape[-1]
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, layout, bq, bk, scale, causal):
+    bh, n, d = q.shape
+    nqb, nkb = n // bq, n // bk
+    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb), jnp.int32)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh, n]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal
+        ),
+        grid=(bh, nqb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        interpret=_interpret(),
+    )(lay, q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, nqb=nqb, bq=bq, bk=bk, scale=scale, causal=causal
+        ),
+        grid=(bh, nkb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, n, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda b, j: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda b, j: (b, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(lay, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def _flash_core(q, k, v, layout_key, bq, bk, causal):
+    out, _ = _flash_fwd(q, k, v, _LAYOUTS.get(layout_key), bq, bk, q.shape[-1] ** -0.5, causal)
+    return out
+
+
+def _flash_core_fwd(q, k, v, layout_key, bq, bk, causal):
+    out, lse = _flash_fwd(
+        q, k, v, _LAYOUTS.get(layout_key), bq, bk, q.shape[-1] ** -0.5, causal
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(layout_key, bq, bk, causal, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, out, lse, g, _LAYOUTS.get(layout_key), bq, bk,
+        q.shape[-1] ** -0.5, causal,
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+# custom_vjp nondiff args must be hashable; numpy layouts are registered here
+_LAYOUTS: dict = {None: None}
+
+
+def _register_layout(layout: Optional[np.ndarray]):
+    if layout is None:
+        return None
+    key = (layout.shape, layout.tobytes())
+    _LAYOUTS[key] = layout
+    return key
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    layout: Optional[np.ndarray] = None,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """q, k, v: [b, h, n, d] → [b, h, n, d].
+
+    ``layout``: optional static numpy bool [n/block_q, n/block_k]; True
+    blocks participate (elementwise causality is applied on top).  None =
+    plain causal flash attention.
+    """
+    b, h, n, d = q.shape
+    bq = pick_block(n, block_q)
+    bk = pick_block(n, block_k)
+    if layout is not None:
+        assert layout.shape == (n // bq, n // bk), (
+            f"layout {layout.shape} != {(n // bq, n // bk)}"
+        )
+    key = _register_layout(layout)
+    fold = lambda x: x.reshape(b * h, n, d)
+    out = _flash_core(fold(q), fold(k), fold(v), key, bq, bk, causal)
+    return out.reshape(b, h, n, d)
+
+
+def block_layout_from_mask(mask: np.ndarray, bq: int, bk: int) -> np.ndarray:
+    """Compress an elementwise [n, n] mask to its live-block layout.
+
+    Valid when within-block structure is pure causality (true for 'full' and
+    'sparse' zoo members); assert-checked by tests against the dense oracle.
+    """
+    n = mask.shape[0]
+    nqb, nkb = n // bq, n // bk
+    blocks = mask.reshape(nqb, bq, nkb, bk)
+    return blocks.any(axis=(1, 3))
+
+
+def flash_plan(mask: np.ndarray, prefer: int = 128):
+    """Find the largest flash block size whose (layout ⊗ causal)
+    reconstruction equals ``mask`` exactly.  Returns (layout, block) or None
+    (→ caller falls back to dense-masked attention).  This is the safety
+    valve that keeps the kernel semantics-identical to the mask builders."""
+    n = mask.shape[0]
+    i = np.arange(n)
+    causal = i[None, :] <= i[:, None]
+    b = pick_block(n, prefer)
+    while b >= 8:
+        if n % b == 0:
+            layout = block_layout_from_mask(mask, b, b)
+            recon = np.kron(layout, np.ones((b, b), bool)) & causal
+            if (recon == mask).all():
+                return layout, b
+        nb = b - 1
+        while nb >= 8 and n % nb:
+            nb -= 1
+        b = nb
+    return None
