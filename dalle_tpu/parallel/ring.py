@@ -1,0 +1,107 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference has NO sequence parallelism — its only long-sequence levers
+are cheaper attention patterns and reversible layers (SURVEY.md §5.7).  This
+module adds the real thing, TPU-native: the joint sequence is sharded over
+the ``sp`` axis; each device holds a K/V chunk that rotates around the ring
+with ``jax.lax.ppermute`` (one ICI hop per step, overlapped by XLA with the
+local attention compute), while online-softmax statistics (m, l, acc)
+accumulate locally — attention over an n-token sequence with n/P tokens and
+O(n/P) K/V memory per device.
+
+Causality with a ring: at rotation step s, device i holds the K/V chunk
+originating from device ``(i - s) mod P``.  The elementwise mask is derived
+from *global* positions, so the first step (own chunk, diagonal) is the
+causal triangle and later steps degenerate to all-or-nothing — no special
+cases, and the fully-masked blocks cost one wasted matmul (acceptable at
+P ≤ 8; a skip/bidirectional schedule is a later optimization).
+
+Used under ``shard_map`` (manual-collectives region) inside the jitted train
+step; see ``ring_attention_sharded`` for the spec-wiring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Local view: q, k, v [b, h, n_local, d], sequence sharded over
+    ``axis_name``.  Returns the local output chunk [b, h, n_local, d]."""
+    p_size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, nl, d = q.shape
+    scale = d**-0.5
+    qf = q.astype(jnp.float32) * scale
+
+    qpos = idx * nl + jnp.arange(nl)  # global positions of my queries
+
+    def step(carry, s):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - s) % p_size  # owner of the chunk I currently hold
+        kpos = src * nl + jnp.arange(nl)
+        sblk = jnp.einsum(
+            "bhid,bhjd->bhij", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            sblk = jnp.where(mask[None, None], sblk, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=-1, keepdims=True))
+        pblk = jnp.exp(sblk - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(pblk, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhij,bhjd->bhid", pblk, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # rotate K/V to the next device (ring over ICI)
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, nl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, nl, 1), jnp.float32)
+    a0 = jnp.zeros((b, h, nl, d), jnp.float32)
+    (k, v, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, a0), jnp.arange(p_size)
+    )
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    sp_axis: str = "sp",
+    causal: bool = True,
+    mesh=None,
+):
+    """Global view: q, k, v [b, h, n, d] under jit with an (ambient) mesh.
+
+    Wraps ``ring_attention`` in shard_map: batch over (dp, fsdp), heads over
+    tp, sequence over ``sp_axis``.  Call within ``jax.set_mesh`` or
+    pass ``mesh`` explicitly.
+    """
+    spec = P(("dp", "fsdp"), "tp", sp_axis, None)
+    fn = functools.partial(ring_attention, axis_name=sp_axis, causal=causal)
+    kwargs = dict(in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    if mesh is not None:
+        kwargs["mesh"] = mesh
+    return jax.shard_map(fn, **kwargs)(q, k, v)
