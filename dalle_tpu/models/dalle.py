@@ -65,6 +65,7 @@ class DALLEConfig:
     sparse_local_blocks: int = 4
     sparse_random_blocks: Optional[int] = None
     use_flash: Optional[bool] = None  # None = auto (Pallas kernel on TPU)
+    sp_axis: Optional[str] = None  # ring-attention sequence parallelism
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -110,6 +111,7 @@ class DALLEConfig:
             sparse_local_blocks=self.sparse_local_blocks,
             sparse_random_blocks=self.sparse_random_blocks,
             use_flash=self.use_flash,
+            sp_axis=self.sp_axis,
             dtype=self.dtype,
         )
 

@@ -75,6 +75,9 @@ class TransformerConfig:
     # Pallas flash kernel for full/sparse layers: None = auto (on for TPU),
     # True/False force.  Dense-masked XLA attention is the fallback.
     use_flash: Optional[bool] = None
+    # sequence parallelism: mesh axis name for ring attention on 'full'
+    # layers (requires an ambient mesh via jax.set_mesh); None = off
+    sp_axis: Optional[str] = None
     dtype: Any = jnp.float32
 
     @property
@@ -250,6 +253,10 @@ class JointAttention(nn.Module):
         from dalle_tpu.ops.flash import flash_attention, flash_plan
 
         c = self.cfg
+        if c.sp_axis is not None and self.attn_type == "full" and key_pad_mask is None:
+            from dalle_tpu.parallel.ring import ring_attention_sharded
+
+            return ring_attention_sharded(q, k, v, sp_axis=c.sp_axis, causal=True)
         use_flash = (
             c.use_flash
             if c.use_flash is not None

@@ -59,6 +59,29 @@ def make_mesh(
     return Mesh(dev_array, AXES)
 
 
+_AMBIENT: list = [None]
+
+
+class ambient:
+    """Context manager recording the mesh for trace-time consumers (ring
+    attention's shard_map region) — jax.set_mesh's thread-local context does
+    not survive into jit tracing, so we carry our own."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _AMBIENT.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _AMBIENT.pop()
+
+
+def get_ambient_mesh() -> Optional[Mesh]:
+    return _AMBIENT[-1]
+
+
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     dev = device if device is not None else jax.devices()[0]
     return Mesh(np.asarray([dev]).reshape(1, 1, 1, 1), AXES)

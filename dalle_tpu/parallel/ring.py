@@ -99,9 +99,16 @@ def ring_attention_sharded(
     tp, sequence over ``sp_axis``.  Call within ``jax.set_mesh`` or
     pass ``mesh`` explicitly.
     """
+    if mesh is None:
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        mesh = get_ambient_mesh()
+    assert mesh is not None, (
+        "ring attention needs a mesh: pass mesh= or run the step under "
+        "dalle_tpu.parallel.mesh.ambient(mesh) (train_lib does this)"
+    )
     spec = P(("dp", "fsdp"), "tp", sp_axis, None)
     fn = functools.partial(ring_attention, axis_name=sp_axis, causal=causal)
-    kwargs = dict(in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
-    if mesh is not None:
-        kwargs["mesh"] = mesh
-    return jax.shard_map(fn, **kwargs)(q, k, v)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

@@ -76,7 +76,10 @@ def get_learning_rate(opt_state) -> float:
 def init_train_state(model, tx, mesh, init_rng, *example_args, **example_kw):
     """Init params on host, shard onto the mesh, init opt state (inherits
     sharding via zeros_like).  Returns (params, opt_state)."""
-    params = model.init(init_rng, *example_args, **example_kw)["params"]
+    from dalle_tpu.parallel.mesh import ambient
+
+    with ambient(mesh):
+        params = model.init(init_rng, *example_args, **example_kw)["params"]
     params = shard_params(params, mesh)
     # Adam moments carry the param path as a suffix, so the same partition
     # rules shard them identically (ZeRO-equivalent optimizer sharding).
@@ -132,7 +135,12 @@ def make_dalle_train_step(
     def wrapped(params, opt_state, vae_params, text, images, key):
         text = jax.device_put(text, bspec)
         images = jax.device_put(images, bspec)
-        return jstep(params, opt_state, vae_params, text, images, key)
+        # ambient mesh so ring attention's shard_map region resolves its
+        # mesh during tracing
+        from dalle_tpu.parallel.mesh import ambient
+
+        with ambient(mesh):
+            return jstep(params, opt_state, vae_params, text, images, key)
 
     return wrapped
 

@@ -24,10 +24,9 @@ def test_ring_matches_full_causal(rng, devices, sp):
     mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=sp)
     q, k, v = qkv(rng)
     want = A.full_causal_attention(q, k, v)
-    with jax.set_mesh(mesh):
-        got = jax.jit(
-            lambda q, k, v: ring_attention_sharded(q, k, v, causal=True)
-        )(q, k, v)
+    got = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, causal=True, mesh=mesh)
+    )(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
@@ -35,10 +34,9 @@ def test_ring_non_causal(rng, devices):
     mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
     q, k, v = qkv(rng)
     want = A._sdpa(q, k, v, None)
-    with jax.set_mesh(mesh):
-        got = jax.jit(
-            lambda q, k, v: ring_attention_sharded(q, k, v, causal=False)
-        )(q, k, v)
+    got = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, causal=False, mesh=mesh)
+    )(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
@@ -47,10 +45,9 @@ def test_ring_with_tp_and_dp(rng, devices):
     mesh = make_mesh(dp=2, fsdp=1, tp=2, sp=2)
     q, k, v = qkv(rng)
     want = A.full_causal_attention(q, k, v)
-    with jax.set_mesh(mesh):
-        got = jax.jit(
-            lambda q, k, v: ring_attention_sharded(q, k, v, causal=True)
-        )(q, k, v)
+    got = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, causal=True, mesh=mesh)
+    )(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
