@@ -469,12 +469,58 @@ class Transformer(nn.Module):
     def _reversible_forward(self, x, key_pad_mask, deterministic):
         """RevNet coupling (reference: reversible.py:143-157): duplicate the
         stream, y1 = x1 + f(x2), y2 = x2 + g(y1), output mean of streams.
-        Memory savings come from remat (use_remat), not a custom autograd."""
-        x1, x2 = x, x
+
+        During init (and under remat) this runs the plain coupled loop; in
+        apply it routes through ``ops.reversible.reversible_chain`` — the
+        O(1)-activation custom VJP that inverts the coupling in backward
+        (the reference's autograd.Function, reference: reversible.py:108-124).
+        """
+        import flax.core as _core
+
+        bound = self.scope is not None and not self.is_initializing()
+        # key_pad_mask would be captured as a tracer inside the custom-vjp
+        # closures (disallowed); that path takes the plain coupled loop
+        if not bound or self.cfg.use_remat or key_pad_mask is not None:
+            x1, x2 = x, x
+            for attn, ff in self.pairs:
+                x1 = x1 + attn(x2, key_pad_mask=key_pad_mask, deterministic=deterministic)
+                x2 = x2 + ff(x1, deterministic=deterministic)
+            return (x1 + x2) / 2
+
+        from dalle_tpu.ops.reversible import reversible_sequence
+
+        need_drop = (not deterministic) and (
+            self.cfg.attn_dropout > 0 or self.cfg.ff_dropout > 0
+        )
+        fs, gs, params = [], [], []
         for attn, ff in self.pairs:
-            x1 = x1 + attn(x2, key_pad_mask=key_pad_mask, deterministic=deterministic)
-            x2 = x2 + ff(x1, deterministic=deterministic)
-        return (x1 + x2) / 2
+            attn_params = _core.freeze(attn.variables["params"])
+            ff_params = _core.freeze(ff.variables["params"])
+            # explicit keys ride inside the (differentiable) pytree so the
+            # custom-vjp closures stay tracer-free; recompute-replay is exact
+            # by construction (the reference needs RNG state capture,
+            # reversible.py:20-50)
+            ka = self.make_rng("dropout") if need_drop else None
+            kf = self.make_rng("dropout") if need_drop else None
+
+            def f(pk, y, _m=attn):
+                p, k = pk
+                rngs = {"dropout": k} if k is not None else None
+                return _m.clone().apply(
+                    {"params": p}, y, deterministic=deterministic, rngs=rngs
+                )
+
+            def g(pk, y, _m=ff):
+                p, k = pk
+                rngs = {"dropout": k} if k is not None else None
+                return _m.clone().apply(
+                    {"params": p}, y, deterministic=deterministic, rngs=rngs
+                )
+
+            fs.append(f)
+            gs.append(g)
+            params.append(((attn_params, ka), (ff_params, kf)))
+        return reversible_sequence(fs, gs, params, x)
 
     def init_cache(self, batch: int) -> Cache:
         return {

@@ -1,0 +1,91 @@
+"""True reversible (RevNet) execution with O(1) activation memory.
+
+The reference implements this as a torch ``autograd.Function`` that stores
+only the final activation and reconstructs each block's inputs by inverting the
+coupling during backward (reference: dalle_pytorch/reversible.py:53-124),
+with explicit RNG state capture for dropout replay (reversible.py:20-50).
+
+JAX re-design: one ``jax.custom_vjp`` over the WHOLE chain —
+  forward:   y1 = x1 + f_i(x2); y2 = x2 + g_i(y1)   for each block i
+  residuals: (per-block params, final y1, y2) — nothing else
+  backward:  walk blocks in reverse; invert (x2 = y2 - g(y1),
+             x1 = y1 - f(x2)) and pull gradients through ``jax.vjp`` of each
+             recomputed sublayer.  Activation memory is O(1) in depth;
+             compute is ~2× backward, same trade as the reference
+             (reference README claim, BASELINE.md "reversible cost model").
+
+Dropout replay needs no RNG machinery: the sublayer closures take explicit
+PRNG keys, so recomputation is bit-identical by construction.
+
+``jax.checkpoint`` (the ``use_remat`` flag) remains the *idiomatic* memory
+lever (SURVEY.md §7 stage 7 recommends it first); this module is the parity
+implementation for exact reversible semantics at extreme depth.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# f/g signature: (params, x) -> y, pure.
+SubFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
+
+
+def _run_forward(fs, gs, params, x1, x2):
+    for i, (f, g) in enumerate(zip(fs, gs)):
+        fp, gp = params[i]
+        x1 = x1 + f(fp, x2)
+        x2 = x2 + g(gp, x1)
+    return x1, x2
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def reversible_chain(fs: Tuple[SubFn, ...], gs: Tuple[SubFn, ...], params, x1, x2):
+    """params: tuple of (f_params, g_params) per block."""
+    return _run_forward(fs, gs, params, x1, x2)
+
+
+def _chain_fwd(fs, gs, params, x1, x2):
+    y1, y2 = _run_forward(fs, gs, params, x1, x2)
+    return (y1, y2), (params, y1, y2)
+
+
+def _chain_bwd(fs, gs, res, grads):
+    params, y1, y2 = res
+    dy1, dy2 = grads
+    dparams = []
+    for i in reversed(range(len(fs))):
+        f, g = fs[i], gs[i]
+        fp, gp = params[i]
+        # invert g: x2_pre = y2 - g(y1); gradients through the recomputation
+        g_out, g_vjp = jax.vjp(g, gp, y1)
+        x2 = y2 - g_out
+        dgp, dy1_from_g = g_vjp(dy2)
+        dy1 = dy1 + dy1_from_g
+        # invert f: x1_pre = y1 - f(x2)
+        f_out, f_vjp = jax.vjp(f, fp, x2)
+        x1 = y1 - f_out
+        dfp, dx2_from_f = f_vjp(dy1)
+        dy2 = dy2 + dx2_from_f
+        dparams.append((dfp, dgp))
+        y1, y2 = x1, x2
+    return tuple(reversed(dparams)), dy1, dy2
+
+
+reversible_chain.defvjp(_chain_fwd, _chain_bwd)
+
+
+def reversible_sequence(
+    fs: Sequence[SubFn],
+    gs: Sequence[SubFn],
+    params: Sequence[Tuple[Any, Any]],
+    x: jnp.ndarray,
+) -> jnp.ndarray:
+    """Duplicate-stream wrapper matching the reference's interface: split the
+    stream, run the coupled chain, merge by mean
+    (reference: reversible.py:143-157)."""
+    y1, y2 = reversible_chain(tuple(fs), tuple(gs), tuple(params), x, x)
+    return (y1 + y2) / 2

@@ -1,0 +1,114 @@
+"""True-reversible custom VJP: value + gradient parity with the plain
+coupled loop, standalone and inside DALLE."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.ops.reversible import reversible_chain, reversible_sequence
+
+T, F = 4, 2
+N_IMG = F * F
+
+
+def test_chain_matches_plain_loop(rng):
+    depth, dim = 3, 8
+    ks = jax.random.split(rng, 2 * depth + 1)
+    params = tuple(
+        (
+            {"w": jax.random.normal(ks[2 * i], (dim, dim)) * 0.1},
+            {"w": jax.random.normal(ks[2 * i + 1], (dim, dim)) * 0.1},
+        )
+        for i in range(depth)
+    )
+    fs = tuple((lambda p, x: jnp.tanh(x @ p["w"]),) * depth)
+    gs = tuple((lambda p, x: jnp.sin(x @ p["w"]),) * depth)
+    x = jax.random.normal(ks[-1], (2, dim))
+
+    def plain(params, x):
+        x1, x2 = x, x
+        for i in range(depth):
+            x1 = x1 + fs[i](params[i][0], x2)
+            x2 = x2 + gs[i](params[i][1], x1)
+        return (x1 + x2) / 2
+
+    def rev(params, x):
+        return reversible_sequence(fs, gs, params, x)
+
+    np.testing.assert_allclose(
+        np.asarray(rev(params, x)), np.asarray(plain(params, x)), atol=1e-6
+    )
+
+    def loss_of(fn):
+        return lambda p: jnp.sum(fn(p, x) ** 2)
+
+    g_rev = jax.grad(loss_of(rev))(params)
+    g_plain = jax.grad(loss_of(plain))(params)
+    for gr, gp in zip(jax.tree_util.tree_leaves(g_rev), jax.tree_util.tree_leaves(g_plain)):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gp), atol=1e-5)
+
+
+def _dalle(rng, **kw):
+    cfg = DALLEConfig(
+        num_text_tokens=30, text_seq_len=T, num_image_tokens=20,
+        image_fmap_size=F, dim=32, depth=3, heads=2, dim_head=16,
+        reversible=True, **kw,
+    )
+    text = jax.random.randint(rng, (2, T), 0, 30)
+    codes = jax.random.randint(rng, (2, N_IMG), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    return model, params, text, codes
+
+
+def test_dalle_reversible_custom_vjp_matches_remat_path(rng):
+    """Same params: the custom-vjp reversible path and the plain coupled
+    loop (use_remat short-circuit) agree in loss and gradients."""
+    import dataclasses
+
+    model_rev, params, text, codes = _dalle(rng)
+    model_plain = DALLE(dataclasses.replace(model_rev.cfg, use_remat=True))
+
+    def loss(m, p):
+        return m.apply({"params": p}, text, codes, return_loss=True)
+
+    l_rev = float(loss(model_rev, params))
+    l_plain = float(loss(model_plain, params))
+    np.testing.assert_allclose(l_rev, l_plain, rtol=1e-6)
+
+    g_rev = jax.grad(lambda p: loss(model_rev, p))(params)
+    g_plain = jax.grad(lambda p: loss(model_plain, p))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_rev), jax.tree_util.tree_leaves(g_plain)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_dalle_reversible_with_dropout_trains(rng):
+    model, params, text, codes = _dalle(rng, attn_dropout=0.1, ff_dropout=0.1)
+
+    def loss(p):
+        return model.apply(
+            {"params": p}, text, codes, return_loss=True,
+            deterministic=False, rngs={"dropout": jax.random.fold_in(rng, 1)},
+        )
+
+    l, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(l))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in leaves)
+    assert any(float(jnp.abs(g).max()) > 0 for g in leaves)
+    # determinism: same rng → same loss (exact dropout replay)
+    np.testing.assert_allclose(float(loss(params)), float(l))
+
+
+def test_dalle_reversible_under_jit_and_grad(rng):
+    model, params, text, codes = _dalle(rng)
+
+    @jax.jit
+    def step(p):
+        return jax.value_and_grad(
+            lambda p: model.apply({"params": p}, text, codes, return_loss=True)
+        )(p)
+
+    l, g = step(params)
+    assert np.isfinite(float(l))
