@@ -1,0 +1,178 @@
+"""torch-checkpoint → Flax param conversion (no torch needed at run time;
+torch-cpu is used only at load time to unpickle).
+
+The reference consumes pretrained torch artifacts directly — OpenAI dVAE
+pickles and taming VQGAN checkpoints (reference: dalle_pytorch/vae.py:103-133,
+150-220).  Our TPU models are Flax/NHWC, so weights are converted once:
+
+  * Conv2d  OIHW → HWIO transpose
+  * Linear  [out, in] → [in, out]
+  * GroupNorm/LayerNorm weight/bias → scale/bias
+  * Embedding unchanged
+
+Two strategies:
+  * ``convert_named`` — regex rules translating checkpoint key names to flax
+    tree paths (used for taming VQGAN, whose naming is stable public API);
+  * ``convert_by_order`` — zip checkpoint tensors with flax leaves in
+    traversal order under exact-shape checking (used for the OpenAI dVAE
+    pickles, whose pickled module layout matches our module order).
+
+Both fail loudly on unconsumed/unfilled leaves — a wrong mapping can't load
+silently.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def fit_tensor(src: np.ndarray, target_shape: Tuple[int, ...]) -> np.ndarray:
+    """Transform a torch tensor to a flax leaf shape (transpose conventions)."""
+    src = np.asarray(src)
+    if src.shape == tuple(target_shape):
+        return src
+    if src.ndim == 4 and tuple(src.transpose(2, 3, 1, 0).shape) == tuple(target_shape):
+        return src.transpose(2, 3, 1, 0)  # OIHW → HWIO
+    if src.ndim == 2 and tuple(src.T.shape) == tuple(target_shape):
+        return src.T  # linear [out,in] → [in,out]
+    if src.ndim == 1 and tuple(src.reshape(target_shape).shape) == tuple(target_shape):
+        return src.reshape(target_shape)
+    raise ValueError(f"cannot fit tensor {src.shape} into {target_shape}")
+
+
+def _flat_leaves(params) -> List[Tuple[str, np.ndarray]]:
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(str(getattr(p, "key", p)) for p in path)
+        out.append((key, leaf))
+    return out
+
+
+def convert_by_order(template, tensors: Sequence[np.ndarray]):
+    """Fill `template` leaves (in traversal order) from `tensors` (in
+    checkpoint order), shape-fitting each.  Exact-consumption checked."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    assert len(tensors) == len(leaves), (
+        f"tensor count mismatch: ckpt {len(tensors)} vs model {len(leaves)}"
+    )
+    filled = [
+        fit_tensor(_to_np(t), leaf.shape).astype(np.float32)
+        for t, leaf in zip(tensors, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, filled)
+
+
+def convert_named(
+    template,
+    state_dict: Dict[str, "np.ndarray"],
+    rules: Sequence[Tuple[str, str]],
+    *,
+    ignore: Sequence[str] = (),
+):
+    """Translate checkpoint keys via regex ``rules`` [(pattern, repl)] into
+    flax paths ('a/b/c'), then fill the template.  Unmatched checkpoint keys
+    (except ``ignore`` patterns) and unfilled leaves raise."""
+    flat = dict(_flat_leaves(template))
+    out: Dict[str, np.ndarray] = {}
+    unmatched = []
+    for key, tensor in state_dict.items():
+        if any(re.fullmatch(p, key) for p in ignore):
+            continue
+        for pat, repl in rules:
+            m = re.fullmatch(pat, key)
+            if m:
+                path = m.expand(repl)
+                assert path in flat, f"{key} → {path} not in model"
+                out[path] = fit_tensor(_to_np(tensor), flat[path].shape).astype(
+                    np.float32
+                )
+                break
+        else:
+            unmatched.append(key)
+    if unmatched:
+        raise ValueError(f"unmatched checkpoint keys: {unmatched[:10]}...")
+    missing = sorted(set(flat) - set(out))
+    if missing:
+        raise ValueError(f"model leaves not filled: {missing[:10]}...")
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
+    filled = []
+    for path, leaf in leaves_with_path:
+        key = "/".join(str(getattr(p, "key", p)) for p in path)
+        filled.append(out[key])
+    return jax.tree_util.tree_unflatten(treedef, filled)
+
+
+# --- taming VQGAN key rules (public naming, stable across releases) --------
+
+_VQGAN_COMMON = [
+    # encoder/decoder stems + heads
+    (r"(encoder|decoder)\.conv_in\.weight", r"\1/conv_in/kernel"),
+    (r"(encoder|decoder)\.conv_in\.bias", r"\1/conv_in/bias"),
+    (r"(encoder|decoder)\.conv_out\.weight", r"\1/conv_out/kernel"),
+    (r"(encoder|decoder)\.conv_out\.bias", r"\1/conv_out/bias"),
+    (r"(encoder|decoder)\.norm_out\.weight", r"\1/norm_out/scale"),
+    (r"(encoder|decoder)\.norm_out\.bias", r"\1/norm_out/bias"),
+    # mid blocks
+    (r"(encoder|decoder)\.mid\.block_(\d)\.norm(\d)\.weight", r"\1/mid_block_\2/norm\3/scale"),
+    (r"(encoder|decoder)\.mid\.block_(\d)\.norm(\d)\.bias", r"\1/mid_block_\2/norm\3/bias"),
+    (r"(encoder|decoder)\.mid\.block_(\d)\.conv(\d)\.weight", r"\1/mid_block_\2/conv\3/kernel"),
+    (r"(encoder|decoder)\.mid\.block_(\d)\.conv(\d)\.bias", r"\1/mid_block_\2/conv\3/bias"),
+    (r"(encoder|decoder)\.mid\.block_(\d)\.nin_shortcut\.weight", r"\1/mid_block_\2/nin_shortcut/kernel"),
+    (r"(encoder|decoder)\.mid\.block_(\d)\.nin_shortcut\.bias", r"\1/mid_block_\2/nin_shortcut/bias"),
+    (r"(encoder|decoder)\.mid\.attn_1\.norm\.weight", r"\1/mid_attn_1/norm/scale"),
+    (r"(encoder|decoder)\.mid\.attn_1\.norm\.bias", r"\1/mid_attn_1/norm/bias"),
+    (r"(encoder|decoder)\.mid\.attn_1\.(q|k|v|proj_out)\.weight", r"\1/mid_attn_1/\2/kernel"),
+    (r"(encoder|decoder)\.mid\.attn_1\.(q|k|v|proj_out)\.bias", r"\1/mid_attn_1/\2/bias"),
+    # encoder down path
+    (r"encoder\.down\.(\d+)\.block\.(\d+)\.norm(\d)\.weight", r"encoder/down_\1_block_\2/norm\3/scale"),
+    (r"encoder\.down\.(\d+)\.block\.(\d+)\.norm(\d)\.bias", r"encoder/down_\1_block_\2/norm\3/bias"),
+    (r"encoder\.down\.(\d+)\.block\.(\d+)\.conv(\d)\.weight", r"encoder/down_\1_block_\2/conv\3/kernel"),
+    (r"encoder\.down\.(\d+)\.block\.(\d+)\.conv(\d)\.bias", r"encoder/down_\1_block_\2/conv\3/bias"),
+    (r"encoder\.down\.(\d+)\.block\.(\d+)\.nin_shortcut\.weight", r"encoder/down_\1_block_\2/nin_shortcut/kernel"),
+    (r"encoder\.down\.(\d+)\.block\.(\d+)\.nin_shortcut\.bias", r"encoder/down_\1_block_\2/nin_shortcut/bias"),
+    (r"encoder\.down\.(\d+)\.attn\.(\d+)\.norm\.weight", r"encoder/down_\1_attn_\2/norm/scale"),
+    (r"encoder\.down\.(\d+)\.attn\.(\d+)\.norm\.bias", r"encoder/down_\1_attn_\2/norm/bias"),
+    (r"encoder\.down\.(\d+)\.attn\.(\d+)\.(q|k|v|proj_out)\.weight", r"encoder/down_\1_attn_\2/\3/kernel"),
+    (r"encoder\.down\.(\d+)\.attn\.(\d+)\.(q|k|v|proj_out)\.bias", r"encoder/down_\1_attn_\2/\3/bias"),
+    (r"encoder\.down\.(\d+)\.downsample\.conv\.weight", r"encoder/down_\1_downsample/kernel"),
+    (r"encoder\.down\.(\d+)\.downsample\.conv\.bias", r"encoder/down_\1_downsample/bias"),
+    # decoder up path
+    (r"decoder\.up\.(\d+)\.block\.(\d+)\.norm(\d)\.weight", r"decoder/up_\1_block_\2/norm\3/scale"),
+    (r"decoder\.up\.(\d+)\.block\.(\d+)\.norm(\d)\.bias", r"decoder/up_\1_block_\2/norm\3/bias"),
+    (r"decoder\.up\.(\d+)\.block\.(\d+)\.conv(\d)\.weight", r"decoder/up_\1_block_\2/conv\3/kernel"),
+    (r"decoder\.up\.(\d+)\.block\.(\d+)\.conv(\d)\.bias", r"decoder/up_\1_block_\2/conv\3/bias"),
+    (r"decoder\.up\.(\d+)\.block\.(\d+)\.nin_shortcut\.weight", r"decoder/up_\1_block_\2/nin_shortcut/kernel"),
+    (r"decoder\.up\.(\d+)\.block\.(\d+)\.nin_shortcut\.bias", r"decoder/up_\1_block_\2/nin_shortcut/bias"),
+    (r"decoder\.up\.(\d+)\.attn\.(\d+)\.norm\.weight", r"decoder/up_\1_attn_\2/norm/scale"),
+    (r"decoder\.up\.(\d+)\.attn\.(\d+)\.norm\.bias", r"decoder/up_\1_attn_\2/norm/bias"),
+    (r"decoder\.up\.(\d+)\.attn\.(\d+)\.(q|k|v|proj_out)\.weight", r"decoder/up_\1_attn_\2/\3/kernel"),
+    (r"decoder\.up\.(\d+)\.attn\.(\d+)\.(q|k|v|proj_out)\.bias", r"decoder/up_\1_attn_\2/\3/bias"),
+    (r"decoder\.up\.(\d+)\.upsample\.conv\.weight", r"decoder/up_\1_upsample/kernel"),
+    (r"decoder\.up\.(\d+)\.upsample\.conv\.bias", r"decoder/up_\1_upsample/bias"),
+    # quantizer
+    (r"quantize\.embedding\.weight", r"codebook/embedding"),
+    (r"quantize\.embed\.weight", r"codebook/embedding"),  # GumbelVQ
+    (r"quant_conv\.weight", r"quant_conv/kernel"),
+    (r"quant_conv\.bias", r"quant_conv/bias"),
+    (r"post_quant_conv\.weight", r"post_quant_conv/kernel"),
+    (r"post_quant_conv\.bias", r"post_quant_conv/bias"),
+]
+
+# taming checkpoints carry the GAN discriminator + perceptual-loss nets; the
+# reference likewise ignores them (only the VQModel weights are used)
+VQGAN_IGNORE = (r"loss\..*", r".*discriminator.*", r".*perceptual.*")
+
+
+def vqgan_rules():
+    return list(_VQGAN_COMMON)

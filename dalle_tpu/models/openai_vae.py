@@ -1,0 +1,116 @@
+"""OpenAI discrete VAE (dVAE) architecture in Flax.
+
+Re-implementation of the released OpenAI DALL-E encoder/decoder that the
+reference loads as pickled torch modules via the external ``DALL-E`` package
+(reference: dalle_pytorch/vae.py:29-30,103-133).  Fixed geometry: 3 conv
+groups of stride (pool) 2 → fmap = image_size/8, vocab 8192, 256 px
+(reference: vae.py:111-113).
+
+Architecture (public openai/DALL-E encoder.py/decoder.py semantics):
+  * bottleneck residual blocks ``id + post_gain * (relu-conv3 ×3, relu-conv1)``
+    with hidden = out/4 and post_gain = 1/n_layers²;
+  * encoder: conv7 → 4 groups (2 blocks each, maxpool after groups 1-3) →
+    relu + conv1 → 8192 logits;
+  * decoder: conv1 from one-hot codes → 4 groups (upsample ×2 before groups
+    2-4) → relu + conv1 → 6 channels (first 3 are the image, sigmoid);
+  * pixels are squashed into [ε, 1-ε] by ``map_pixels`` (ε = 0.1) before
+    encoding and unsquashed after decoding (reference: vae.py:39-48).
+
+NHWC layout; weights convert from the torch pickles via
+:mod:`dalle_tpu.models.convert`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+LOGIT_LAPLACE_EPS = 0.1  # (reference: vae.py:44)
+
+
+def map_pixels(x: jnp.ndarray) -> jnp.ndarray:
+    """[0,1] → [ε, 1-ε] (reference: vae.py:47-48)."""
+    return (1 - 2 * LOGIT_LAPLACE_EPS) * x + LOGIT_LAPLACE_EPS
+
+
+def unmap_pixels(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip((x - LOGIT_LAPLACE_EPS) / (1 - 2 * LOGIT_LAPLACE_EPS), 0, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class OpenAIVAEConfig:
+    group_count: int = 4
+    n_hid: int = 256
+    n_blk_per_group: int = 2
+    input_channels: int = 3
+    vocab_size: int = 8192
+    n_init: int = 128  # decoder stem width
+
+    @property
+    def n_layers(self) -> int:
+        return self.group_count * self.n_blk_per_group
+
+
+class _Block(nn.Module):
+    """Bottleneck residual block (encoder and decoder share the shape)."""
+
+    n_out: int
+    post_gain: float
+
+    @nn.compact
+    def __call__(self, x):
+        hid = self.n_out // 4
+        idp = (
+            x
+            if x.shape[-1] == self.n_out
+            else nn.Conv(self.n_out, (1, 1), name="id_conv")(x)
+        )
+        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_0")(jax.nn.relu(x))
+        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_1")(jax.nn.relu(h))
+        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_2")(jax.nn.relu(h))
+        h = nn.Conv(self.n_out, (1, 1), name="conv_3")(jax.nn.relu(h))
+        return idp + self.post_gain * h
+
+
+class OpenAIEncoder(nn.Module):
+    cfg: OpenAIVAEConfig = OpenAIVAEConfig()
+
+    @nn.compact
+    def __call__(self, x):
+        """x: [b, H, W, 3] in [0,1] → logits [b, H/8, W/8, vocab]."""
+        c = self.cfg
+        pg = 1.0 / c.n_layers**2
+        h = nn.Conv(c.n_hid, (7, 7), padding="SAME", name="input_conv")(x)
+        widths = [1, 2, 4, 8]
+        for g, w in enumerate(widths):
+            for b in range(c.n_blk_per_group):
+                h = _Block(w * c.n_hid, pg, name=f"group_{g+1}_blk_{b+1}")(h)
+            if g < c.group_count - 1:
+                h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = nn.Conv(c.vocab_size, (1, 1), name="output_conv")(jax.nn.relu(h))
+        return h
+
+
+class OpenAIDecoder(nn.Module):
+    cfg: OpenAIVAEConfig = OpenAIVAEConfig()
+
+    @nn.compact
+    def __call__(self, z):
+        """z: one-hot (or relaxed) codes [b, f, f, vocab] → [b, 8f, 8f, 3]."""
+        c = self.cfg
+        pg = 1.0 / c.n_layers**2
+        h = nn.Conv(c.n_init, (1, 1), name="input_conv")(z)
+        widths = [8, 4, 2, 1]
+        for g, w in enumerate(widths):
+            for b in range(c.n_blk_per_group):
+                h = _Block(w * c.n_hid, pg, name=f"group_{g+1}_blk_{b+1}")(h)
+            if g < c.group_count - 1:
+                bsz, hh, ww, ch = h.shape
+                h = jax.image.resize(h, (bsz, hh * 2, ww * 2, ch), "nearest")
+        h = nn.Conv(2 * c.input_channels, (1, 1), name="output_conv")(
+            jax.nn.relu(h)
+        )
+        return h

@@ -1,32 +1,195 @@
 """Pretrained VAE wrappers: OpenAI discrete VAE and taming VQGAN.
 
-The reference wraps externally-released torch checkpoints
-(reference: dalle_pytorch/vae.py:103-133 OpenAIDiscreteVAE, :150-220
-VQGanVAE) downloaded with rank-0 coordination (reference: vae.py:53-94).
-Here the architectures are re-implemented in Flax and weights are converted
-from the torch pickles when present on disk (zero-egress environments can't
-download; pass ``ckpt_path``).  Until the converters land (build plan §7
-stage 8) these raise a clear error on use; the in-tree DiscreteVAE covers
-training end-to-end.
+Capability parity with the reference wrappers (reference:
+dalle_pytorch/vae.py): rank-0-downloads-then-barrier cache coordination
+(vae.py:53-94), OpenAI dVAE encode/decode with pixel (un)mapping
+(vae.py:103-133), VQGAN with default 1024-token ImageNet model or arbitrary
+checkpoints/configs incl. GumbelVQ (vae.py:150-220).
+
+TPU-first: the architectures are native Flax modules
+(:mod:`dalle_tpu.models.openai_vae`, :mod:`dalle_tpu.models.vqgan`) whose
+``(module, params)`` pair plugs into the same train/generate steps as the
+in-tree DiscreteVAE — torch is used only once at load time to unpickle the
+released checkpoints (no torch in the compute path).
 """
 
 from __future__ import annotations
 
+import io
+import os
+import sys
+import urllib.request
+from pathlib import Path
 
-class _PendingPretrained:
-    """Placeholder that fails loudly on use, not on import."""
+import jax
+import jax.numpy as jnp
+import numpy as np
 
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            f"{type(self).__name__} weight conversion is not wired up yet; "
-            "train an in-tree DiscreteVAE or pass converted flax params. "
-            "See dalle_tpu/models/pretrained.py."
-        )
+from dalle_tpu.models import openai_vae as _oa
+from dalle_tpu.models.vqgan import VQGAN, VQGANConfig  # noqa: F401  (re-export)
+from dalle_tpu.models import convert as _convert
+
+import flax.linen as nn
+
+CACHE_PATH = Path(os.path.expanduser("~/.cache/dalle"))  # (reference: vae.py:27)
+
+OPENAI_VAE_ENCODER_URL = "https://cdn.openai.com/dall-e/encoder.pkl"
+OPENAI_VAE_DECODER_URL = "https://cdn.openai.com/dall-e/decoder.pkl"
+# default 1024-token ImageNet VQGAN (reference: vae.py:32-33)
+VQGAN_VAE_URL = "https://heibox.uni-heidelberg.de/f/140747ba53464f49b476/?dl=1"
+VQGAN_VAE_CONFIG_URL = "https://heibox.uni-heidelberg.de/f/6ecf2af6c658432c8298/?dl=1"
 
 
-class OpenAIDiscreteVAE(_PendingPretrained):
-    """reference: dalle_pytorch/vae.py:103-133."""
+def download(url: str, filename: str, root: Path = CACHE_PATH) -> str:
+    """Rank-0 downloads, others wait at the barrier until the file exists
+    (reference: vae.py:53-94)."""
+    from dalle_tpu.parallel import backend as backend_lib
+
+    root.mkdir(parents=True, exist_ok=True)
+    path = root / filename
+    b = backend_lib.backend
+    is_root = b is None or b.is_local_root_worker()
+    if path.exists():
+        return str(path)
+    if not is_root:
+        b.local_barrier()
+        assert path.exists(), f"rank-0 download of {filename} did not appear"
+        return str(path)
+    try:
+        tmp = path.with_suffix(".tmp")
+        with urllib.request.urlopen(url, timeout=60) as r, open(tmp, "wb") as f:
+            while chunk := r.read(1 << 20):
+                f.write(chunk)
+        tmp.rename(path)
+    except Exception as e:
+        raise RuntimeError(
+            f"could not download {url} ({e}); in offline environments place "
+            f"the file at {path} manually"
+        ) from e
+    finally:
+        if b is not None:
+            b.local_barrier()
+    return str(path)
 
 
-class VQGanVAE(_PendingPretrained):
-    """reference: dalle_pytorch/vae.py:150-220."""
+def _torch_load(path: str):
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+class OpenAIDiscreteVAE(nn.Module):
+    """Drop-in (module, params) VAE: fixed 3 layers / 256 px / 8192 tokens
+    (reference: vae.py:103-133)."""
+
+    cfg: _oa.OpenAIVAEConfig = _oa.OpenAIVAEConfig()
+
+    def setup(self):
+        self.enc = _oa.OpenAIEncoder(self.cfg, name="encoder")
+        self.dec = _oa.OpenAIDecoder(self.cfg, name="decoder")
+
+    @property
+    def num_layers(self):
+        return 3
+
+    @property
+    def num_tokens(self):
+        return self.cfg.vocab_size
+
+    @property
+    def image_size(self):
+        return 256
+
+    def get_codebook_indices(self, img):
+        logits = self.enc(_oa.map_pixels(img))
+        b, h, w, _ = logits.shape
+        return jnp.argmax(logits, axis=-1).reshape(b, h * w).astype(jnp.int32)
+
+    def decode(self, img_seq):
+        b, n = img_seq.shape
+        f = int(n**0.5)
+        z = jax.nn.one_hot(img_seq, self.cfg.vocab_size).reshape(b, f, f, -1)
+        out = self.dec(z)
+        return _oa.unmap_pixels(jax.nn.sigmoid(out[..., :3]))
+
+    def _init_all(self, img):
+        """Touches encoder AND decoder so one init builds all params."""
+        return self.decode(self.get_codebook_indices(img))
+
+    def __call__(self, img):
+        raise NotImplementedError  # encode/decode only (reference: vae.py:132-133)
+
+
+def load_openai_vae(enc_path=None, dec_path=None):
+    """→ (OpenAIDiscreteVAE module, params).  Downloads the released pickles
+    when paths are omitted (zero-egress: place them in ~/.cache/dalle)."""
+    enc_path = enc_path or download(OPENAI_VAE_ENCODER_URL, "encoder.pkl")
+    dec_path = dec_path or download(OPENAI_VAE_DECODER_URL, "decoder.pkl")
+    model = OpenAIDiscreteVAE()
+    template = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 256, 256, 3)),
+        method=OpenAIDiscreteVAE._init_all,
+    )["params"]
+
+    def tensors_of(obj):
+        sd = obj.state_dict() if hasattr(obj, "state_dict") else dict(obj)
+        return [v for k, v in sd.items()]
+
+    enc_t = tensors_of(_torch_load(enc_path))
+    dec_t = tensors_of(_torch_load(dec_path))
+    params = dict(template)
+    params["encoder"] = _convert.convert_by_order(template["encoder"], enc_t)
+    params["decoder"] = _convert.convert_by_order(template["decoder"], dec_t)
+    return model, params
+
+
+def _parse_vqgan_config(config_path: str) -> VQGANConfig:
+    import yaml
+
+    with open(config_path) as f:
+        raw = yaml.safe_load(f)
+    params = raw["model"]["params"]
+    dd = params["ddconfig"]
+    gumbel = "Gumbel" in raw["model"].get("target", "")
+    return VQGANConfig(
+        ch=dd["ch"],
+        ch_mult=tuple(dd["ch_mult"]),
+        num_res_blocks=dd["num_res_blocks"],
+        attn_resolutions=tuple(dd["attn_resolutions"]),
+        resolution=dd["resolution"],
+        in_channels=dd["in_channels"],
+        z_channels=dd["z_channels"],
+        n_embed=params["n_embed"],
+        embed_dim=params["embed_dim"],
+        gumbel=gumbel,
+    )
+
+
+def load_vqgan(vqgan_model_path=None, vqgan_config_path=None):
+    """→ (VQGAN module, params).  Default: the 1024-token ImageNet model
+    (reference: vae.py:154-170); custom ckpt+yaml supported
+    (reference --vqgan_model_path/--vqgan_config_path)."""
+    model_path = vqgan_model_path or download(VQGAN_VAE_URL, "vqgan.1024.model.ckpt")
+    config_path = vqgan_config_path or download(
+        VQGAN_VAE_CONFIG_URL, "vqgan.1024.config.yml"
+    )
+    cfg = _parse_vqgan_config(config_path)
+    model = VQGAN(cfg)
+    template = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, cfg.resolution, cfg.resolution, 3)),
+        method=VQGAN._init_all,
+    )["params"]
+    ckpt = _torch_load(model_path)
+    sd = ckpt.get("state_dict", ckpt)
+    params = _convert.convert_named(
+        template, sd, _convert.vqgan_rules(), ignore=_convert.VQGAN_IGNORE
+    )
+    return model, params
+
+
+def VQGanVAE(vqgan_model_path=None, vqgan_config_path=None):
+    """Reference-named convenience loader (reference: vae.py:150-220):
+    returns ``(VQGAN module, params)``."""
+    return load_vqgan(vqgan_model_path, vqgan_config_path)

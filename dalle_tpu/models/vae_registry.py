@@ -1,0 +1,69 @@
+"""VAE family registry: self-describing (de)serialization for checkpoints.
+
+The reference embeds ``vae_params`` (constructor kwargs) in DALLE
+checkpoints and rebuilds the right class by flag at load time
+(reference: train_dalle.py:235-289, generate.py:86-91).  Here every VAE
+family serializes to a tagged dict so ``generate`` can rebuild the exact
+module with zero flags.
+"""
+
+from __future__ import annotations
+
+from dalle_tpu.models.openai_vae import OpenAIVAEConfig
+from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+from dalle_tpu.models.vqgan import VQGAN, VQGANConfig
+
+
+def vae_hparams(vae, cfg) -> dict:
+    from dalle_tpu.models.pretrained import OpenAIDiscreteVAE
+
+    if isinstance(vae, DiscreteVAE):
+        return {"type": "discrete", **cfg.to_dict()}
+    if isinstance(vae, VQGAN):
+        return {"type": "vqgan", **vae.cfg.to_dict()}
+    if isinstance(vae, OpenAIDiscreteVAE):
+        import dataclasses
+
+        return {"type": "openai", **dataclasses.asdict(vae.cfg)}
+    raise TypeError(f"unknown VAE family: {type(vae)}")
+
+
+def build_vae(hparams: dict):
+    """tagged dict → (module, config-like).  config-like exposes
+    num_tokens / fmap_size / image_size for DALLEConfig construction."""
+    from dalle_tpu.models.pretrained import OpenAIDiscreteVAE
+
+    d = dict(hparams)
+    kind = d.pop("type", "discrete")
+    if kind == "discrete":
+        cfg = DiscreteVAEConfig.from_dict(d)
+        return DiscreteVAE(cfg), cfg
+    if kind == "vqgan":
+        cfg = VQGANConfig.from_dict(d)
+
+        class _C:
+            num_tokens = cfg.n_embed
+            fmap_size = cfg.fmap_size
+            image_size = cfg.resolution
+
+            @staticmethod
+            def to_dict():
+                return {"type": "vqgan", **cfg.to_dict()}
+
+        return VQGAN(cfg), _C
+    if kind == "openai":
+        cfg = OpenAIVAEConfig(**d)
+
+        class _C:  # noqa: D401
+            num_tokens = cfg.vocab_size
+            fmap_size = 32
+            image_size = 256
+
+            @staticmethod
+            def to_dict():
+                import dataclasses
+
+                return {"type": "openai", **dataclasses.asdict(cfg)}
+
+        return OpenAIDiscreteVAE(cfg), _C
+    raise ValueError(f"unknown VAE type {kind!r}")

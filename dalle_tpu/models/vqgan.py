@@ -1,0 +1,226 @@
+"""VQGAN (taming-transformers) architecture in Flax.
+
+Re-implementation of the ``VQModel``/``GumbelVQ`` networks the reference
+loads through the external taming-transformers package + OmegaConf
+(reference: dalle_pytorch/vae.py:150-220): GroupNorm/Swish ResNet encoder-
+decoder with mid-block attention, and a codebook quantizer.  Covers the
+configs the reference exercises: the default f16 1024-token ImageNet VQGAN
+(reference: vae.py:32-33), Gumbel f8 8192, and arbitrary codebooks via
+config (the 16k model of BASELINE.json config 3).
+
+Only the inference surface DALLE needs is implemented —
+``encode → indices`` and ``indices → decode`` (reference: vae.py:198-217);
+GAN training of the VQGAN itself is out of scope, matching the reference
+(which also only wraps pretrained checkpoints).
+
+NHWC; weights convert from taming torch checkpoints via
+:mod:`dalle_tpu.models.convert`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class VQGANConfig:
+    ch: int = 128
+    ch_mult: Tuple[int, ...] = (1, 1, 2, 2, 4)  # f = 2**(len-1) = 16
+    num_res_blocks: int = 2
+    attn_resolutions: Tuple[int, ...] = (16,)
+    resolution: int = 256
+    in_channels: int = 3
+    z_channels: int = 256
+    n_embed: int = 1024
+    embed_dim: int = 256
+    gumbel: bool = False  # GumbelVQ checkpoints (8192 tokens, f8)
+
+    @property
+    def num_layers(self) -> int:
+        """log2 downsampling factor (reference infers it as
+        log2(resolution / attn_res), vae.py:177-178)."""
+        return len(self.ch_mult) - 1
+
+    @property
+    def fmap_size(self) -> int:
+        return self.resolution // (2**self.num_layers)
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["ch_mult"] = list(self.ch_mult)
+        d["attn_resolutions"] = list(self.attn_resolutions)
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        d["ch_mult"] = tuple(d["ch_mult"])
+        d["attn_resolutions"] = tuple(d["attn_resolutions"])
+        return cls(**d)
+
+
+def _gn(x, name=None, scope=None):
+    return nn.GroupNorm(num_groups=32, epsilon=1e-6, name=name)(x)
+
+
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+class ResnetBlock(nn.Module):
+    out_ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.GroupNorm(32, epsilon=1e-6, name="norm1")(x)
+        h = nn.Conv(self.out_ch, (3, 3), padding="SAME", name="conv1")(swish(h))
+        h = nn.GroupNorm(32, epsilon=1e-6, name="norm2")(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding="SAME", name="conv2")(swish(h))
+        if x.shape[-1] != self.out_ch:
+            x = nn.Conv(self.out_ch, (1, 1), name="nin_shortcut")(x)
+        return x + h
+
+
+class AttnBlock(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b, hh, ww, c = x.shape
+        h = nn.GroupNorm(32, epsilon=1e-6, name="norm")(x)
+        q = nn.Conv(c, (1, 1), name="q")(h).reshape(b, hh * ww, c)
+        k = nn.Conv(c, (1, 1), name="k")(h).reshape(b, hh * ww, c)
+        v = nn.Conv(c, (1, 1), name="v")(h).reshape(b, hh * ww, c)
+        attn = jax.nn.softmax(
+            jnp.einsum("bic,bjc->bij", q, k, preferred_element_type=jnp.float32)
+            * (c**-0.5),
+            axis=-1,
+        ).astype(v.dtype)
+        h = jnp.einsum("bij,bjc->bic", attn, v).reshape(b, hh, ww, c)
+        return x + nn.Conv(c, (1, 1), name="proj_out")(h)
+
+
+class VQGANEncoder(nn.Module):
+    cfg: VQGANConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        h = nn.Conv(c.ch, (3, 3), padding="SAME", name="conv_in")(x)
+        res = c.resolution
+        for i, mult in enumerate(c.ch_mult):
+            for b in range(c.num_res_blocks):
+                h = ResnetBlock(c.ch * mult, name=f"down_{i}_block_{b}")(h)
+                if res in c.attn_resolutions:
+                    h = AttnBlock(name=f"down_{i}_attn_{b}")(h)
+            if i < len(c.ch_mult) - 1:
+                # taming uses asymmetric pad + stride-2 conv
+                h = jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0)))
+                h = nn.Conv(
+                    h.shape[-1], (3, 3), strides=(2, 2), padding="VALID",
+                    name=f"down_{i}_downsample",
+                )(h)
+                res //= 2
+        h = ResnetBlock(h.shape[-1], name="mid_block_1")(h)
+        h = AttnBlock(name="mid_attn_1")(h)
+        h = ResnetBlock(h.shape[-1], name="mid_block_2")(h)
+        h = nn.GroupNorm(32, epsilon=1e-6, name="norm_out")(h)
+        return nn.Conv(c.z_channels, (3, 3), padding="SAME", name="conv_out")(swish(h))
+
+
+class VQGANDecoder(nn.Module):
+    cfg: VQGANConfig
+
+    @nn.compact
+    def __call__(self, z):
+        c = self.cfg
+        block_in = c.ch * c.ch_mult[-1]
+        h = nn.Conv(block_in, (3, 3), padding="SAME", name="conv_in")(z)
+        h = ResnetBlock(block_in, name="mid_block_1")(h)
+        h = AttnBlock(name="mid_attn_1")(h)
+        h = ResnetBlock(block_in, name="mid_block_2")(h)
+        res = c.fmap_size
+        for i, mult in reversed(list(enumerate(c.ch_mult))):
+            for b in range(c.num_res_blocks + 1):
+                h = ResnetBlock(c.ch * mult, name=f"up_{i}_block_{b}")(h)
+                if res in c.attn_resolutions:
+                    h = AttnBlock(name=f"up_{i}_attn_{b}")(h)
+            if i > 0:
+                bsz, hh, ww, ch = h.shape
+                h = jax.image.resize(h, (bsz, hh * 2, ww * 2, ch), "nearest")
+                h = nn.Conv(ch, (3, 3), padding="SAME", name=f"up_{i}_upsample")(h)
+                res *= 2
+        h = nn.GroupNorm(32, epsilon=1e-6, name="norm_out")(h)
+        return nn.Conv(c.in_channels, (3, 3), padding="SAME", name="conv_out")(swish(h))
+
+
+class VQGAN(nn.Module):
+    """Encoder + quantizer + decoder with DALLE's required surface."""
+
+    cfg: VQGANConfig
+
+    def setup(self):
+        c = self.cfg
+        self.encoder = VQGANEncoder(c, name="encoder")
+        self.decoder = VQGANDecoder(c, name="decoder")
+        self.codebook = nn.Embed(c.n_embed, c.embed_dim, name="codebook")
+        if not c.gumbel:
+            self.quant_conv = nn.Conv(c.embed_dim, (1, 1), name="quant_conv")
+            self.post_quant_conv = nn.Conv(
+                c.z_channels, (1, 1), name="post_quant_conv"
+            )
+        else:
+            # GumbelVQ: quant_conv maps to n_embed logits directly
+            self.quant_conv = nn.Conv(c.n_embed, (1, 1), name="quant_conv")
+            self.post_quant_conv = nn.Conv(
+                c.z_channels, (1, 1), name="post_quant_conv"
+            )
+
+    @property
+    def num_layers(self):
+        return self.cfg.num_layers
+
+    @property
+    def num_tokens(self):
+        return self.cfg.n_embed
+
+    @property
+    def image_size(self):
+        return self.cfg.resolution
+
+    def get_codebook_indices(self, img):
+        """img [b,H,W,3] in [0,1] → int32 [b, fmap²].  Pixels map to [-1, 1]
+        (reference: vae.py:198-205)."""
+        z = self.encoder(2.0 * img - 1.0)
+        z = self.quant_conv(z)
+        b, h, w, _ = z.shape
+        if self.cfg.gumbel:
+            idx = jnp.argmax(z, axis=-1)  # logits → hard indices
+        else:
+            flat = z.reshape(b * h * w, -1)
+            emb = self.codebook.embedding  # [n, d]
+            d2 = (
+                jnp.sum(flat**2, axis=1, keepdims=True)
+                - 2 * flat @ emb.T
+                + jnp.sum(emb**2, axis=1)[None]
+            )
+            idx = jnp.argmin(d2, axis=-1).reshape(b, h, w)
+        return idx.reshape(b, h * w).astype(jnp.int32)
+
+    def _init_all(self, img):
+        """Touches encoder AND decoder so one init builds all params."""
+        return self.decode(self.get_codebook_indices(img))
+
+    def decode(self, img_seq):
+        """int [b, fmap²] → [b, H, W, 3] in [0, 1]
+        (one-hot @ codebook → decoder → [-1,1] → [0,1]; reference:
+        vae.py:207-217)."""
+        b, n = img_seq.shape
+        f = self.cfg.fmap_size
+        z = self.codebook(img_seq).reshape(b, f, f, -1)
+        z = self.post_quant_conv(z)
+        x = self.decoder(z)
+        return jnp.clip((x + 1.0) / 2.0, 0.0, 1.0)

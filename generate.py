@@ -62,8 +62,9 @@ def main(argv=None):
     model = DALLE(cfg)
     params = jax.device_put(ckpt["params"])
     assert ckpt.get("vae_hparams"), "checkpoint lacks an embedded VAE"
-    vae_cfg = DiscreteVAEConfig.from_dict(ckpt["vae_hparams"])
-    vae = DiscreteVAE(vae_cfg)
+    from dalle_tpu.models.vae_registry import build_vae
+
+    vae, _ = build_vae(ckpt["vae_hparams"])
     vae_params = jax.device_put(ckpt["vae_params"])
 
     clip = clip_params = None

@@ -1,0 +1,149 @@
+"""Pretrained VAE architectures + weight conversion mechanics.
+
+Real released weights can't be fetched in a zero-egress environment; these
+tests pin (a) architecture geometry (fmap/vocab/decode shapes), (b) the
+converter's transpose/shape logic and exact-consumption guarantees via
+synthetic torch-style state dicts, (c) registry round-trips."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.models import convert as C
+from dalle_tpu.models.openai_vae import (
+    OpenAIVAEConfig,
+    map_pixels,
+    unmap_pixels,
+)
+from dalle_tpu.models.pretrained import OpenAIDiscreteVAE
+from dalle_tpu.models.vae_registry import build_vae, vae_hparams
+from dalle_tpu.models.vqgan import VQGAN, VQGANConfig
+
+TINY_OA = OpenAIVAEConfig(n_hid=8, n_blk_per_group=1, vocab_size=32, n_init=8)
+TINY_VQ = VQGANConfig(
+    ch=32, ch_mult=(1, 2), num_res_blocks=1, attn_resolutions=(8,),
+    resolution=16, z_channels=16, n_embed=24, embed_dim=16,
+)
+
+
+def test_pixel_mapping_roundtrip():
+    x = jnp.linspace(0, 1, 11)
+    np.testing.assert_allclose(np.asarray(unmap_pixels(map_pixels(x))), np.asarray(x), atol=1e-6)
+
+
+def test_openai_vae_geometry(rng):
+    model = OpenAIDiscreteVAE(TINY_OA)
+    img = jax.random.uniform(rng, (2, 16, 16, 3))
+    params = model.init(
+        {"params": rng}, img, method=OpenAIDiscreteVAE._init_all
+    )["params"]
+    ids = model.apply({"params": params}, img, method=OpenAIDiscreteVAE.get_codebook_indices)
+    assert ids.shape == (2, 4) and int(ids.max()) < 32  # 16/8=2 → 2x2 map
+    out = model.apply({"params": params}, ids, method=OpenAIDiscreteVAE.decode)
+    assert out.shape == (2, 16, 16, 3)
+    assert float(out.min()) >= 0 and float(out.max()) <= 1
+
+
+def test_vqgan_geometry(rng):
+    model = VQGAN(TINY_VQ)
+    img = jax.random.uniform(rng, (2, 16, 16, 3))
+    params = model.init({"params": rng}, img, method=VQGAN._init_all)["params"]
+    ids = model.apply({"params": params}, img, method=VQGAN.get_codebook_indices)
+    assert ids.shape == (2, 64) and int(ids.max()) < 24  # f=2 → 8x8 map
+    out = model.apply({"params": params}, ids, method=VQGAN.decode)
+    assert out.shape == (2, 16, 16, 3)
+    assert float(out.min()) >= 0 and float(out.max()) <= 1
+
+
+def test_fit_tensor_transposes():
+    conv = np.zeros((8, 4, 3, 3))  # torch OIHW
+    assert C.fit_tensor(conv, (3, 3, 4, 8)).shape == (3, 3, 4, 8)
+    lin = np.zeros((8, 4))
+    assert C.fit_tensor(lin, (4, 8)).shape == (4, 8)
+    with pytest.raises(ValueError):
+        C.fit_tensor(np.zeros((5, 5)), (3, 3))
+
+
+def test_convert_by_order_roundtrip(rng):
+    template = {"a": jnp.zeros((3, 3, 4, 8)), "b": jnp.zeros((8,))}
+    torch_tensors = [np.random.rand(8, 4, 3, 3), np.random.rand(8)]
+    out = C.convert_by_order(template, torch_tensors)
+    np.testing.assert_allclose(out["a"], torch_tensors[0].transpose(2, 3, 1, 0))
+    with pytest.raises(AssertionError):
+        C.convert_by_order(template, torch_tensors[:1])
+
+
+def test_vqgan_named_conversion(rng):
+    """Synthesize a torch-style taming state dict covering every model leaf,
+    convert, verify exact fill + value placement."""
+    model = VQGAN(TINY_VQ)
+    img = jnp.zeros((1, 16, 16, 3))
+    template = model.init(
+        {"params": jax.random.PRNGKey(0)}, img, method=VQGAN._init_all
+    )["params"]
+
+    # build the inverse: flax path → torch key
+    inv = []
+    for pat, repl in C.vqgan_rules():
+        inv.append((pat, repl))
+
+    flat = dict(C._flat_leaves(template))
+    sd = {}
+    import re
+
+    def torch_shape(path, shape):
+        if path.endswith("/kernel") and len(shape) == 4:
+            return (shape[3], shape[2], shape[0], shape[1])
+        return shape
+
+    # generate torch keys by scanning rule space against known paths
+    for path, leaf in flat.items():
+        matched = False
+        for pat, repl in inv:
+            # try to reverse: construct candidate torch keys by substituting
+            # groups — instead, scan: generate torch key candidates from the
+            # flax path by inverting our naming conventions
+            pass
+        # direct inversion by naming convention:
+        tk = path.replace("/", ".")
+        tk = re.sub(r"(encoder|decoder)\.down_(\d+)_block_(\d+)\.", r"\1.down.\2.block.\3.", tk)
+        tk = re.sub(r"(encoder|decoder)\.down_(\d+)_attn_(\d+)\.", r"\1.down.\2.attn.\3.", tk)
+        tk = re.sub(r"(encoder|decoder)\.down_(\d+)_downsample\.", r"\1.down.\2.downsample.conv.", tk)
+        tk = re.sub(r"(encoder|decoder)\.up_(\d+)_block_(\d+)\.", r"\1.up.\2.block.\3.", tk)
+        tk = re.sub(r"(encoder|decoder)\.up_(\d+)_attn_(\d+)\.", r"\1.up.\2.attn.\3.", tk)
+        tk = re.sub(r"(encoder|decoder)\.up_(\d+)_upsample\.", r"\1.up.\2.upsample.conv.", tk)
+        tk = re.sub(r"\.mid_(block_\d|attn_\d)\.", r".mid.\1.", tk)
+        tk = tk.replace("codebook.embedding", "quantize.embedding.weight")
+        tk = tk.replace(".scale", ".weight").replace(".kernel", ".weight")
+        if not tk.endswith((".weight", ".bias")):
+            tk += ""
+        sd[tk] = np.random.rand(*torch_shape(path, leaf.shape)).astype(np.float32)
+
+    sd["loss.discriminator.fake"] = np.zeros((1,))  # must be ignored
+    out = C.convert_named(template, sd, C.vqgan_rules(), ignore=C.VQGAN_IGNORE)
+    # spot-check value placement incl. conv transpose
+    key = "encoder.conv_in.weight"
+    got = np.asarray(out["encoder"]["conv_in"]["kernel"])
+    np.testing.assert_allclose(got, sd[key].transpose(2, 3, 1, 0))
+    # missing leaf must raise
+    sd2 = dict(sd)
+    sd2.pop("encoder.conv_in.bias")
+    with pytest.raises(ValueError):
+        C.convert_named(template, sd2, C.vqgan_rules(), ignore=C.VQGAN_IGNORE)
+
+
+def test_vae_registry_roundtrip(rng):
+    model = VQGAN(TINY_VQ)
+    hp = vae_hparams(model, None)
+    rebuilt, cfg = build_vae(hp)
+    assert isinstance(rebuilt, VQGAN) and rebuilt.cfg == TINY_VQ
+    assert cfg.num_tokens == 24 and cfg.fmap_size == 8
+
+    oa = OpenAIDiscreteVAE(TINY_OA)
+    hp2 = vae_hparams(oa, None)
+    rebuilt2, cfg2 = build_vae(hp2)
+    assert isinstance(rebuilt2, OpenAIDiscreteVAE)
+    assert cfg2.num_tokens == 32

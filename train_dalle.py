@@ -110,24 +110,31 @@ def parse_args(argv=None):
 
 def resolve_vae(args, resume_meta):
     """VAE resolution order (reference: train_dalle.py:235-289):
-    resume ckpt's embedded vae → --vae_path → --taming → OpenAI default."""
+    resume ckpt's embedded vae → --vae_path → --taming → OpenAI default.
+    Returns (module, params, cfg-like with num_tokens/fmap_size/image_size)."""
+    from dalle_tpu.models.vae_registry import build_vae
+
     if resume_meta is not None and resume_meta.get("vae_hparams"):
-        cfg = DiscreteVAEConfig.from_dict(resume_meta["vae_hparams"])
-        return DiscreteVAE(cfg), resume_meta["vae_params"], cfg
+        vae, cfg = build_vae(resume_meta["vae_hparams"])
+        return vae, resume_meta["vae_params"], cfg
     if args.vae_path:
         assert is_checkpoint(args.vae_path), f"{args.vae_path} is not a checkpoint"
         out = load_checkpoint(args.vae_path)
         cfg = DiscreteVAEConfig.from_dict(out["hparams"])
         return DiscreteVAE(cfg), out["params"], cfg
     if args.taming:
-        from dalle_tpu.models.pretrained import VQGanVAE
+        from dalle_tpu.models.pretrained import load_vqgan
 
-        vq = VQGanVAE()  # raises with guidance until converters land
-        return vq, None, None
-    from dalle_tpu.models.pretrained import OpenAIDiscreteVAE
+        vae, params = load_vqgan()
+        _, cfg = build_vae({"type": "vqgan", **vae.cfg.to_dict()})
+        return vae, params, cfg
+    from dalle_tpu.models.pretrained import load_openai_vae
 
-    oa = OpenAIDiscreteVAE()
-    return oa, None, None
+    vae, params = load_openai_vae()
+    _, cfg = build_vae(
+        {"type": "openai", **__import__("dataclasses").asdict(vae.cfg)}
+    )
+    return vae, params, cfg
 
 
 def main(argv=None):
