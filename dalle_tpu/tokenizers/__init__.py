@@ -28,6 +28,14 @@ def get_tokenizer(
         assert bpe_path, "a yttm model path is required"
         return YttmTokenizer(bpe_path)
     try:
-        return SimpleTokenizer(bpe_path)
+        try:
+            # C++ merge engine when a toolchain is available (yttm-equivalent)
+            from dalle_tpu.tokenizers.native_bpe import NativeTokenizer
+
+            return NativeTokenizer(bpe_path)
+        except FileNotFoundError:
+            raise
+        except Exception:
+            return SimpleTokenizer(bpe_path)
     except FileNotFoundError:
         return ByteTokenizer()

@@ -1,0 +1,129 @@
+// Native BPE merge engine — the C++ hot path for tokenization.
+//
+// The reference's fastest tokenizer is youtokentome, a C++ BPE library it
+// wraps from Python (reference: dalle_pytorch/tokenizer.py:232-266).  This
+// is our first-party equivalent: the greedy lowest-rank pair-merge loop
+// (the O(words * merges) hot path of CLIP-style BPE) in C++, driven from
+// Python via ctypes (dalle_tpu/tokenizers/native_bpe.py).  Semantics match
+// SimpleTokenizer.bpe exactly — pinned by parity tests.
+//
+// Build: make -C dalle_tpu/tokenizers/native   (g++ -O2 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <limits>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<std::string, std::string>& p) const {
+    std::hash<std::string> h;
+    return h(p.first) * 1000003u ^ h(p.second);
+  }
+};
+
+struct BPE {
+  std::unordered_map<std::pair<std::string, std::string>, int, PairHash> ranks;
+};
+
+// split a UTF-8 string into codepoint-level symbols
+std::vector<std::string> utf8_symbols(const char* s) {
+  std::vector<std::string> out;
+  const unsigned char* p = reinterpret_cast<const unsigned char*>(s);
+  while (*p) {
+    int len = 1;
+    if ((*p & 0xF8) == 0xF0) len = 4;
+    else if ((*p & 0xF0) == 0xE0) len = 3;
+    else if ((*p & 0xE0) == 0xC0) len = 2;
+    out.emplace_back(reinterpret_cast<const char*>(p), len);
+    p += len;
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// merges file: first line header, then "<tok> <tok>" per line
+void* bpe_create(const char* merges_path, int max_merges) {
+  std::ifstream f(merges_path);
+  if (!f.good()) return nullptr;
+  auto* bpe = new BPE();
+  std::string line;
+  bool first = true;
+  int rank = 0;
+  while (std::getline(f, line) && (max_merges < 0 || rank < max_merges)) {
+    if (first) { first = false; continue; }  // header
+    std::istringstream iss(line);
+    std::string a, b, extra;
+    if (!(iss >> a >> b) || (iss >> extra)) continue;  // exactly two fields
+    bpe->ranks[{a, b}] = rank++;
+  }
+  return bpe;
+}
+
+void bpe_destroy(void* h) { delete static_cast<BPE*>(h); }
+
+int bpe_num_merges(void* h) {
+  return static_cast<int>(static_cast<BPE*>(h)->ranks.size());
+}
+
+// word: UTF-8 token (already byte-encoded by the Python side).  The final
+// symbol gets "</w>" appended, then pairs merge greedily by lowest rank —
+// identical to SimpleTokenizer.bpe.  Output: pieces joined by '\x02' into
+// out (cap bytes).  Returns output length, or -1 on overflow.
+int bpe_apply(void* h, const char* word, char* out, int cap) {
+  auto* bpe = static_cast<BPE*>(h);
+  std::vector<std::string> syms = utf8_symbols(word);
+  if (syms.empty()) return 0;
+  syms.back() += "</w>";
+
+  while (syms.size() > 1) {
+    int best = std::numeric_limits<int>::max();
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < syms.size(); ++i) {
+      auto it = bpe->ranks.find({syms[i], syms[i + 1]});
+      if (it != bpe->ranks.end() && it->second < best) {
+        best = it->second;
+        best_i = i;
+      }
+    }
+    if (best == std::numeric_limits<int>::max()) break;
+    // merge ALL occurrences of the best pair, left to right
+    const std::string a = syms[best_i], b = syms[best_i + 1];
+    std::vector<std::string> merged;
+    merged.reserve(syms.size());
+    size_t i = 0;
+    while (i < syms.size()) {
+      if (i + 1 < syms.size() && syms[i] == a && syms[i + 1] == b) {
+        merged.push_back(a + b);
+        i += 2;
+      } else {
+        merged.push_back(syms[i]);
+        i += 1;
+      }
+    }
+    syms.swap(merged);
+  }
+
+  size_t pos = 0;
+  for (size_t i = 0; i < syms.size(); ++i) {
+    if (i) {
+      if (pos + 1 >= static_cast<size_t>(cap)) return -1;
+      out[pos++] = '\x02';
+    }
+    if (pos + syms[i].size() >= static_cast<size_t>(cap)) return -1;
+    std::memcpy(out + pos, syms[i].data(), syms[i].size());
+    pos += syms[i].size();
+  }
+  out[pos] = '\0';
+  return static_cast<int>(pos);
+}
+
+}  // extern "C"

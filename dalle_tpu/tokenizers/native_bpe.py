@@ -1,0 +1,89 @@
+"""ctypes binding for the native C++ BPE merge engine.
+
+First-party replacement for the reference's youtokentome C++ dependency
+(reference: dalle_pytorch/tokenizer.py:232-266): the greedy pair-merge loop
+runs in C++ (``native/bpe.cpp``); byte-encoding, the word splitter, and the
+vocab stay in Python (they're not hot).  ``NativeTokenizer`` subclasses
+``SimpleTokenizer`` and overrides only ``bpe`` — every contract and test of
+the Python tokenizer applies unchanged.
+
+The shared library builds on demand with ``make`` (g++); when no toolchain
+is present the import raises and callers fall back to the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+from dalle_tpu.tokenizers.simple import SimpleTokenizer
+
+_NATIVE_DIR = Path(__file__).parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libbpe.so"
+
+
+def build_native(force: bool = False) -> Path:
+    if _LIB_PATH.exists() and not force:
+        return _LIB_PATH
+    subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR), "libbpe.so"],
+        check=True,
+        capture_output=True,
+    )
+    return _LIB_PATH
+
+
+def _load_lib() -> ctypes.CDLL:
+    build_native()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.bpe_create.restype = ctypes.c_void_p
+    lib.bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+    lib.bpe_num_merges.restype = ctypes.c_int
+    lib.bpe_num_merges.argtypes = [ctypes.c_void_p]
+    lib.bpe_apply.restype = ctypes.c_int
+    lib.bpe_apply.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    return lib
+
+
+class NativeTokenizer(SimpleTokenizer):
+    """SimpleTokenizer with the merge loop in C++."""
+
+    MAX_MERGES = 49152 - 256 - 2  # CLIP vocab truncation (simple.py)
+
+    def __init__(self, bpe_path: Optional[str] = None):
+        super().__init__(bpe_path)
+        self._lib = _load_lib()
+        path = self._resolve(bpe_path)
+        self._handle = self._lib.bpe_create(
+            str(path).encode(), self.MAX_MERGES
+        )
+        if not self._handle:
+            raise RuntimeError(f"native BPE failed to load {path}")
+        assert self._lib.bpe_num_merges(self._handle) == len(self.bpe_ranks), (
+            "native/python merge tables disagree"
+        )
+        self._buf = ctypes.create_string_buffer(1 << 16)
+
+    def bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        n = self._lib.bpe_apply(
+            self._handle, token.encode("utf-8"), self._buf, len(self._buf)
+        )
+        if n < 0:
+            return super().bpe(token)  # overflow: fall back
+        out = self._buf.raw[:n].decode("utf-8").replace("\x02", " ")
+        self.cache[token] = out
+        return out
+
+    def __del__(self):
+        if getattr(self, "_handle", None) and getattr(self, "_lib", None):
+            self._lib.bpe_destroy(self._handle)

@@ -167,3 +167,24 @@ def test_webdataset_tar_streaming(tmp_path):
     assert len(batches) == 3
     t, im = batches[0]
     assert t.shape == (2, 16) and im.shape == (2, 8, 8, 3)
+
+
+def test_native_bpe_parity(merges_file):
+    """C++ merge engine == Python SimpleTokenizer.bpe on every input."""
+    pytest.importorskip("ctypes")
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    from dalle_tpu.tokenizers.native_bpe import NativeTokenizer
+
+    py = SimpleTokenizer(bpe_path=merges_file)
+    nat = NativeTokenizer(bpe_path=merges_file)
+    words = ["the", "cat", "dog", "thecatdog", "a", "zzz", "théca"]
+    for w in words:
+        py.cache.pop(w, None)
+        nat.cache.pop(w, None)
+        assert nat.bpe(w) == py.bpe(w), w
+    # full encode path parity
+    for text in ["the cat sat", "a dog; the dog!", "thé the"]:
+        assert nat.encode(text) == py.encode(text)
