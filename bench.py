@@ -25,33 +25,7 @@ from dalle_tpu.training import (
     make_dalle_train_step,
     make_optimizer,
 )
-
-# bf16 peak TFLOP/s per chip by TPU generation (public specs)
-PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0, "cpu": 1.0}
-
-
-def detect_peak() -> float:
-    kind = jax.devices()[0].device_kind.lower()
-    for name, peak in PEAK_TFLOPS.items():
-        if name in kind.replace(" ", ""):
-            return peak
-    if "lite" in kind:  # "TPU v5 lite" == v5e
-        return PEAK_TFLOPS["v5e"]
-    return PEAK_TFLOPS["v4"]
-
-
-def transformer_flops_per_token(cfg: DALLEConfig) -> float:
-    """Forward+backward FLOPs per sequence token (6N rule + attention)."""
-    d = cfg.dim
-    inner = cfg.heads * cfg.dim_head
-    per_layer = 2 * (d * 3 * inner + inner * d + 2 * d * 4 * d * 2 // 2 + 4 * d * d)
-    # ^ qkv + out + GEGLU in (2x for gate) + ff out, as MACs*2
-    matmul = cfg.depth * per_layer
-    attn = cfg.depth * 2 * 2 * cfg.total_seq_len * inner  # qk^T + pv
-    head = 2 * d * cfg.total_tokens
-    emb = 2 * d  # lookups are gathers; negligible
-    fwd = matmul + attn + head + emb
-    return 3.0 * fwd  # fwd + 2x bwd
+from dalle_tpu.training.profiler import dalle_train_flops, detect_peak_tflops
 
 
 def main():
@@ -93,9 +67,8 @@ def main():
     dt = (time.perf_counter() - t0) / iters
 
     img_tokens_per_sec = batch * cfg.image_seq_len / dt / n_dev
-    seq_tokens = batch * cfg.total_seq_len
-    flops = transformer_flops_per_token(cfg) * seq_tokens
-    mfu = flops / dt / (detect_peak() * 1e12 * n_dev)
+    flops = dalle_train_flops(cfg, batch)
+    mfu = flops / dt / (detect_peak_tflops() * 1e12 * n_dev)
 
     print(
         json.dumps(

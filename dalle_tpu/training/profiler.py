@@ -1,0 +1,107 @@
+"""Profiling + MFU metering (aux parity: SURVEY.md §5.1).
+
+The reference's only profiling is the DeepSpeed flops profiler triggered at
+step 200 plus a hand-rolled samples/sec meter (reference:
+train_dalle.py:473-481,568-569,621-624).  TPU-native equivalents:
+
+  * ``profile_window``      — jax.profiler trace of a step range (the
+    ``--flops_profiler`` CLI flag drives this);
+  * ``dalle_train_flops``   — analytic fwd+bwd FLOPs for a DALLEConfig
+    (6N rule + attention), feeding
+  * ``Meter``               — tokens/sec, samples/sec and MFU against the
+    detected chip's bf16 peak;
+  * ``xla_cost_analysis``   — the compiler's own FLOP estimate for any
+    jitted function (cross-check for the analytic count).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+# bf16 peak TFLOP/s per chip (public specs)
+PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+
+def detect_peak_tflops(device: Optional[jax.Device] = None) -> float:
+    dev = device or jax.devices()[0]
+    kind = dev.device_kind.lower().replace(" ", "")
+    for name, peak in PEAK_TFLOPS.items():
+        if name in kind:
+            return peak
+    if "lite" in kind:  # "TPU v5 lite" == v5e
+        return PEAK_TFLOPS["v5e"]
+    if dev.platform == "cpu":
+        return 0.1  # placeholder so MFU stays finite in tests
+    return PEAK_TFLOPS["v4"]
+
+
+def dalle_train_flops(cfg, batch: int) -> float:
+    """Analytic fwd+bwd FLOPs per train step (matmul-dominated terms)."""
+    d = cfg.dim
+    inner = cfg.heads * cfg.dim_head
+    n = cfg.total_seq_len
+    tokens = batch * n
+    per_layer = 2 * d * 3 * inner + 2 * inner * d  # qkv + out proj
+    per_layer += 2 * d * (d * cfg.ff_mult * 2) + 2 * (d * cfg.ff_mult) * d  # GEGLU
+    matmul = cfg.depth * per_layer * tokens
+    attn = cfg.depth * 4 * inner * n * tokens  # qk^T + pv
+    head = 2 * d * cfg.total_tokens * tokens
+    fwd = matmul + attn + head
+    mult = 3.0  # fwd + 2x bwd
+    if getattr(cfg, "reversible", False):
+        mult += 1.0  # recompute in the inverted backward
+    return mult * fwd
+
+
+def xla_cost_analysis(jitted_fn, *args) -> dict:
+    """The compiler's own cost model for a jitted function."""
+    lowered = jitted_fn.lower(*args)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+@contextlib.contextmanager
+def profile_window(log_dir: str):
+    """jax.profiler trace context (view with tensorboard/xprof)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Meter:
+    """Throughput + MFU meter over a rolling step window
+    (supersedes the reference's sample_per_sec, train_dalle.py:621-624)."""
+
+    def __init__(self, flops_per_step: float, tokens_per_step: int,
+                 samples_per_step: int, window: int = 10):
+        self.flops = flops_per_step
+        self.tokens = tokens_per_step
+        self.samples = samples_per_step
+        self.window = window
+        self.peak = detect_peak_tflops() * 1e12 * len(jax.devices())
+        self._t0 = time.perf_counter()
+        self._steps = 0
+
+    def step(self) -> Optional[dict]:
+        """Call once per train step; every `window` steps returns metrics."""
+        self._steps += 1
+        if self._steps % self.window:
+            return None
+        dt = time.perf_counter() - self._t0
+        self._t0 = time.perf_counter()
+        per_step = dt / self.window
+        return {
+            "step_time_s": per_step,
+            "samples_per_sec": self.samples / per_step,
+            "tokens_per_sec": self.tokens / per_step,
+            "mfu": self.flops / per_step / self.peak,
+        }

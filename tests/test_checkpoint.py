@@ -104,3 +104,49 @@ def test_logging_facade(tmp_path):
     assert list((tmp_path / "t" / "media").glob("*.png"))
     grid = make_grid(np.zeros((5, 4, 4, 3)))
     assert grid.shape == (8, 16, 3)
+
+
+def test_profiler_meter_and_flops():
+    from dalle_tpu.models.dalle import DALLEConfig
+    from dalle_tpu.training.profiler import (
+        Meter,
+        dalle_train_flops,
+        detect_peak_tflops,
+    )
+
+    cfg = DALLEConfig(dim=64, depth=2, heads=2, dim_head=16,
+                      text_seq_len=8, image_fmap_size=4)
+    flops = dalle_train_flops(cfg, batch=4)
+    assert flops > 0
+    assert detect_peak_tflops() > 0
+    meter = Meter(flops, tokens_per_step=96, samples_per_step=4, window=2)
+    assert meter.step() is None
+    m = meter.step()
+    assert m and m["mfu"] >= 0 and m["samples_per_sec"] > 0
+
+
+def test_xla_cost_analysis_close_to_analytic(rng):
+    """The compiler's FLOP count should be within ~3x of the analytic
+    estimate (sanity for the MFU meter)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.training.profiler import dalle_train_flops, xla_cost_analysis
+
+    cfg = DALLEConfig(num_text_tokens=64, text_seq_len=8, num_image_tokens=32,
+                      image_fmap_size=4, dim=64, depth=2, heads=4, dim_head=16)
+    model = DALLE(cfg)
+    text = jnp.zeros((4, 8), jnp.int32)
+    codes = jnp.zeros((4, 16), jnp.int32)
+    params = model.init({"params": rng}, text, codes)["params"]
+
+    def loss_fn(p, t, c):
+        return model.apply({"params": p}, t, c, return_loss=True)
+
+    grad_fn = jax.jit(jax.grad(loss_fn))
+    ca = xla_cost_analysis(grad_fn, params, text, codes)
+    xla_flops = ca.get("flops", 0.0)
+    analytic = dalle_train_flops(cfg, 4)
+    if xla_flops > 0:
+        assert 0.2 < xla_flops / analytic < 5.0, (xla_flops, analytic)

@@ -285,8 +285,14 @@ def main(argv=None):
     epoch = start_epoch
     save("init")
 
+    from dalle_tpu.training.profiler import Meter, dalle_train_flops
+
+    meter = Meter(
+        flops_per_step=dalle_train_flops(cfg, args.batch_size),
+        tokens_per_step=args.batch_size * cfg.total_seq_len,
+        samples_per_step=args.batch_size,
+    )
     lr = args.learning_rate
-    t10 = time.perf_counter()
     for epoch in range(start_epoch, args.epochs):
         if hasattr(loader, "set_epoch"):
             loader.set_epoch(epoch)
@@ -306,18 +312,18 @@ def main(argv=None):
 
             if global_step != 0 and global_step % args.save_every_n_steps == 0:
                 save(f"step{global_step}")
-            if is_root and global_step % 10 == 0:
+            m = meter.step()
+            if is_root and m is not None:
                 avg_loss = float(distr.average_all(loss))
-                dt = time.perf_counter() - t10
-                t10 = time.perf_counter()
-                sps = args.batch_size * 10 / dt if global_step else 0.0
                 print(
                     f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
-                    f"lr {lr:.2e} ({sps:.1f} samples/s)"
+                    f"lr {lr:.2e} ({m['samples_per_sec']:.1f} samples/s, "
+                    f"MFU {m['mfu']:.1%})"
                 )
                 run.log(
                     {"loss": avg_loss, "lr": lr, "epoch": epoch,
-                     "sample_per_sec": sps},
+                     "sample_per_sec": m["samples_per_sec"],
+                     "tokens_per_sec": m["tokens_per_sec"], "mfu": m["mfu"]},
                     step=global_step,
                 )
             if is_root and global_step % 100 == 0 and global_step != 0:
