@@ -88,6 +88,24 @@ class TransformerConfig:
         return self.attn_types[i % len(self.attn_types)]
 
 
+def _constrain_activations(x, cfg: "TransformerConfig"):
+    """Pin the [b, n, d] activation sharding between layers: batch over
+    (dp, fsdp), sequence over sp when sequence parallelism is on.  Keeps
+    GSPMD's propagation from drifting at scale; no-op without a mesh."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        mesh = get_ambient_mesh()
+        if mesh is None:
+            return x
+        spec = PartitionSpec(("dp", "fsdp"), cfg.sp_axis, None)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except Exception:
+        return x
+
+
 def _layer_scale_init(layer_ind: int) -> float:
     """Depth-dependent LayerScale init (reference: transformer.py:40-54)."""
     if layer_ind < 18:
@@ -464,6 +482,7 @@ class Transformer(nn.Module):
         for attn, ff in self.pairs:
             x = x + attn(x, key_pad_mask=key_pad_mask, deterministic=deterministic)
             x = x + ff(x, deterministic=deterministic)
+            x = _constrain_activations(x, c)
         return x
 
     def _reversible_forward(self, x, key_pad_mask, deterministic):
