@@ -265,6 +265,22 @@ class DALLE(nn.Module):
     def init_cache(self, batch: int):
         return self.transformer.init_cache(batch)
 
+    def prefill(self, text, cache):
+        """Process the teacher-forced text prefix (positions 0..t-1 =
+        [<bos>, text[:-1]]) in ONE batched pass, filling the KV caches —
+        the scan then only covers image positions.  (The stable-mode 0.1/0.9
+        stop-grad mix is an inference no-op, so it is skipped here.)"""
+        c = self.cfg
+        b = text.shape[0]
+        remapped = self.remap_pad_tokens(text)
+        bos = jnp.zeros((b, 1), jnp.int32)
+        toks = jnp.concatenate([bos, remapped], axis=1)[:, : c.text_seq_len]
+        x = self.text_emb(toks)
+        if not c.rotary_emb:
+            x = x + self.text_pos_emb(jnp.arange(c.text_seq_len))[None]
+        _, cache = self.transformer.prefill(x, cache)
+        return cache
+
     def decode_step(self, combined_id, pos, cache, deterministic=True):
         """One AR step: embed token at ``pos``, run transformer decode, return
         (masked logits for position ``pos``, new cache)."""

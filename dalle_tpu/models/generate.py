@@ -37,7 +37,8 @@ PRIME_FRACTION = 0.4375
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "num_steps", "filter_thres", "temperature")
+    jax.jit,
+    static_argnames=("model", "num_steps", "start", "filter_thres", "temperature"),
 )
 def scan_decode(
     model: DALLE,
@@ -46,13 +47,23 @@ def scan_decode(
     forced_mask: jnp.ndarray,  # [n] bool: position is forced
     key: jax.Array,
     num_steps: int,
+    start: int = 0,
+    prefill_text: Optional[jnp.ndarray] = None,
     filter_thres: float = 0.9,
     temperature: float = 1.0,
 ):
-    """Run ``num_steps`` decode steps; returns sampled combined ids [b, n]
-    where entry p is the sample from position p's logits (= token p+1)."""
+    """Decode positions [start, start+num_steps); returns sampled combined
+    ids [b, num_steps] where entry i is the sample from position
+    (start+i)'s logits (= token start+i+1).  With ``start > 0``,
+    ``prefill_text`` fills the cache for positions [0, start) in one
+    batched pass instead of start scan iterations."""
     b = forced.shape[0]
     cache = model.apply({"params": params}, b, method=DALLE.init_cache)
+    if start > 0:
+        assert prefill_text is not None
+        cache = model.apply(
+            {"params": params}, prefill_text, cache, method=DALLE.prefill
+        )
     keys = jax.random.split(key, num_steps)
 
     def step(carry, inp):
@@ -68,7 +79,7 @@ def scan_decode(
         return (cache, sampled), sampled
 
     (_, _), samples = jax.lax.scan(
-        step, (cache, forced[:, 0]), (jnp.arange(num_steps), keys)
+        step, (cache, forced[:, 0]), (start + jnp.arange(num_steps), keys)
     )
     return samples.transpose(1, 0)  # [b, num_steps]
 
@@ -109,17 +120,21 @@ def generate_image_codes(
     """text [b, text_seq_len] → image codes [b, image_seq_len]."""
     c = model.cfg
     forced, mask = _build_forced(model, params, text, prime_codes)
+    # text prefix [0, t) prefills in one pass; the scan covers only the
+    # image positions [t, t + image_seq_len)
     samples = scan_decode(
         model,
         params,
         forced,
         mask,
         key,
-        num_steps=c.total_seq_len,
+        num_steps=c.image_seq_len,
+        start=c.text_seq_len,
+        prefill_text=text.astype(jnp.int32),
         filter_thres=filter_thres,
         temperature=temperature,
     )
-    img_samples = samples[:, c.text_seq_len :] - c.total_text_tokens
+    img_samples = samples - c.total_text_tokens
     codes = jnp.clip(img_samples, 0, c.num_image_tokens - 1)
     if prime_codes is not None:
         n_init = prime_codes.shape[1]

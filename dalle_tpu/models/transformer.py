@@ -300,6 +300,22 @@ class JointAttention(nn.Module):
             "v": jnp.zeros(shape, c.dtype),
         }
 
+    def prefill(self, x, cache):
+        """Teacher-forced prefix [b, L, dim] (text region, L <= text_seq_len):
+        one batched pass that computes outputs AND fills cache[:, :, :L]."""
+        c = self.cfg
+        b, L, _ = x.shape
+        q, k, v = self._heads(self.to_qkv(x), L)
+        if self._angles is not None:
+            ang = jnp.asarray(self._angles)[:L]
+            q, k = apply_rotary(q, ang), apply_rotary(k, ang)
+        ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(c.dtype), 0, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(c.dtype), 0, axis=2)
+        mask = jnp.asarray(_static_mask(c, self.attn_type)[:L, :L])
+        out = attn_ops._sdpa(q, k, v, mask[None, None])
+        out = out.transpose(0, 2, 1, 3).reshape(b, L, -1)
+        return self.to_out(out), {"k": ck, "v": cv}
+
     def decode_step(self, x_t, idx, cache, deterministic=True):
         """x_t: [b, dim] token at position idx; returns ([b, dim], cache')."""
         c = self.cfg
@@ -358,6 +374,19 @@ class CausalSGU(nn.Module):
     def init_cache(self, batch: int) -> Cache:
         c = self.cfg
         return {"v": jnp.zeros((batch, c.seq_len, self.inner // 2), c.dtype)}
+
+    def prefill(self, x, cache):
+        L = x.shape[1]
+        y = jax.nn.gelu(self.proj_in(x))
+        u, v = jnp.split(y, 2, axis=-1)
+        v = self.sgu_norm(v)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cache["v"], v.astype(self.cfg.dtype), 0, axis=1
+        )
+        w = self._gate_weight()[:L, :L]
+        b_row = self.spatial_b[:L]
+        gated = jnp.einsum("ij,bjd->bid", w, v) + b_row[None, :, None].astype(v.dtype)
+        return self.proj_out(u * gated), {"v": cv}
 
     def decode_step(self, x_t, idx, cache, deterministic=True):
         y = jax.nn.gelu(self.proj_in(x_t))
@@ -431,6 +460,26 @@ class SubLayer(nn.Module):
         if self._needs_hist():
             cache["hist"] = jnp.zeros((batch, c.seq_len, c.dim), c.dtype)
         return cache
+
+    def prefill(self, x, cache):
+        """Prefix pass over [b, L, dim] text-region positions."""
+        c = self.cfg
+        y = self.norm(x)
+        new_cache = dict(cache)
+        if self._shifts():
+            hist = jax.lax.dynamic_update_slice_in_dim(
+                cache["hist"], y.astype(c.dtype), 0, axis=1
+            )
+            new_cache["hist"] = hist
+            # all prefix positions are text region: text-half shift only
+            y = shift_tokens_full(y, y.shape[1], 0)
+        if self._is_attn:
+            y, new_cache["fn"] = self.fn.prefill(y, cache["fn"])
+        else:
+            y = self.fn(y, deterministic=True)
+        if c.sandwich_norm:
+            y = self.norm_out(y)
+        return y * self.scale.astype(y.dtype), new_cache
 
     def decode_step(self, x_t, idx, cache, deterministic=True):
         c = self.cfg
@@ -549,6 +598,30 @@ class Transformer(nn.Module):
             }
             for i, (attn, ff) in enumerate(self.pairs)
         }
+
+    def prefill(self, x, cache):
+        """Fill all layer caches for the prefix [b, L, dim]; returns
+        (outputs [b, L, dim], cache)."""
+        c = self.cfg
+        new_cache = {}
+        if c.reversible:
+            x1, x2 = x, x
+            for i, (attn, ff) in enumerate(self.pairs):
+                lc = cache[f"layer_{i}"]
+                da, ca = attn.prefill(x2, lc["attn"])
+                x1 = x1 + da
+                df, cf = ff.prefill(x1, lc["ff"])
+                x2 = x2 + df
+                new_cache[f"layer_{i}"] = {"attn": ca, "ff": cf}
+            return (x1 + x2) / 2, new_cache
+        for i, (attn, ff) in enumerate(self.pairs):
+            lc = cache[f"layer_{i}"]
+            da, ca = attn.prefill(x, lc["attn"])
+            x = x + da
+            df, cf = ff.prefill(x, lc["ff"])
+            x = x + df
+            new_cache[f"layer_{i}"] = {"attn": ca, "ff": cf}
+        return x, new_cache
 
     def decode_step(self, x_t, idx, cache, deterministic=True):
         c = self.cfg

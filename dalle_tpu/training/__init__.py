@@ -2,6 +2,7 @@ from dalle_tpu.training.train_lib import (  # noqa: F401
     count_params,
     get_learning_rate,
     init_train_state,
+    make_clip_train_step,
     make_dalle_eval_step,
     make_dalle_train_step,
     make_optimizer,

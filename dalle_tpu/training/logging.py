@@ -50,6 +50,7 @@ class Run:
         name: Optional[str] = None,
         use_wandb: bool = True,
         resume: bool = False,
+        entity: Optional[str] = None,
     ):
         self._wandb = None
         if use_wandb:
@@ -62,6 +63,7 @@ class Run:
                     config=config or {},
                     name=name,
                     resume=resume,
+                    entity=entity,
                 )
             except Exception:
                 self._wandb = None

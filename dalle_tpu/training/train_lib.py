@@ -168,6 +168,38 @@ def make_dalle_eval_step(model: DALLE, mesh, vae: Optional[DiscreteVAE] = None):
     return wrapped
 
 
+def make_clip_train_step(clip, tx: optax.GradientTransformation, mesh):
+    """CLIP contrastive training step (the reference trains CLIP only via a
+    README snippet, reference: README.md:210-235 — here it is a first-class
+    jitted step): step(params, opt_state, text, images, key)."""
+    bspec = batch_sharding(mesh)
+
+    def step(params, opt_state, text, images, key):
+        def loss_fn(p):
+            return clip.apply(
+                {"params": p},
+                text,
+                images,
+                return_loss=True,
+                deterministic=False,
+                rngs={"dropout": key},
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+
+    def wrapped(params, opt_state, text, images, key):
+        return jstep(
+            params, opt_state, jax.device_put(text, bspec),
+            jax.device_put(images, bspec), key,
+        )
+
+    return wrapped
+
+
 def make_vae_train_step(model: DiscreteVAE, tx: optax.GradientTransformation, mesh):
     """Returns ``step(params, opt_state, images, temp, key) ->
     (params, opt_state, loss, recons)``.  Temperature is traced so Gumbel

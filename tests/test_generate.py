@@ -3,6 +3,7 @@ CLIP rerank wiring, and distribution-parity of sampled tokens vs the
 logits-mask contract."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -99,3 +100,49 @@ def test_generate_texts(rng):
     prompt = text[:, :2]
     out2 = generate_texts(model, params, rng, text=prompt)
     np.testing.assert_array_equal(np.asarray(out2[:, :2]), np.asarray(prompt))
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(attn_types=("full",)),
+        dict(attn_types=("axial_row", "axial_col")),
+        dict(attn_types=("conv_like",), kernel_size=2),
+        dict(attn_types=("sparse",), sparse_block=4),
+        dict(attn_types=("full", "mlp")),
+        dict(attn_types=("full",), shift_tokens=True),
+        dict(attn_types=("full",), rotary_emb=True),
+        dict(attn_types=("full",), reversible=True),
+    ],
+    ids=["full", "axial", "conv", "sparse", "mlp", "shift", "rotary", "rev"],
+)
+def test_prefill_matches_stepwise_decode(rng, kw):
+    """Greedy decode with text-prefix prefill == greedy decode stepping
+    through every position — pins the prefill cache fill for each layer
+    type."""
+    from dalle_tpu.models.generate import scan_decode
+
+    model, params, text, codes = build(rng, **kw)
+    c = model.cfg
+    forced = jnp.concatenate(
+        [
+            jnp.zeros((2, 1), jnp.int32),
+            model.apply({"params": params}, text, method=type(model).remap_pad_tokens),
+        ],
+        axis=1,
+    )
+    n = c.total_seq_len
+    pad = jnp.zeros((2, n - forced.shape[1]), jnp.int32)
+    forced = jnp.concatenate([forced, pad], axis=1)
+    mask = jnp.zeros((n,), bool).at[: c.text_seq_len + 1].set(True)
+
+    full = scan_decode(
+        model, params, forced, mask, rng, num_steps=n,
+        filter_thres=0.0, temperature=1e-8,
+    )[:, c.text_seq_len :]
+    pre = scan_decode(
+        model, params, forced, mask, rng, num_steps=c.image_seq_len,
+        start=c.text_seq_len, prefill_text=text.astype(jnp.int32),
+        filter_thres=0.0, temperature=1e-8,
+    )
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(full))

@@ -260,6 +260,7 @@ def main(argv=None):
         name=args.wandb_name,
         use_wandb=not args.no_wandb,
         resume=resume_meta is not None,
+        entity=args.wandb_entity,
     ) if is_root else None
     if is_root:
         print(f"DALLE params: {count_params(params):,}")
