@@ -35,3 +35,20 @@ def __getattr__(name):
 
         return getattr(importlib.import_module(_EXPORTS[name]), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def force_cpu_if_virtual():
+    """Honor ``--xla_force_host_platform_device_count`` in ``XLA_FLAGS``.
+
+    A TPU plugin's site hook may re-export ``JAX_PLATFORMS`` to its own
+    platform after the user set ``JAX_PLATFORMS=cpu``, which makes virtual
+    multi-device CPU runs (tests, dryruns, CI) silently attach to — and
+    block on — the real accelerator.  The post-import config update wins
+    over the env var, so CLIs call this before any jax use.
+    """
+    import os
+
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

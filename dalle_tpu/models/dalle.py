@@ -66,6 +66,8 @@ class DALLEConfig:
     sparse_random_blocks: Optional[int] = None
     use_flash: Optional[bool] = None  # None = auto (Pallas kernel on TPU)
     sp_axis: Optional[str] = None  # ring-attention sequence parallelism
+    pp_stages: int = 1  # GPipe pipeline parallelism over the 'pp' mesh axis
+    pp_microbatches: int = 4
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -112,6 +114,8 @@ class DALLEConfig:
             sparse_random_blocks=self.sparse_random_blocks,
             use_flash=self.use_flash,
             sp_axis=self.sp_axis,
+            pp_stages=self.pp_stages,
+            pp_microbatches=self.pp_microbatches,
             dtype=self.dtype,
         )
 

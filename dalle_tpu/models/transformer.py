@@ -78,6 +78,14 @@ class TransformerConfig:
     # sequence parallelism: mesh axis name for ring attention on 'full'
     # layers (requires an ambient mesh via jax.set_mesh); None = off
     sp_axis: Optional[str] = None
+    # pipeline parallelism: >1 partitions the depth into contiguous stages
+    # executed with a GPipe microbatch schedule over the 'pp' mesh axis
+    # (parallel/pipeline.py).  Requires depth % pp_stages == 0 and the
+    # attn_types cycle to divide the per-stage depth (so every stage runs
+    # the same SPMD program).  Absent in the reference (SURVEY.md §2.10).
+    pp_stages: int = 1
+    pp_microbatches: int = 4
+    pp_axis: str = "pp"
     dtype: Any = jnp.float32
 
     @property
@@ -502,13 +510,94 @@ class SubLayer(nn.Module):
         return y * self.scale.astype(y.dtype), new_cache
 
 
+class TransformerStage(nn.Module):
+    """A contiguous slice of the stack: one pipeline stage.
+
+    Holds ``depth // pp_stages`` (attn, ff) pairs.  Layer names are
+    stage-local so every stage has an identical parameter *structure* —
+    the GPipe executor applies one generic stage program to per-stage
+    weight slices (SPMD requirement).  The attn-type cycle is validated by
+    the owning Transformer so the type sequence is also stage-invariant.
+    """
+
+    cfg: TransformerConfig
+    stage_ind: int = 0
+
+    def setup(self):
+        c = self.cfg
+        per = c.depth // c.pp_stages
+        layer_cls = nn.remat(SubLayer) if c.use_remat else SubLayer
+        pairs = []
+        for j in range(per):
+            gi = self.stage_ind * per + j  # global index (LayerScale init)
+            atype = c.attn_type_for_layer(gi)
+            pairs.append(
+                (
+                    layer_cls(c, gi, f"attn:{atype}", name=f"layer_{j}_attn"),
+                    layer_cls(c, gi, "ff", name=f"layer_{j}_ff"),
+                )
+            )
+        self.pairs = pairs
+
+    def __call__(self, x, key_pad_mask=None, deterministic=True):
+        for attn, ff in self.pairs:
+            x = x + attn(x, key_pad_mask=key_pad_mask, deterministic=deterministic)
+            x = x + ff(x, deterministic=deterministic)
+        return x
+
+    def init_cache(self, batch: int) -> Cache:
+        return {
+            f"layer_{j}": {"attn": attn.init_cache(batch), "ff": ff.init_cache(batch)}
+            for j, (attn, ff) in enumerate(self.pairs)
+        }
+
+    def prefill(self, x, cache):
+        new_cache = {}
+        for j, (attn, ff) in enumerate(self.pairs):
+            lc = cache[f"layer_{j}"]
+            da, ca = attn.prefill(x, lc["attn"])
+            x = x + da
+            df, cf = ff.prefill(x, lc["ff"])
+            x = x + df
+            new_cache[f"layer_{j}"] = {"attn": ca, "ff": cf}
+        return x, new_cache
+
+    def decode_step(self, x_t, idx, cache, deterministic=True):
+        new_cache = {}
+        for j, (attn, ff) in enumerate(self.pairs):
+            lc = cache[f"layer_{j}"]
+            da, ca = attn.decode_step(x_t, idx, lc["attn"], deterministic)
+            x_t = x_t + da
+            df, cf = ff.decode_step(x_t, idx, lc["ff"], deterministic)
+            x_t = x_t + df
+            new_cache[f"layer_{j}"] = {"attn": ca, "ff": cf}
+        return x_t, new_cache
+
+
 class Transformer(nn.Module):
-    """The stack.  Sequential or reversible execution, full or decode mode."""
+    """The stack.  Sequential, reversible, or pipelined execution; full or
+    decode mode."""
 
     cfg: TransformerConfig
 
     def setup(self):
         c = self.cfg
+        if c.pp_stages > 1:
+            assert not c.reversible, "reversible + pipeline not supported"
+            assert c.depth % c.pp_stages == 0, (
+                f"depth {c.depth} not divisible by pp_stages {c.pp_stages}"
+            )
+            per = c.depth // c.pp_stages
+            assert per % len(c.attn_types) == 0, (
+                "attn_types cycle must divide the per-stage depth so every "
+                f"stage runs the same program (cycle {len(c.attn_types)}, "
+                f"per-stage {per})"
+            )
+            self.stages = [
+                TransformerStage(c, s, name=f"stage_{s}")
+                for s in range(c.pp_stages)
+            ]
+            return
         # use_remat: recompute each sublayer in backward instead of storing
         # activations — the idiomatic JAX stand-in for the reference's
         # reversible autograd trick (reference: reversible.py:108-124).
@@ -526,6 +615,8 @@ class Transformer(nn.Module):
 
     def __call__(self, x, key_pad_mask=None, deterministic=True):
         c = self.cfg
+        if c.pp_stages > 1:
+            return self._pipeline_forward(x, key_pad_mask, deterministic)
         if c.reversible:
             return self._reversible_forward(x, key_pad_mask, deterministic)
         for attn, ff in self.pairs:
@@ -533,6 +624,76 @@ class Transformer(nn.Module):
             x = x + ff(x, deterministic=deterministic)
             x = _constrain_activations(x, c)
         return x
+
+    def _pipeline_forward(self, x, key_pad_mask, deterministic):
+        """GPipe over the ``pp`` mesh axis (parallel/pipeline.py).
+
+        Falls back to the mathematically-identical sequential stage loop
+        during init, without an ambient mesh whose ``pp`` size matches, or
+        when a key-pad mask is routed (per-microbatch arg routing is not
+        wired; the reference never trains DALLE with a pad mask either).
+        """
+        import flax.core as _core
+
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        c = self.cfg
+        mesh = get_ambient_mesh()
+        pp_size = (
+            dict(zip(mesh.axis_names, mesh.devices.shape)).get(c.pp_axis, 1)
+            if mesh is not None
+            else 1
+        )
+        bound = self.scope is not None and not self.is_initializing()
+        if (
+            not bound
+            or key_pad_mask is not None
+            or pp_size != c.pp_stages
+        ):
+            if bound and pp_size != c.pp_stages:
+                import warnings
+
+                warnings.warn(
+                    f"pp_stages={c.pp_stages} but mesh axis '{c.pp_axis}' has "
+                    f"size {pp_size}: running stages SEQUENTIALLY (no "
+                    "pipelining). Set --mesh_pp to match --pp_stages.",
+                    stacklevel=2,
+                )
+            for st in self.stages:
+                x = st(x, key_pad_mask=key_pad_mask, deterministic=deterministic)
+                x = _constrain_activations(x, c)
+            return x
+
+        from dalle_tpu.parallel.pipeline import gpipe, stack_stage_params
+
+        stacked = stack_stage_params(
+            [_core.freeze(st.variables["params"]) for st in self.stages]
+        )
+        need_drop = (not deterministic) and (c.attn_dropout > 0 or c.ff_dropout > 0)
+        key = self.make_rng("dropout") if need_drop else jax.random.PRNGKey(0)
+        generic = self.stages[0]
+
+        def stage_fn(p, y, stage_idx, mb_idx, k):
+            rngs = None
+            if need_drop:
+                rngs = {
+                    "dropout": jax.random.fold_in(
+                        jax.random.fold_in(k, stage_idx), mb_idx
+                    )
+                }
+            return generic.clone().apply(
+                {"params": p}, y, deterministic=deterministic, rngs=rngs
+            )
+
+        return gpipe(
+            stage_fn,
+            stacked,
+            x,
+            mesh=mesh,
+            axis=c.pp_axis,
+            num_microbatches=c.pp_microbatches,
+            extra=key,
+        )
 
     def _reversible_forward(self, x, key_pad_mask, deterministic):
         """RevNet coupling (reference: reversible.py:143-157): duplicate the
@@ -591,6 +752,11 @@ class Transformer(nn.Module):
         return reversible_sequence(fs, gs, params, x)
 
     def init_cache(self, batch: int) -> Cache:
+        if self.cfg.pp_stages > 1:
+            return {
+                f"stage_{s}": st.init_cache(batch)
+                for s, st in enumerate(self.stages)
+            }
         return {
             f"layer_{i}": {
                 "attn": attn.init_cache(batch),
@@ -604,6 +770,12 @@ class Transformer(nn.Module):
         (outputs [b, L, dim], cache)."""
         c = self.cfg
         new_cache = {}
+        if c.pp_stages > 1:
+            # decode is latency-bound, not stage-parallel: run stages in
+            # sequence (identical math; generation under a pp-trained model)
+            for s, st in enumerate(self.stages):
+                x, new_cache[f"stage_{s}"] = st.prefill(x, cache[f"stage_{s}"])
+            return x, new_cache
         if c.reversible:
             x1, x2 = x, x
             for i, (attn, ff) in enumerate(self.pairs):
@@ -626,6 +798,12 @@ class Transformer(nn.Module):
     def decode_step(self, x_t, idx, cache, deterministic=True):
         c = self.cfg
         new_cache = {}
+        if c.pp_stages > 1:
+            for s, st in enumerate(self.stages):
+                x_t, new_cache[f"stage_{s}"] = st.decode_step(
+                    x_t, idx, cache[f"stage_{s}"], deterministic
+                )
+            return x_t, new_cache
         if c.reversible:
             x1, x2 = x_t, x_t
             for i, (attn, ff) in enumerate(self.pairs):

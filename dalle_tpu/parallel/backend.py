@@ -100,8 +100,8 @@ class SingleBackend(Backend):
 
     BACKEND_NAME = "single"
 
-    def initialize(self, dp=-1, fsdp=1, tp=1, sp=1, **kw):
-        self.mesh = mesh_lib.make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+    def initialize(self, dp=-1, fsdp=1, tp=1, sp=1, pp=1, ep=1, **kw):
+        self.mesh = mesh_lib.make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp, pp=pp, ep=ep)
         self._initialized = True
         return self
 
@@ -159,6 +159,8 @@ class JaxBackend(SingleBackend):
         fsdp=1,
         tp=1,
         sp=1,
+        pp=1,
+        ep=1,
         **kw,
     ):
         if coordinator_address is not None:
@@ -169,7 +171,7 @@ class JaxBackend(SingleBackend):
             )
         elif jax.process_count() == 1 and num_processes not in (None, 1):
             jax.distributed.initialize()
-        self.mesh = mesh_lib.make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+        self.mesh = mesh_lib.make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp, pp=pp, ep=ep)
         self._initialized = True
         return self
 

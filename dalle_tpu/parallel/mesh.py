@@ -2,8 +2,12 @@
 
 Replaces the reference's launcher-spawned process groups + NCCL rendezvous
 (reference: dalle_pytorch/distributed_backends/deepspeed_backend.py:36-39,
-horovod_backend.py:20-23) with one logical 4-axis mesh:
+horovod_backend.py:20-23) with one logical 6-axis mesh:
 
+  * ``pp``   — pipeline parallelism (GPipe microbatch schedule over
+               ``shard_map``+``ppermute``; see parallel/pipeline.py.  The
+               outermost axis: stage hand-offs are point-to-point and per
+               microbatch, so this is the axis that can ride DCN)
   * ``dp``   — data parallelism (gradient psum rides ICI)
   * ``fsdp`` — ZeRO-equivalent: params/optimizer-state sharded, batch also
                split along this axis (the reference reaches ZeRO via the
@@ -12,10 +16,12 @@ horovod_backend.py:20-23) with one logical 4-axis mesh:
                the reference, SURVEY.md §2.10 "NOT present")
   * ``sp``   — sequence/context parallelism (ring attention; absent in the
                reference, SURVEY.md §5.7)
+  * ``ep``   — expert parallelism (MoE expert weights sharded; token
+               dispatch collectives inserted by GSPMD)
 
 XLA's GSPMD inserts the collectives; multi-host slices map the mesh so that
-dp/fsdp inner axes ride ICI and any DCN boundary lands on the outermost axis
-(`jax.experimental.mesh_utils` hybrid ordering).
+frequently-communicating inner axes ride ICI and any DCN boundary lands on
+the outermost axis (`jax.experimental.mesh_utils` hybrid ordering).
 """
 
 from __future__ import annotations
@@ -26,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("pp", "dp", "fsdp", "tp", "sp", "ep")
 BATCH_AXES = ("dp", "fsdp")  # batch dim is split over both
 
 
@@ -35,12 +41,14 @@ def make_mesh(
     fsdp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build the 4-axis mesh; a single -1 axis absorbs remaining devices."""
+    """Build the 6-axis mesh; a single -1 axis absorbs remaining devices."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    sizes = [dp, fsdp, tp, sp]
+    sizes = [pp, dp, fsdp, tp, sp, ep]
     unknown = [i for i, s in enumerate(sizes) if s == -1]
     known = int(np.prod([s for s in sizes if s != -1]))
     if unknown:
@@ -84,7 +92,7 @@ def get_ambient_mesh() -> Optional[Mesh]:
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     dev = device if device is not None else jax.devices()[0]
-    return Mesh(np.asarray([dev]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.asarray([dev]).reshape((1,) * len(AXES)), AXES)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

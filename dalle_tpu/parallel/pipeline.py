@@ -1,0 +1,116 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+The reference has NO pipeline parallelism — its distributed surface is data
+parallelism (+ ZeRO sharding) only (SURVEY.md §2.10).  This module adds the
+real thing, TPU-native: transformer stages are assigned to devices along the
+``pp`` axis; microbatches stream through the stages with one
+``jax.lax.ppermute`` hop per tick (point-to-point over ICI/DCN), following
+the classic GPipe schedule — M microbatches through S stages complete in
+M + S - 1 ticks with an (S-1)/(M+S-1) bubble.
+
+Everything is differentiable: the schedule is a ``lax.scan``, the stage
+hand-off is ``ppermute`` (whose transpose is the reverse permutation), so
+``jax.grad`` through :func:`gpipe` yields the standard backward pipeline for
+free — no hand-written 1F1B needed for correctness (1F1B is a later
+scheduling optimization).
+
+Layout contract: ``stacked_params`` has a leading stage axis of size S on
+every leaf, sharded ``P('pp')``; each device slices its own stage's weights
+inside the ``shard_map`` region, so weight storage is genuinely partitioned
+across the pipeline.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh,
+    axis: str = "pp",
+    num_microbatches: int = 4,
+    extra: Any = None,
+):
+    """Run ``x`` through S pipeline stages with a GPipe microbatch schedule.
+
+    Args:
+      stage_fn: ``(params_one_stage, x_mb, stage_idx, mb_idx, extra) -> y_mb``
+        applied by every device to its resident stage.  Must be the same
+        traced computation for all stages (SPMD) — only the weights differ.
+      stacked_params: pytree whose leaves carry a leading axis of size
+        ``mesh.shape[axis]`` (one slice per stage).
+      x: [b, ...] global input batch (replicated w.r.t. ``axis``).
+      num_microbatches: M; b % M == 0.  Larger M shrinks the pipeline bubble.
+      extra: optional pytree broadcast to every stage invocation (e.g. a
+        dropout PRNG key).
+
+    Returns [b, ...] output of the final stage, replicated over ``axis``.
+    """
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    S = shape[axis]
+    M = num_microbatches
+    # batch stays sharded over (dp, fsdp) THROUGH the pipeline region — each
+    # data-parallel group pipelines its own shard; shard_map's transpose
+    # rule psums the weight cotangents over the replicated axes.  (tp is
+    # replicated inside stages for now: manual-collective tensor parallelism
+    # within the shard_map region is a future optimization.)
+    dp_axes = tuple(a for a in ("dp", "fsdp") if a in shape)
+    dp_total = 1
+    for a in dp_axes:
+        dp_total *= shape[a]
+    b_local = x.shape[0] // dp_total
+    assert b_local % M == 0, (
+        f"per-dp-shard batch {b_local} not divisible by {M} microbatches"
+    )
+
+    def run(params, x_full, extra_in):
+        my_params = jax.tree_util.tree_map(lambda a: a[0], params)
+        idx = jax.lax.axis_index(axis)
+        b = x_full.shape[0]  # local (dp-sharded) batch
+        xm = x_full.reshape(M, b // M, *x_full.shape[1:])
+        T = M + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            buf, outputs = carry
+            feed = xm[jnp.clip(t, 0, M - 1)]
+            inp = jnp.where(idx == 0, feed, buf)
+            out = stage_fn(my_params, inp, idx, jnp.clip(t - idx, 0, M - 1), extra_in)
+            # the last stage banks its result for microbatch t-(S-1)
+            oidx = jnp.clip(t - (S - 1), 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, oidx, 0, keepdims=False)
+            banked = jnp.where((idx == S - 1) & (t >= S - 1), out, prev)
+            outputs = jax.lax.dynamic_update_index_in_dim(outputs, banked, oidx, 0)
+            # hand my activation to the next stage (ring hop; stage 0's
+            # incoming value is ignored — it always reads from xm)
+            buf_next = jax.lax.ppermute(out, axis, perm)
+            return (buf_next, outputs), None
+
+        outputs0 = jnp.zeros_like(xm)
+        buf0 = jnp.zeros_like(xm[0])
+        (_, outputs), _ = jax.lax.scan(tick, (buf0, outputs0), jnp.arange(T))
+        # replicate the final-stage outputs to every pp rank
+        gathered = jax.lax.all_gather(outputs, axis)  # [S, M, mb, ...]
+        return gathered[S - 1].reshape(b, *x_full.shape[1:])
+
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(axis), P(dp_axes), P()),
+        out_specs=P(dp_axes),
+        check_vma=False,
+    )(stacked_params, x, extra)
+
+
+def stack_stage_params(stage_param_trees):
+    """[tree_s for s in stages] -> one tree with leading stage axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_param_trees)

@@ -53,6 +53,9 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    import dalle_tpu
+
+    dalle_tpu.force_cpu_if_virtual()
     args = parse_args(argv)
     tokenizer = get_tokenizer(bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese)
 

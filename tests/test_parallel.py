@@ -44,10 +44,10 @@ def dalle_cfg(**kw):
 def test_mesh_shapes(devices):
     mesh = make_mesh(dp=2, fsdp=2, tp=2)
     assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-        "dp": 2, "fsdp": 2, "tp": 2, "sp": 1,
+        "pp": 1, "dp": 2, "fsdp": 2, "tp": 2, "sp": 1, "ep": 1,
     }
     mesh2 = make_mesh(dp=-1, tp=2)
-    assert mesh2.devices.shape[0] == 4
+    assert dict(zip(mesh2.axis_names, mesh2.devices.shape))["dp"] == 4
 
 
 def test_param_specs_tp_and_fsdp(rng):

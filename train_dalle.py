@@ -104,6 +104,11 @@ def parse_args(argv=None):
     parser.add_argument("--ff_dropout", type=float, default=0.0)
     parser.add_argument("--num_text_tokens", type=int, default=None,
                         help="default: tokenizer vocab size")
+    parser.add_argument("--pp_stages", type=int, default=1,
+                        help="pipeline-parallel stages (needs --mesh_pp)")
+    parser.add_argument("--pp_microbatches", type=int, default=4)
+    parser.add_argument("--sp_ring", action="store_true",
+                        help="ring-attention sequence parallelism over mesh_sp")
     parser = backend_lib.wrap_arg_parser(parser)
     return parser.parse_args(argv)
 
@@ -138,11 +143,14 @@ def resolve_vae(args, resume_meta):
 
 
 def main(argv=None):
+    import dalle_tpu
+
+    dalle_tpu.force_cpu_if_virtual()
     args = parse_args(argv)
     distr = backend_lib.set_backend_from_args(args)
     mesh_kw = {
         ax: getattr(args, f"mesh_{ax}")
-        for ax in ("dp", "fsdp", "tp", "sp")
+        for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep")
         if getattr(args, f"mesh_{ax}", None)
     }
     distr.initialize(**mesh_kw)
@@ -187,6 +195,9 @@ def main(argv=None):
             rotary_emb=args.rotary_emb,
             reversible=args.reversible,
             use_remat=args.use_remat,
+            pp_stages=args.pp_stages,
+            pp_microbatches=args.pp_microbatches,
+            sp_axis="sp" if args.sp_ring else None,
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         )
     model = DALLE(cfg)
@@ -246,7 +257,15 @@ def main(argv=None):
             resume_meta["params"],
             jax.tree_util.tree_map(lambda x: x.sharding, params),
         )
-    vae_params = jax.device_put(vae_params) if vae_params is not None else None
+    # replicate the (frozen, small) VAE params onto THIS run's mesh — the
+    # checkpoint may have been written under a different mesh shape
+    from dalle_tpu.parallel.mesh import replicated
+
+    vae_params = (
+        jax.device_put(vae_params, replicated(distr.mesh))
+        if vae_params is not None
+        else None
+    )
     step_fn = make_dalle_train_step(model, tx, distr.mesh, vae=vae)
 
     sched = ReduceLROnPlateau(lr=args.learning_rate) if args.lr_decay else None

@@ -63,11 +63,14 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    import dalle_tpu
+
+    dalle_tpu.force_cpu_if_virtual()
     args = parse_args(argv)
     distr = backend_lib.set_backend_from_args(args)
     mesh_kw = {
         ax: getattr(args, f"mesh_{ax}")
-        for ax in ("dp", "fsdp", "tp", "sp")
+        for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep")
         if getattr(args, f"mesh_{ax}", None)
     }
     distr.initialize(**mesh_kw)
