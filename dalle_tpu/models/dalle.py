@@ -68,6 +68,11 @@ class DALLEConfig:
     sp_axis: Optional[str] = None  # ring-attention sequence parallelism
     pp_stages: int = 1  # GPipe pipeline parallelism over the 'pp' mesh axis
     pp_microbatches: int = 4
+    moe_experts: int = 0  # >0: every moe_every-th FF is a routed MoE ('ep' axis)
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -116,6 +121,11 @@ class DALLEConfig:
             sp_axis=self.sp_axis,
             pp_stages=self.pp_stages,
             pp_microbatches=self.pp_microbatches,
+            moe_experts=self.moe_experts,
+            moe_every=self.moe_every,
+            moe_top_k=self.moe_top_k,
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_aux_weight=self.moe_aux_weight,
             dtype=self.dtype,
         )
 

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts feed-forward with expert parallelism (``ep`` axis).
+
+Beyond-reference capability: the reference's FF is always dense
+(reference: dalle_pytorch/transformer.py:72-88); this adds a GShard/Switch
+style sparsely-activated FF so the framework's parallelism surface covers
+expert parallelism alongside dp/fsdp/tp/sp/pp.
+
+TPU-first design choices:
+  * **dense dispatch** — routing is expressed as einsums against a one-hot
+    dispatch tensor (no scatter/gather, no dynamic shapes; everything lands
+    on the MXU and GSPMD inserts the token all-to-all when experts are
+    sharded over ``ep``);
+  * **per-sequence routing groups** — capacity competition is confined to a
+    single batch row ([b, n, d] inputs) or a single token ([b, d] decode
+    inputs), so (a) generation is batch-size independent — decode capacity
+    is per-token, tokens never compete across samples — and (b) dispatch
+    memory is O(b · n²/E) instead of O((b·n)²/E);
+  * **causal slot assignment** — slots are assigned by one cumulative sum
+    in (token, round) lexicographic order, so whether position p keeps its
+    expert slot depends only on positions < p (and p's own earlier rounds),
+    never on future targets: teacher-forced training matches step-wise
+    decode whenever no token is actually dropped;
+  * **static capacity** — ``capacity_factor`` bounds per-expert work;
+    overflow tokens fall through the residual connection (standard GShard
+    semantics), keeping shapes static for XLA;
+  * **top-k routing with renormalized gates** and the Switch load-balancing
+    auxiliary loss ``E · Σ_e f_e · p_e``, sown into the ``losses`` collection
+    (train steps add it to the task loss; under reversible or pipelined
+    execution the detached sublayer apply cannot propagate it — the
+    Transformer warns in those modes).
+
+Expert weights are stacked [E, ...] and sharded over ``ep`` via
+partition.py rules (``experts_wi`` / ``experts_wo``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _route(gates: jnp.ndarray, top_k: int, capacity: int):
+    """gates: [g, G, E] softmax probs over experts, per routing group.
+
+    Returns (dispatch [g, G, E, C], combine [g, G, E, C], aux scalar).
+
+    Slot positions are assigned with a single cumulative sum in
+    (token, round) order within each group: strictly causal, at most one
+    token per (expert, slot), at most ``top_k`` slots per token.
+    """
+    g, G, E = gates.shape
+    K = min(top_k, E)  # re-selecting an exhausted expert would double-dispatch
+
+    # routing choices per round (capacity-independent)
+    remaining = gates
+    choices = []  # K x [g, G, E] one-hots
+    for _ in range(K):
+        e_k = jnp.argmax(remaining, axis=-1)
+        oh = jax.nn.one_hot(e_k, E, dtype=gates.dtype)
+        choices.append(oh)
+        remaining = remaining * (1.0 - oh)
+    # (token, round)-major sequence of one-hots: [g, G*K, E]
+    oh_seq = jnp.stack(choices, axis=2).reshape(g, G * K, E)
+    # causal position within the chosen expert
+    csum = jnp.cumsum(oh_seq, axis=1) - oh_seq
+    pos = jnp.sum(csum * oh_seq, axis=-1).astype(jnp.int32)  # [g, G*K]
+    keep = (pos < capacity).astype(gates.dtype)
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [g, G*K, C]
+    slot = oh_seq[..., None] * pos_oh[:, :, None, :] * keep[..., None, None]
+    slot = slot.reshape(g, G, K, E, capacity)
+
+    gate_k = jnp.einsum("gte,gtke->gtk", gates, slot.sum(-1))  # kept gates
+    dispatch = slot.sum(axis=2)  # [g, G, E, C]
+    combine = jnp.einsum("gtkec,gtk->gtec", slot, gate_k)
+    denom = jnp.maximum(gate_k.sum(-1), 1e-9)  # renormalize over kept experts
+    combine = combine / denom[..., None, None]
+
+    # Switch load-balance loss: fraction routed (first choice) x mean prob
+    f = jnp.mean(choices[0], axis=(0, 1))
+    p = jnp.mean(gates, axis=(0, 1))
+    aux = E * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+class MoEFeedForward(nn.Module):
+    """Drop-in replacement for ``FeedForward``: GEGLU experts, top-k routing.
+
+    Accepts [b, n, dim] (training: each row is a routing group) or [b, dim]
+    (decode: each token its own group — no cross-sample competition).
+    """
+
+    cfg: "TransformerConfig"  # noqa: F821  (transformer.TransformerConfig)
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        c = self.cfg
+        E = c.moe_experts
+        inner = c.dim * c.ff_mult
+        lead = x.shape[:-1]
+        xg = x.reshape((-1, x.shape[-2] if x.ndim >= 3 else 1, c.dim))
+        g, G, _ = xg.shape
+        K = min(c.moe_top_k, E)
+        capacity = max(1, math.ceil(G * K * c.moe_capacity_factor / E))
+
+        router = nn.Dense(E, use_bias=False, dtype=jnp.float32, name="router")
+        gates = jax.nn.softmax(router(xg.astype(jnp.float32)), axis=-1)
+        dispatch, combine, aux = _route(gates, K, capacity)
+        self.sow("losses", "moe_aux", c.moe_aux_weight * aux)
+
+        wi = self.param(
+            "experts_wi",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (E, c.dim, inner * 2),
+        )
+        wo = self.param(
+            "experts_wo",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (E, inner, c.dim),
+        )
+        expert_in = jnp.einsum(
+            "gtec,gtd->gecd", dispatch.astype(c.dtype), xg.astype(c.dtype)
+        )
+        h = jnp.einsum("gecd,edf->gecf", expert_in, wi.astype(c.dtype))
+        u, gate = jnp.split(h, 2, axis=-1)
+        h = u * jax.nn.gelu(gate)
+        h = nn.Dropout(c.ff_dropout)(h, deterministic=deterministic)
+        expert_out = jnp.einsum("gecf,efd->gecd", h, wo.astype(c.dtype))
+        y = jnp.einsum("gtec,gecd->gtd", combine.astype(c.dtype), expert_out)
+        return y.reshape(*lead, c.dim)

@@ -86,6 +86,14 @@ class TransformerConfig:
     pp_stages: int = 1
     pp_microbatches: int = 4
     pp_axis: str = "pp"
+    # mixture-of-experts FF (models/moe.py): every moe_every-th block's FF
+    # becomes a top-k routed expert layer; expert weights shard over 'ep'.
+    # Beyond-reference (the reference FF is always dense, transformer.py:72-88).
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     dtype: Any = jnp.float32
 
     @property
@@ -428,6 +436,13 @@ class SubLayer(nn.Module):
                 self.fn = CausalSGU(c, name="fn")
             else:
                 self.fn = JointAttention(c, attn_type=atype, name="fn")
+        elif (
+            c.moe_experts > 0
+            and self.layer_ind % c.moe_every == c.moe_every - 1
+        ):
+            from dalle_tpu.models.moe import MoEFeedForward
+
+            self.fn = MoEFeedForward(c, name="fn")
         else:
             self.fn = FeedForward(c, name="fn")
         self.scale = self.param(
@@ -593,6 +608,10 @@ class Transformer(nn.Module):
                 f"stage runs the same program (cycle {len(c.attn_types)}, "
                 f"per-stage {per})"
             )
+            assert c.moe_experts == 0 or per % c.moe_every == 0, (
+                "moe_every must divide the per-stage depth under pipeline "
+                f"parallelism (moe_every {c.moe_every}, per-stage {per})"
+            )
             self.stages = [
                 TransformerStage(c, s, name=f"stage_{s}")
                 for s in range(c.pp_stages)
@@ -666,6 +685,15 @@ class Transformer(nn.Module):
 
         from dalle_tpu.parallel.pipeline import gpipe, stack_stage_params
 
+        if c.moe_experts > 0:
+            import warnings
+
+            warnings.warn(
+                "MoE aux losses sown inside pipeline stages are not "
+                "propagated under the GPipe executor (detached stage apply); "
+                "load-balancing loss is inactive for pp>1.",
+                stacklevel=2,
+            )
         stacked = stack_stage_params(
             [_core.freeze(st.variables["params"]) for st in self.stages]
         )
@@ -718,6 +746,15 @@ class Transformer(nn.Module):
 
         from dalle_tpu.ops.reversible import reversible_sequence
 
+        if self.cfg.moe_experts > 0:
+            import warnings
+
+            warnings.warn(
+                "MoE aux losses sown inside reversible blocks are not "
+                "propagated through the custom-VJP chain (detached sublayer "
+                "apply); load-balancing loss is inactive for reversible=True.",
+                stacklevel=2,
+            )
         need_drop = (not deterministic) and (
             self.cfg.attn_dropout > 0 or self.cfg.ff_dropout > 0
         )

@@ -35,6 +35,13 @@ _TP_RULES = (
     ("proj_out/kernel", PartitionSpec("tp", None)),
 )
 
+# MoE expert weights [E, d, f]: experts over ep, inner dim over tp
+# (models/moe.py; the token dispatch collective is inserted by GSPMD)
+_MOE_RULES = (
+    ("experts_wi", ("ep", None, "tp")),
+    ("experts_wo", ("ep", "tp", None)),
+)
+
 
 def _path_str(path) -> str:
     return "/".join(
@@ -45,8 +52,17 @@ def _path_str(path) -> str:
 def _spec_for(path: str, shape, mesh_shape) -> PartitionSpec:
     tp = mesh_shape.get("tp", 1)
     fsdp = mesh_shape.get("fsdp", 1)
+    ep = mesh_shape.get("ep", 1)
     spec = None
-    if tp > 1:
+    for suffix, rule in _MOE_RULES:
+        if path.endswith(suffix) and len(shape) == len(rule):
+            dims = []
+            for i, ax in enumerate(rule):
+                size = {"ep": ep, "tp": tp}.get(ax, 1)
+                dims.append(ax if size > 1 and shape[i] % size == 0 else None)
+            spec = PartitionSpec(*dims)
+            break
+    if spec is None and tp > 1:
         for suffix, rule in _TP_RULES:
             if path.endswith(suffix):
                 ax = [rule.index(a) for a in rule if a == "tp"]

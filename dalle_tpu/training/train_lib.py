@@ -116,14 +116,22 @@ def make_dalle_train_step(
             codes = images
 
         def loss_fn(p):
-            return model.apply(
+            # mutable=["losses"] collects sown auxiliary losses (MoE load
+            # balancing, models/moe.py); empty dict when the model has none
+            task_loss, mut = model.apply(
                 {"params": p},
                 text,
                 codes,
                 return_loss=True,
                 deterministic=False,
                 rngs={"dropout": key},
+                mutable=["losses"],
             )
+            aux = sum(
+                jnp.sum(leaf)
+                for leaf in jax.tree_util.tree_leaves(mut.get("losses", {}))
+            )
+            return task_loss + aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, new_opt_state = tx.update(grads, opt_state, params)

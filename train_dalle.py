@@ -109,6 +109,16 @@ def parse_args(argv=None):
     parser.add_argument("--pp_microbatches", type=int, default=4)
     parser.add_argument("--sp_ring", action="store_true",
                         help="ring-attention sequence parallelism over mesh_sp")
+    parser.add_argument("--moe_experts", type=int, default=0,
+                        help=">0: every moe_every-th FF is a routed MoE "
+                             "(expert weights shard over --mesh_ep)")
+    parser.add_argument("--moe_every", type=int, default=2)
+    parser.add_argument("--moe_top_k", type=int, default=2)
+    parser.add_argument("--moe_capacity_factor", type=float, default=1.25,
+                        help="per-group expert slot headroom; overflow tokens "
+                             "fall through the residual")
+    parser.add_argument("--moe_aux_weight", type=float, default=0.01,
+                        help="load-balancing loss weight")
     parser = backend_lib.wrap_arg_parser(parser)
     return parser.parse_args(argv)
 
@@ -198,6 +208,11 @@ def main(argv=None):
             pp_stages=args.pp_stages,
             pp_microbatches=args.pp_microbatches,
             sp_axis="sp" if args.sp_ring else None,
+            moe_experts=args.moe_experts,
+            moe_every=args.moe_every,
+            moe_top_k=args.moe_top_k,
+            moe_capacity_factor=args.moe_capacity_factor,
+            moe_aux_weight=args.moe_aux_weight,
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         )
     model = DALLE(cfg)
