@@ -28,6 +28,49 @@ import numpy as np
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
 
+def _native():
+    """The C++ decode/resize engine (native/dataio.cpp) if buildable.
+
+    When present, JPEG/PNG decode and crop+bilinear-resize run in first-party
+    C++ instead of PIL (same libjpeg/libpng underneath — decode is
+    bit-identical; the resize kernel is plain bilinear, vs PIL's antialiased
+    convolution).  Unsupported formats (bmp) and failures fall back to PIL.
+    """
+    try:
+        from dalle_tpu.data import native_io
+
+        return native_io.maybe()
+    except Exception:
+        return None
+
+
+def _decode_rgb(data: bytes) -> np.ndarray:
+    """Image bytes -> [h, w, 3] uint8 via native engine, PIL fallback."""
+    nio = _native()
+    if nio is not None:
+        try:
+            return nio.decode_rgb(data)
+        except ValueError:
+            pass
+    import io
+
+    from PIL import Image
+
+    return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"), np.uint8)
+
+
+def _crop_resize(rgb: np.ndarray, x0, y0, crop, out_size) -> np.ndarray:
+    """Square crop + bilinear resize -> [S, S, 3] uint8."""
+    nio = _native()
+    if nio is not None:
+        return nio.crop_resize(rgb, x0, y0, crop, crop, out_size)
+    from PIL import Image
+
+    img = Image.fromarray(rgb).crop((x0, y0, x0 + crop, y0 + crop))
+    return np.asarray(
+        img.resize((out_size, out_size), Image.BILINEAR), np.uint8
+    )
+
 
 class TextImageDataset:
     def __init__(
@@ -75,20 +118,16 @@ class TextImageDataset:
         return self.random_sample() if self.shuffle else self.sequential_sample(ind)
 
     def _load_image(self, key) -> np.ndarray:
-        from PIL import Image
-
-        img = Image.open(self.image_files[key]).convert("RGB")
-        w, h = img.size
-        # RandomResizedCrop, aspect 1:1, scale in [resize_ratio**2, 1]
+        rgb = _decode_rgb(self.image_files[key].read_bytes())
+        h, w = rgb.shape[:2]
+        # RandomResizedCrop, aspect 1:1, scale in [resize_ratio, 1]
         side = min(w, h)
         scale = self._rng.uniform(self.resize_ratio, 1.0)
         crop = max(int(side * scale), 1)
         x0 = self._rng.randint(0, w - crop + 1)
         y0 = self._rng.randint(0, h - crop + 1)
-        img = img.crop((x0, y0, x0 + crop, y0 + crop)).resize(
-            (self.image_size, self.image_size), Image.BILINEAR
-        )
-        return np.asarray(img, dtype=np.float32) / 255.0  # NHWC [0,1]
+        out = _crop_resize(rgb, x0, y0, crop, self.image_size)
+        return out.astype(np.float32) / 255.0  # NHWC [0,1]
 
     def __getitem__(self, ind) -> Tuple[np.ndarray, np.ndarray]:
         key = self.keys[ind]
@@ -127,25 +166,17 @@ class ImageFolderDataset:
         return len(self.files)
 
     def __getitem__(self, ind) -> np.ndarray:
-        from PIL import Image
-
         try:
-            img = Image.open(self.files[ind]).convert("RGB")
+            rgb = _decode_rgb(self.files[ind].read_bytes())
         except Exception:
             # corrupt image → neighbor fallback, same policy as
             # TextImageDataset (reference: loader.py:58-69)
             return self[(ind + 1) % len(self)]
-        w, h = img.size
+        h, w = rgb.shape[:2]
         side = min(w, h)
-        img = img.crop(
-            (
-                (w - side) // 2,
-                (h - side) // 2,
-                (w + side) // 2,
-                (h + side) // 2,
-            )
-        ).resize((self.image_size, self.image_size), Image.BILINEAR)
-        return np.asarray(img, dtype=np.float32) / 255.0
+        out = _crop_resize(rgb, (w - side) // 2, (h - side) // 2, side,
+                           self.image_size)
+        return out.astype(np.float32) / 255.0
 
 
 class DataLoader:

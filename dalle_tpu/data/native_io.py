@@ -1,0 +1,249 @@
+"""ctypes binding for the native C++ data-IO engine (native/dataio.cpp).
+
+First-party native replacement for the decode/prefetch muscle the reference
+gets from torch DataLoader workers + PIL C extensions
+(reference: dalle_pytorch/loader.py, train_dalle.py:353-374):
+
+  * :func:`decode_rgb` — JPEG/PNG bytes → HxWx3 uint8 (libjpeg/libpng16);
+  * :func:`crop_resize` — crop rect + bilinear resample to SxS;
+  * :class:`ImagePipeline` — worker-pool read+decode+crop+resize off the
+    Python thread with bounded queues (results may arrive out of order;
+    each carries its submission index);
+  * :class:`TarReader` — sequential tar-shard entry iterator (streaming,
+    GNU long-name aware) for the WebDataset-equivalent path.
+
+Builds on demand with ``make`` (g++, links -ljpeg -lpng); callers treat an
+import/build failure as "native unavailable" and fall back to PIL/tarfile.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libdataio.so"
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def build_native(force: bool = False) -> Path:
+    if _LIB_PATH.exists() and not force:
+        return _LIB_PATH
+    subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR), "libdataio.so"],
+        check=True,
+        capture_output=True,
+    )
+    return _LIB_PATH
+
+
+def get_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    build_native()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    lib.dio_decode_rgb.restype = ctypes.c_int
+    lib.dio_decode_rgb.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.dio_free.argtypes = [ctypes.c_void_p]
+    lib.dio_crop_resize_rgb.restype = ctypes.c_int
+    lib.dio_crop_resize_rgb.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
+    ]
+    lib.dio_engine_create.restype = ctypes.c_void_p
+    lib.dio_engine_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.dio_engine_submit.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,
+    ]
+    lib.dio_engine_next.restype = ctypes.c_int
+    lib.dio_engine_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_long), u8p,
+    ]
+    lib.dio_engine_close.argtypes = [ctypes.c_void_p]
+    lib.dio_engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.dio_tar_open.restype = ctypes.c_void_p
+    lib.dio_tar_open.argtypes = [ctypes.c_char_p]
+    lib.dio_tar_next.restype = ctypes.c_int
+    lib.dio_tar_next.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.dio_tar_read.restype = ctypes.c_long
+    lib.dio_tar_read.argtypes = [ctypes.c_void_p, u8p, ctypes.c_long]
+    lib.dio_tar_close.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        get_lib()
+        return True
+    except Exception:
+        return False
+
+
+_MAYBE = None
+
+
+def maybe():
+    """This module if the native lib is buildable, else None.
+
+    The single lazy probe shared by every fallback-capable call site
+    (loader.py, wds.py) — a failed build is cached, not retried."""
+    global _MAYBE
+    if _MAYBE is None:
+        _MAYBE = True if available() else False
+    import sys
+
+    return sys.modules[__name__] if _MAYBE else None
+
+
+def decode_rgb(data: bytes) -> np.ndarray:
+    """JPEG/PNG bytes -> [h, w, 3] uint8.  Raises ValueError on failure."""
+    lib = get_lib()
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    out = u8p()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    rc = lib.dio_decode_rgb(
+        data, len(data), ctypes.byref(out), ctypes.byref(w), ctypes.byref(h)
+    )
+    if rc != 0:
+        raise ValueError("native decode failed (unsupported or corrupt)")
+    try:
+        arr = np.ctypeslib.as_array(out, shape=(h.value, w.value, 3)).copy()
+    finally:
+        lib.dio_free(ctypes.cast(out, ctypes.c_void_p))
+    return arr
+
+
+def crop_resize(
+    rgb: np.ndarray, x0: int, y0: int, cw: int, ch: int, out_size: int
+) -> np.ndarray:
+    """Crop [y0:y0+ch, x0:x0+cw] and bilinearly resample to out_size²."""
+    lib = get_lib()
+    rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
+    h, w, _ = rgb.shape
+    out = np.empty((out_size, out_size, 3), np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    rc = lib.dio_crop_resize_rgb(
+        rgb.ctypes.data_as(u8p), w, h, x0, y0, cw, ch, out_size,
+        out.ctypes.data_as(u8p),
+    )
+    if rc != 0:
+        raise ValueError(f"bad crop rect ({x0},{y0},{cw},{ch}) for {w}x{h}")
+    return out
+
+
+CROP_CENTER = 0
+CROP_RANDOM = 1
+
+
+class ImagePipeline:
+    """Worker-pool image loader: read+decode+crop+resize in C++ threads.
+
+    ``submit(idx, path, ...)`` then iterate :meth:`results`; each result is
+    ``(idx, pixels-or-None)`` (None = corrupt/unsupported, caller skips).
+    """
+
+    def __init__(self, image_size: int, workers: int = 4, queue_cap: int = 16):
+        self._lib = get_lib()
+        self.image_size = image_size
+        self._h = self._lib.dio_engine_create(workers, queue_cap, image_size)
+        self._submitted = 0
+
+    def submit(
+        self,
+        idx: int,
+        path: str,
+        *,
+        mode: int = CROP_CENTER,
+        scale: float = 1.0,
+        u: float = 0.0,
+        v: float = 0.0,
+    ):
+        self._lib.dio_engine_submit(
+            self._h, idx, str(path).encode(), mode, scale, u, v
+        )
+        self._submitted += 1
+
+    def results(self) -> Iterator[Tuple[int, Optional[np.ndarray]]]:
+        """Close the intake and drain all results (unordered)."""
+        self._lib.dio_engine_close(self._h)
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        while True:
+            idx = ctypes.c_long()
+            buf = np.empty((self.image_size, self.image_size, 3), np.uint8)
+            rc = self._lib.dio_engine_next(
+                self._h, ctypes.byref(idx), buf.ctypes.data_as(u8p)
+            )
+            if rc == -2:
+                return
+            yield int(idx.value), (buf if rc == 0 else None)
+
+    def close(self):
+        if self._h:
+            self._lib.dio_engine_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class TarReader:
+    """Sequential tar entry iterator: yields (name, bytes)."""
+
+    def __init__(self, path: str):
+        self._lib = get_lib()
+        self._h = self._lib.dio_tar_open(str(path).encode())
+        if not self._h:
+            raise OSError(f"cannot open tar {path}")
+
+    def __iter__(self) -> Iterator[Tuple[str, bytes]]:
+        name_buf = ctypes.create_string_buffer(4096)
+        size = ctypes.c_long()
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        while True:
+            rc = self._lib.dio_tar_next(
+                self._h, name_buf, len(name_buf), ctypes.byref(size)
+            )
+            if rc == 1:
+                return
+            if rc != 0:
+                raise OSError("corrupt tar archive")
+            data = np.empty(size.value, np.uint8)
+            got = (
+                self._lib.dio_tar_read(
+                    self._h, data.ctypes.data_as(u8p), size.value
+                )
+                if size.value
+                else 0
+            )
+            if got != size.value:
+                raise OSError("truncated tar entry")
+            yield name_buf.value.decode(), data.tobytes()
+
+    def close(self):
+        if self._h:
+            self._lib.dio_tar_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -23,7 +23,6 @@ same capability directly on ``tarfile``:
 from __future__ import annotations
 
 import glob as globlib
-import io
 import subprocess
 import tarfile
 from pathlib import Path
@@ -63,27 +62,49 @@ def _open_shard(url: str):
     return open(url, "rb")
 
 
-def iter_tar_samples(url: str) -> Iterator[Dict[str, bytes]]:
-    """Group successive tar members by basename stem (WebDataset layout)."""
+def _iter_tar_members(url: str) -> Iterator[tuple]:
+    """(name, bytes) pairs from a shard.  Local UNCOMPRESSED ``.tar`` files
+    use the native C++ tar reader when available; pipes/URLs, compressed
+    shards (``.tar.gz`` etc. — tarfile's ``r|*`` sniffs those), and fallback
+    use tarfile."""
+    try:
+        from dalle_tpu.data import native_io
+
+        nio = native_io.maybe()
+    except Exception:
+        nio = None
+    if (
+        nio is not None
+        and url.lower().endswith(".tar")
+        and not url.startswith(("pipe:", "http://", "https://", "gs://"))
+    ):
+        yield from nio.TarReader(url)
+        return
     stream = _open_shard(url)
-    current_key: Optional[str] = None
-    sample: Dict[str, bytes] = {}
     with tarfile.open(fileobj=stream, mode="r|*") as tar:
         for member in tar:
             if not member.isfile():
                 continue
-            name = Path(member.name)
-            stem = str(name.parent / name.stem)
-            ext = name.suffix.lstrip(".").lower()
-            if stem != current_key:
-                if sample:
-                    yield sample
-                current_key, sample = stem, {"__key__": stem.encode()}
             f = tar.extractfile(member)
             if f is not None:
-                sample[ext] = f.read()
-        if sample:
-            yield sample
+                yield member.name, f.read()
+
+
+def iter_tar_samples(url: str) -> Iterator[Dict[str, bytes]]:
+    """Group successive tar members by basename stem (WebDataset layout)."""
+    current_key: Optional[str] = None
+    sample: Dict[str, bytes] = {}
+    for member_name, data in _iter_tar_members(url):
+        name = Path(member_name)
+        stem = str(name.parent / name.stem)
+        ext = name.suffix.lstrip(".").lower()
+        if stem != current_key:
+            if sample:
+                yield sample
+            current_key, sample = stem, {"__key__": stem.encode()}
+        sample[ext] = data
+    if sample:
+        yield sample
 
 
 class WebDataset:
@@ -176,7 +197,7 @@ class BatchedWebLoader:
         return self.nominal_length
 
     def _decode(self, sample):
-        from PIL import Image
+        from dalle_tpu.data.loader import _crop_resize, _decode_rgb
 
         ck, ik = self.ds._keys(sample)
         caption = sample[ck].decode("utf-8", errors="replace").strip()
@@ -185,13 +206,13 @@ class BatchedWebLoader:
         tokens = self.tokenizer.tokenize(
             caption.split("\n")[0], self.text_len, truncate_text=self.truncate_captions
         )[0]
-        img = Image.open(io.BytesIO(sample[ik])).convert("RGB")
-        w, h = img.size
+        # native C++ decode/resize when available, PIL fallback (loader.py)
+        rgb = _decode_rgb(sample[ik])
+        h, w = rgb.shape[:2]
         side = min(w, h)
-        img = img.crop(
-            ((w - side) // 2, (h - side) // 2, (w + side) // 2, (h + side) // 2)
-        ).resize((self.image_size, self.image_size), Image.BILINEAR)
-        return tokens.astype(np.int32), np.asarray(img, np.float32) / 255.0
+        out = _crop_resize(rgb, (w - side) // 2, (h - side) // 2, side,
+                           self.image_size)
+        return tokens.astype(np.int32), out.astype(np.float32) / 255.0
 
     def __iter__(self):
         texts, images = [], []

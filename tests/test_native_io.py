@@ -1,0 +1,175 @@
+"""Native C++ data-IO engine: decode parity, resize math, worker pipeline,
+tar reader, and dataset integration."""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+nio = pytest.importorskip("dalle_tpu.data.native_io")
+
+if not nio.available():
+    pytest.skip("native dataio not buildable here", allow_module_level=True)
+
+
+def _png_bytes(arr):
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def test_png_decode_exact():
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(37, 53, 3) * 255).astype(np.uint8)
+    assert np.array_equal(nio.decode_rgb(_png_bytes(arr)), arr)
+
+
+def test_jpeg_decode_matches_pil():
+    rng = np.random.RandomState(1)
+    arr = (rng.rand(40, 48, 3) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=85)
+    dec = nio.decode_rgb(buf.getvalue())
+    pil = np.asarray(Image.open(io.BytesIO(buf.getvalue())).convert("RGB"))
+    assert np.array_equal(dec, pil)  # same libjpeg underneath
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        nio.decode_rgb(b"not an image at all")
+
+
+def test_crop_resize_identity_and_reference():
+    rng = np.random.RandomState(2)
+    arr = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+    # crop == out_size: exact passthrough
+    assert np.array_equal(
+        nio.crop_resize(arr, 4, 6, 16, 16, 16), arr[6:22, 4:20]
+    )
+    # 2x downscale vs numpy half-pixel bilinear reference
+    out = nio.crop_resize(arr, 0, 0, 32, 32, 16)
+    f = arr.astype(np.float64)
+    coords = (np.arange(16) + 0.5) * 2 - 0.5
+    lo = np.floor(coords).astype(int)
+    frac = coords - lo
+    hi = np.minimum(lo + 1, 31)
+    top = f[lo][:, lo] * (1 - frac[None, :, None]) + f[lo][:, hi] * frac[None, :, None]
+    bot = f[hi][:, lo] * (1 - frac[None, :, None]) + f[hi][:, hi] * frac[None, :, None]
+    ref = top * (1 - frac[:, None, None]) + bot * frac[:, None, None]
+    np.testing.assert_allclose(out, np.round(ref), atol=1.0)
+
+
+def test_crop_resize_bad_rect():
+    arr = np.zeros((8, 8, 3), np.uint8)
+    with pytest.raises(ValueError):
+        nio.crop_resize(arr, 4, 4, 8, 8, 4)  # overflows the image
+
+
+def test_pipeline_delivers_all_and_flags_corrupt(tmp_path):
+    rng = np.random.RandomState(3)
+    good = {}
+    for i in range(12):
+        arr = (rng.rand(24 + i, 30, 3) * 255).astype(np.uint8)
+        p = tmp_path / f"img{i}.png"
+        p.write_bytes(_png_bytes(arr))
+        good[i] = p
+    bad = tmp_path / "bad.png"
+    bad.write_bytes(b"corrupt bytes")
+
+    pipe = nio.ImagePipeline(image_size=16, workers=4, queue_cap=4)
+    for i, p in good.items():
+        pipe.submit(i, str(p))
+    pipe.submit(99, str(bad))
+    seen, failed = set(), set()
+    for idx, pixels in pipe.results():
+        if pixels is None:
+            failed.add(idx)
+        else:
+            assert pixels.shape == (16, 16, 3)
+            seen.add(idx)
+    pipe.close()
+    assert seen == set(good)
+    assert failed == {99}
+
+
+def test_pipeline_abandoned_midway_does_not_hang(tmp_path):
+    """Destroying an engine whose results were never drained must not
+    deadlock the worker threads (results queue full, consumer gone)."""
+    arr = (np.random.RandomState(7).rand(16, 16, 3) * 255).astype(np.uint8)
+    p = tmp_path / "img.png"
+    p.write_bytes(_png_bytes(arr))
+    pipe = nio.ImagePipeline(image_size=8, workers=2, queue_cap=2)
+    for i in range(20):  # far more than queue_cap
+        pipe.submit(i, str(p))
+    import threading
+
+    done = threading.Event()
+    t = threading.Thread(target=lambda: (pipe.close(), done.set()))
+    t.start()
+    t.join(timeout=10)
+    assert done.is_set(), "engine destroy deadlocked with full result queue"
+
+
+def test_wds_compressed_shard_falls_back_to_tarfile(tmp_path):
+    from dalle_tpu.data.wds import iter_tar_samples
+
+    tp = tmp_path / "pairs.tar.gz"
+    img = _png_bytes((np.ones((8, 8, 3)) * 64).astype(np.uint8))
+    with tarfile.open(tp, "w:gz") as tar:
+        for name, data in (("s0.txt", b"gz caption"), ("s0.png", img)):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    samples = list(iter_tar_samples(str(tp)))
+    assert len(samples) == 1 and samples[0]["txt"] == b"gz caption"
+
+
+def test_tar_reader_roundtrip(tmp_path):
+    payloads = {
+        "a/sample0.txt": b"a red square",
+        "a/sample0.png": _png_bytes(np.zeros((8, 8, 3), np.uint8)),
+        "long/" + "x" * 150 + ".txt": b"gnu long name entry",
+    }
+    tp = tmp_path / "shard.tar"
+    with tarfile.open(tp, "w") as tar:
+        for name, data in payloads.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    got = dict(nio.TarReader(str(tp)))
+    assert got == payloads
+
+
+def test_wds_uses_native_tar(tmp_path):
+    from dalle_tpu.data.wds import iter_tar_samples
+
+    tp = tmp_path / "pairs.tar"
+    img = _png_bytes((np.ones((8, 8, 3)) * 128).astype(np.uint8))
+    with tarfile.open(tp, "w") as tar:
+        for name, data in (
+            ("s0.txt", b"caption zero"),
+            ("s0.png", img),
+            ("s1.txt", b"caption one"),
+            ("s1.png", img),
+        ):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    samples = list(iter_tar_samples(str(tp)))
+    assert len(samples) == 2
+    assert samples[0]["txt"] == b"caption zero"
+    assert samples[1]["png"] == img
+
+
+def test_dataset_uses_native_decode(tmp_path):
+    from dalle_tpu.data.loader import ImageFolderDataset, _native
+
+    assert _native() is not None
+    arr = (np.random.RandomState(5).rand(20, 28, 3) * 255).astype(np.uint8)
+    (tmp_path / "x.png").write_bytes(_png_bytes(arr))
+    ds = ImageFolderDataset(str(tmp_path), image_size=8)
+    out = ds[0]
+    assert out.shape == (8, 8, 3) and out.dtype == np.float32
+    assert 0.0 <= out.min() and out.max() <= 1.0

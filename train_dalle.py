@@ -366,7 +366,12 @@ def main(argv=None):
                 sample_text = jnp.asarray(text[:1])
                 imgs = generate_images(
                     model, params, vae, vae_params, sample_text,
-                    jax.random.fold_in(rng, -global_step), filter_thres=0.9,
+                    # distinct stream from the train-step keys (fold_in
+                    # requires a non-negative value: uint32)
+                    jax.random.fold_in(
+                        jax.random.fold_in(rng, 0x5A3D), global_step
+                    ),
+                    filter_thres=0.9,
                 )
                 caption = tokenizer.decode(np.asarray(sample_text)[0])
                 run.log_images(
