@@ -1,5 +1,16 @@
 """Tokenizer registry mirroring the reference's selection flags
-(reference: train_dalle.py:228-232, generate.py:69-73)."""
+(reference: train_dalle.py:228-232, generate.py:69-73).
+
+Selection semantics match the reference: explicit ``--chinese`` / ``--hug``
+flags win; otherwise ``bpe_path``'s extension routes the file —
+``.json`` → HugTokenizer, ``.txt``/``.txt.gz`` → the CLIP BPE
+(native C++ merge engine when buildable, pure Python otherwise), anything
+else (e.g. a yttm ``.model``) → YttmTokenizer, exactly like the reference's
+extension dispatch (reference: train_dalle.py:228-232).  With no arguments
+the vendored CLIP merges give the default 49408-token vocab with zero setup.
+"""
+
+import logging
 
 from dalle_tpu.tokenizers.fallback import (  # noqa: F401
     ByteTokenizer,
@@ -9,6 +20,21 @@ from dalle_tpu.tokenizers.fallback import (  # noqa: F401
 )
 from dalle_tpu.tokenizers.simple import SimpleTokenizer  # noqa: F401
 
+logger = logging.getLogger(__name__)
+
+
+def _clip_bpe(bpe_path=None):
+    """CLIP BPE via the C++ merge engine, pure Python as fallback."""
+    try:
+        from dalle_tpu.tokenizers.native_bpe import NativeTokenizer
+
+        return NativeTokenizer(bpe_path)
+    except FileNotFoundError:
+        raise
+    except Exception as exc:  # no toolchain / lib build failure
+        logger.info("native BPE unavailable (%s); using pure-Python BPE", exc)
+        return SimpleTokenizer(bpe_path)
+
 
 def get_tokenizer(
     *,
@@ -17,8 +43,7 @@ def get_tokenizer(
     chinese: bool = False,
     yttm: bool = False,
 ):
-    """Flag-compatible selection: --chinese / --hug (json path) / yttm model
-    path / default CLIP BPE, with byte fallback when no merges exist."""
+    """Flag- and extension-compatible tokenizer selection."""
     if chinese:
         return ChineseTokenizer()
     if hug:
@@ -27,15 +52,21 @@ def get_tokenizer(
     if yttm:
         assert bpe_path, "a yttm model path is required"
         return YttmTokenizer(bpe_path)
+    if bpe_path:
+        p = str(bpe_path)
+        if p.endswith(".json"):
+            return HugTokenizer(bpe_path)
+        if p.endswith((".txt", ".txt.gz")):
+            return _clip_bpe(bpe_path)
+        # reference routes every non-.json --bpe_path to youtokentome
+        return YttmTokenizer(bpe_path)
     try:
-        try:
-            # C++ merge engine when a toolchain is available (yttm-equivalent)
-            from dalle_tpu.tokenizers.native_bpe import NativeTokenizer
-
-            return NativeTokenizer(bpe_path)
-        except FileNotFoundError:
-            raise
-        except Exception:
-            return SimpleTokenizer(bpe_path)
-    except FileNotFoundError:
+        return _clip_bpe(None)
+    except FileNotFoundError as exc:
+        logger.warning(
+            "FALLING BACK to the 257-token ByteTokenizer (%s). Models trained "
+            "this way use a DIFFERENT vocab than the default 49408-token CLIP "
+            "BPE and are not comparable to reference-trained checkpoints.",
+            exc,
+        )
         return ByteTokenizer()

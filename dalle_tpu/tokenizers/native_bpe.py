@@ -14,7 +14,10 @@ is present the import raises and callers fall back to the pure-Python path.
 from __future__ import annotations
 
 import ctypes
+import hashlib
+import os
 import subprocess
+import tempfile
 from pathlib import Path
 from typing import Optional
 
@@ -59,9 +62,10 @@ class NativeTokenizer(SimpleTokenizer):
     MAX_MERGES = 49152 - 256 - 2  # CLIP vocab truncation (simple.py)
 
     def __init__(self, bpe_path: Optional[str] = None):
-        super().__init__(bpe_path)
+        resolved = self._resolve(bpe_path)  # resolve once for both engines
+        super().__init__(resolved)
         self._lib = _load_lib()
-        path = self._resolve(bpe_path)
+        path = self._plain_text_path(resolved)
         self._handle = self._lib.bpe_create(
             str(path).encode(), self.MAX_MERGES
         )
@@ -71,6 +75,28 @@ class NativeTokenizer(SimpleTokenizer):
             "native/python merge tables disagree"
         )
         self._buf = ctypes.create_string_buffer(1 << 16)
+
+    @staticmethod
+    def _plain_text_path(path: str) -> str:
+        """The C engine reads plain text; gunzip vendored merges to a cached
+        temp file keyed by content hash (re-verified, never trusted blind)."""
+        if not str(path).endswith(".gz"):
+            return str(path)
+        from dalle_tpu.tokenizers.simple import _read_merges_text
+
+        raw = _read_merges_text(path).encode("utf-8")
+        digest = hashlib.sha256(raw).hexdigest()[:16]
+        out = Path(tempfile.gettempdir()) / f"dalle_tpu_bpe_{digest}.txt"
+        # /tmp is shared: only reuse a cache file whose content hashes back
+        # to the same digest; rewrite it otherwise
+        if not (
+            out.exists()
+            and hashlib.sha256(out.read_bytes()).hexdigest()[:16] == digest
+        ):
+            tmp = out.with_suffix(f".{os.getpid()}.part")
+            tmp.write_bytes(raw)
+            tmp.replace(out)
+        return str(out)
 
     def bpe(self, token: str) -> str:
         if token in self.cache:

@@ -8,12 +8,20 @@ cleanup, and the shared contract
 0-padding (pad id 0 is load-bearing: DALLE remaps it per position,
 see models/dalle.py).
 
-The reference ships OpenAI's 3.2 MB merges file as package data
-(reference: dalle_pytorch/data/bpe_simple_vocab_16e6.txt, MANIFEST.in:1).
-We do NOT vendor that file; pass ``bpe_path`` (searched in
-``$DALLE_TPU_BPE_PATH`` and ``~/.cache/dalle`` by default), or use
-``tokenizers/fallback.py``'s byte tokenizer when no merges are available.
-The BPE *algorithm* here is the standard public one, written fresh.
+Like the reference (dalle_pytorch/data/bpe_simple_vocab_16e6.txt,
+MANIFEST.in:1), the CLIP merges table ships as package data —
+``data/bpe_simple_vocab_16e6.txt.gz`` — so ``SimpleTokenizer()`` works with
+zero setup and yields the 49408-token CLIP vocab.  The table is OpenAI
+CLIP's published BPE data (MIT license), stored gzipped; resolution order
+is explicit ``bpe_path`` > ``$DALLE_TPU_BPE_PATH`` > ``~/.cache/dalle`` >
+the vendored copy.
+
+Provenance note: the merge-loop semantics follow OpenAI CLIP's
+``SimpleTokenizer`` (as vendored by the reference at
+dalle_pytorch/tokenizer.py:78-125, MIT) — bit-exact merges are required for
+vocab parity with reference-trained models.  The word splitter uses CLIP's
+exact ``regex`` pattern when the ``regex`` module is available and a close
+stdlib-``re`` approximation otherwise.
 """
 
 from __future__ import annotations
@@ -28,10 +36,24 @@ from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
+VENDORED_MERGES = str(
+    Path(__file__).parent / "data" / "bpe_simple_vocab_16e6.txt.gz"
+)
+
 DEFAULT_SEARCH = (
     os.environ.get("DALLE_TPU_BPE_PATH", ""),
     str(Path.home() / ".cache" / "dalle" / "bpe_simple_vocab_16e6.txt"),
+    VENDORED_MERGES,
 )
+
+
+@functools.lru_cache(maxsize=4)
+def _read_merges_text(path: str) -> str:
+    """Read + (if gzipped) decompress a merges file once per path."""
+    raw = Path(path).read_bytes()
+    if str(path).endswith(".gz"):
+        raw = gzip.decompress(raw)
+    return raw.decode("utf-8")
 
 
 @functools.lru_cache()
@@ -65,13 +87,21 @@ def whitespace_clean(text: str) -> str:
     return re.sub(r"\s+", " ", text).strip()
 
 
-# stdlib `re` has no \p{L}; unicode letters are matched via str.isalpha in
-# the byte encoder path, ASCII classes suffice for the word splitter
-_WORD_PAT = re.compile(
-    r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"
-    r"|[^\W\d_]+|[0-9]|[^\s\w]+",
-    re.IGNORECASE | re.UNICODE,
-)
+try:
+    # CLIP's exact splitter needs \p{L}/\p{N} classes (third-party `regex`)
+    import regex as _regex
+
+    _WORD_PAT = _regex.compile(
+        r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"
+        r"|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+",
+        _regex.IGNORECASE,
+    )
+except ImportError:  # stdlib approximation: ASCII digit class, \w-based letters
+    _WORD_PAT = re.compile(
+        r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"
+        r"|[^\W\d_]+|[0-9]|[^\s\w]+|_+",
+        re.IGNORECASE | re.UNICODE,
+    )
 
 
 class SimpleTokenizer:
@@ -98,10 +128,13 @@ class SimpleTokenizer:
 
     @staticmethod
     def _resolve(bpe_path):
-        candidates = ([bpe_path] if bpe_path else []) + [
-            p for p in DEFAULT_SEARCH if p
-        ]
-        for p in candidates:
+        if bpe_path:
+            # an explicit path must exist — falling through to the vendored
+            # merges would silently swap the vocab under the user
+            if Path(bpe_path).exists():
+                return str(bpe_path)
+            raise FileNotFoundError(f"BPE merges file not found: {bpe_path}")
+        for p in DEFAULT_SEARCH:
             if p and Path(p).exists():
                 return p
         raise FileNotFoundError(
@@ -112,10 +145,7 @@ class SimpleTokenizer:
 
     @staticmethod
     def _load_merges(path):
-        raw = Path(path).read_bytes()
-        if path.endswith(".gz"):
-            raw = gzip.decompress(raw)
-        lines = raw.decode("utf-8").split("\n")
+        lines = _read_merges_text(path).split("\n")
         # CLIP merges file layout: header line, then merge pairs; the
         # published file is truncated to 49152-256-2+1 entries
         merges = [tuple(l.split()) for l in lines[1:] if len(l.split()) == 2]
