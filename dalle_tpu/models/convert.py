@@ -12,10 +12,12 @@ pickles and taming VQGAN checkpoints (reference: dalle_pytorch/vae.py:103-133,
 
 Two strategies:
   * ``convert_named`` — regex rules translating checkpoint key names to flax
-    tree paths (used for taming VQGAN, whose naming is stable public API);
+    tree paths (used for BOTH the taming VQGAN and the OpenAI dVAE pickles;
+    their module naming is stable public API, and name-matching is immune to
+    traversal-order drift — golden-tested in tests/test_golden_vae.py);
   * ``convert_by_order`` — zip checkpoint tensors with flax leaves in
-    traversal order under exact-shape checking (used for the OpenAI dVAE
-    pickles, whose pickled module layout matches our module order).
+    traversal order under exact-shape checking (utility for simple
+    checkpoints with positionally-aligned layouts).
 
 Both fail loudly on unconsumed/unfilled leaves — a wrong mapping can't load
 silently.
@@ -113,6 +115,41 @@ def convert_named(
     return jax.tree_util.tree_unflatten(treedef, filled)
 
 
+# --- OpenAI dVAE key rules (released pickle layout: blocks.input /
+# blocks.group_G.block_B.{id_path,res_path.conv_N} / blocks.output.conv,
+# custom Conv2d params named w/b — see openai/DALL-E encoder.py) ------------
+
+OPENAI_VAE_RULES = [
+    (r"blocks\.input\.w", r"input_conv/kernel"),
+    (r"blocks\.input\.b", r"input_conv/bias"),
+    (
+        r"blocks\.group_(\d+)\.block_(\d+)\.id_path\.w",
+        r"group_\1_blk_\2/id_conv/kernel",
+    ),
+    (
+        r"blocks\.group_(\d+)\.block_(\d+)\.id_path\.b",
+        r"group_\1_blk_\2/id_conv/bias",
+    ),
+    (
+        r"blocks\.group_(\d+)\.block_(\d+)\.res_path\.conv_(\d)\.w",
+        r"group_\1_blk_\2/conv_\3/kernel",
+    ),
+    (
+        r"blocks\.group_(\d+)\.block_(\d+)\.res_path\.conv_(\d)\.b",
+        r"group_\1_blk_\2/conv_\3/bias",
+    ),
+    (r"blocks\.output\.conv\.w", r"output_conv/kernel"),
+    (r"blocks\.output\.conv\.b", r"output_conv/bias"),
+]
+
+# the released pickles track a vestigial use_mixed_precision flag per conv
+OPENAI_VAE_IGNORE = (r".*use_mixed_precision.*", r".*\.use_float16.*")
+
+
+def openai_vae_rules():
+    return list(OPENAI_VAE_RULES)
+
+
 # --- taming VQGAN key rules (public naming, stable across releases) --------
 
 _VQGAN_COMMON = [
@@ -163,6 +200,8 @@ _VQGAN_COMMON = [
     # quantizer
     (r"quantize\.embedding\.weight", r"codebook/embedding"),
     (r"quantize\.embed\.weight", r"codebook/embedding"),  # GumbelVQ
+    (r"quantize\.proj\.weight", r"gumbel_proj/kernel"),  # GumbelVQ logits head
+    (r"quantize\.proj\.bias", r"gumbel_proj/bias"),
     (r"quant_conv\.weight", r"quant_conv/kernel"),
     (r"quant_conv\.bias", r"quant_conv/bias"),
     (r"post_quant_conv\.weight", r"post_quant_conv/kernel"),

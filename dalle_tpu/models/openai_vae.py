@@ -48,10 +48,17 @@ class OpenAIVAEConfig:
     input_channels: int = 3
     vocab_size: int = 8192
     n_init: int = 128  # decoder stem width
+    image_size: int = 256  # released artifact trains at 256 px
 
     @property
     def n_layers(self) -> int:
         return self.group_count * self.n_blk_per_group
+
+    @property
+    def num_pools(self) -> int:
+        """Downsampling conv groups (maxpool after all but the last group):
+        2**num_pools spatial reduction."""
+        return self.group_count - 1
 
 
 class _Block(nn.Module):
@@ -68,10 +75,12 @@ class _Block(nn.Module):
             if x.shape[-1] == self.n_out
             else nn.Conv(self.n_out, (1, 1), name="id_conv")(x)
         )
-        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_0")(jax.nn.relu(x))
-        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_1")(jax.nn.relu(h))
+        # conv_1..conv_4 names mirror the released res_path layout so the
+        # name-based weight converter maps 1:1 (openai/DALL-E encoder.py)
+        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_1")(jax.nn.relu(x))
         h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_2")(jax.nn.relu(h))
-        h = nn.Conv(self.n_out, (1, 1), name="conv_3")(jax.nn.relu(h))
+        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_3")(jax.nn.relu(h))
+        h = nn.Conv(self.n_out, (1, 1), name="conv_4")(jax.nn.relu(h))
         return idp + self.post_gain * h
 
 

@@ -90,7 +90,7 @@ class OpenAIDiscreteVAE(nn.Module):
 
     @property
     def num_layers(self):
-        return 3
+        return self.cfg.num_pools
 
     @property
     def num_tokens(self):
@@ -98,7 +98,7 @@ class OpenAIDiscreteVAE(nn.Module):
 
     @property
     def image_size(self):
-        return 256
+        return self.cfg.image_size
 
     def get_codebook_indices(self, img):
         logits = self.enc(_oa.map_pixels(img))
@@ -120,27 +120,38 @@ class OpenAIDiscreteVAE(nn.Module):
         raise NotImplementedError  # encode/decode only (reference: vae.py:132-133)
 
 
-def load_openai_vae(enc_path=None, dec_path=None):
+def load_openai_vae(enc_path=None, dec_path=None, cfg=None):
     """→ (OpenAIDiscreteVAE module, params).  Downloads the released pickles
     when paths are omitted (zero-egress: place them in ~/.cache/dalle)."""
     enc_path = enc_path or download(OPENAI_VAE_ENCODER_URL, "encoder.pkl")
     dec_path = dec_path or download(OPENAI_VAE_DECODER_URL, "decoder.pkl")
-    model = OpenAIDiscreteVAE()
+    model = OpenAIDiscreteVAE(cfg or _oa.OpenAIVAEConfig())
+    # param shapes are spatial-size-agnostic: init on a small image
     template = model.init(
         {"params": jax.random.PRNGKey(0)},
-        jnp.zeros((1, 256, 256, 3)),
+        jnp.zeros((1, 32, 32, 3)),
         method=OpenAIDiscreteVAE._init_all,
     )["params"]
 
-    def tensors_of(obj):
-        sd = obj.state_dict() if hasattr(obj, "state_dict") else dict(obj)
-        return [v for k, v in sd.items()]
+    def state_dict_of(obj):
+        return obj.state_dict() if hasattr(obj, "state_dict") else dict(obj)
 
-    enc_t = tensors_of(_torch_load(enc_path))
-    dec_t = tensors_of(_torch_load(dec_path))
     params = dict(template)
-    params["encoder"] = _convert.convert_by_order(template["encoder"], enc_t)
-    params["decoder"] = _convert.convert_by_order(template["decoder"], dec_t)
+    # name-based conversion: the pickled module layout (blocks.group_G...)
+    # maps 1:1 onto our flax paths; order-zip would silently depend on both
+    # sides' traversal orders (golden-tested in tests/test_golden_vae.py)
+    params["encoder"] = _convert.convert_named(
+        template["encoder"],
+        state_dict_of(_torch_load(enc_path)),
+        _convert.openai_vae_rules(),
+        ignore=_convert.OPENAI_VAE_IGNORE,
+    )
+    params["decoder"] = _convert.convert_named(
+        template["decoder"],
+        state_dict_of(_torch_load(dec_path)),
+        _convert.openai_vae_rules(),
+        ignore=_convert.OPENAI_VAE_IGNORE,
+    )
     return model, params
 
 

@@ -167,17 +167,15 @@ class VQGAN(nn.Module):
         self.encoder = VQGANEncoder(c, name="encoder")
         self.decoder = VQGANDecoder(c, name="decoder")
         self.codebook = nn.Embed(c.n_embed, c.embed_dim, name="codebook")
-        if not c.gumbel:
-            self.quant_conv = nn.Conv(c.embed_dim, (1, 1), name="quant_conv")
-            self.post_quant_conv = nn.Conv(
-                c.z_channels, (1, 1), name="post_quant_conv"
-            )
-        else:
-            # GumbelVQ: quant_conv maps to n_embed logits directly
-            self.quant_conv = nn.Conv(c.n_embed, (1, 1), name="quant_conv")
-            self.post_quant_conv = nn.Conv(
-                c.z_channels, (1, 1), name="post_quant_conv"
-            )
+        # taming layout for both variants: quant_conv z→embed_dim and
+        # post_quant_conv embed_dim→z; GumbelVQ adds quantize.proj, a 1×1
+        # conv producing the n_embed logits (taming GumbelQuantize.proj)
+        self.quant_conv = nn.Conv(c.embed_dim, (1, 1), name="quant_conv")
+        self.post_quant_conv = nn.Conv(
+            c.z_channels, (1, 1), name="post_quant_conv"
+        )
+        if c.gumbel:
+            self.gumbel_proj = nn.Conv(c.n_embed, (1, 1), name="gumbel_proj")
 
     @property
     def num_layers(self):
@@ -198,7 +196,7 @@ class VQGAN(nn.Module):
         z = self.quant_conv(z)
         b, h, w, _ = z.shape
         if self.cfg.gumbel:
-            idx = jnp.argmax(z, axis=-1)  # logits → hard indices
+            idx = jnp.argmax(self.gumbel_proj(z), axis=-1)  # hard indices
         else:
             flat = z.reshape(b * h * w, -1)
             emb = self.codebook.embedding  # [n, d]

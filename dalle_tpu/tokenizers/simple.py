@@ -134,6 +134,12 @@ class SimpleTokenizer:
             if Path(bpe_path).exists():
                 return str(bpe_path)
             raise FileNotFoundError(f"BPE merges file not found: {bpe_path}")
+        env_path = os.environ.get("DALLE_TPU_BPE_PATH", "")
+        if env_path and not Path(env_path).exists():
+            # same silent-vocab-swap hazard as an explicit argument
+            raise FileNotFoundError(
+                f"$DALLE_TPU_BPE_PATH points to a missing file: {env_path}"
+            )
         for p in DEFAULT_SEARCH:
             if p and Path(p).exists():
                 return p

@@ -107,10 +107,12 @@ def make_dalle_train_step(
 
     def step(params, opt_state, vae_params, text, images, key):
         if vae is not None:
+            # method by NAME so any VAE flavor (DiscreteVAE / VQGAN /
+            # OpenAIDiscreteVAE) dispatches to its own encoder
             codes = vae.apply(
                 {"params": vae_params},
                 images,
-                method=DiscreteVAE.get_codebook_indices,
+                method="get_codebook_indices",
             )
         else:
             codes = images
@@ -159,7 +161,7 @@ def make_dalle_eval_step(model: DALLE, mesh, vae: Optional[DiscreteVAE] = None):
     def step(params, vae_params, text, images):
         codes = (
             vae.apply(
-                {"params": vae_params}, images, method=DiscreteVAE.get_codebook_indices
+                {"params": vae_params}, images, method="get_codebook_indices"
             )
             if vae is not None
             else images

