@@ -341,6 +341,18 @@ static long octal(const char* p, int n) {
   return v;
 }
 
+// tar numeric field: octal text, or GNU base-256 (high bit of first byte
+// set) used for sizes >= 8 GiB
+static long tar_numeric(const char* cp, int n) {
+  const unsigned char* p = reinterpret_cast<const unsigned char*>(cp);
+  if (p[0] & 0x80) {
+    long v = p[0] & 0x7f;
+    for (int i = 1; i < n; ++i) v = (v << 8) | p[i];
+    return v;
+  }
+  return octal(cp, n);
+}
+
 }  // namespace
 
 void* dio_tar_open(const char* path) {
@@ -365,6 +377,7 @@ int dio_tar_next(void* tp, char* name_out, int name_cap, long* size_out) {
   }
   char hdr[512];
   std::string override_name;  // from GNU 'L' or PAX path=
+  long override_size = -1;    // from PAX size= (entries >= 8 GiB)
   for (;;) {
     if (std::fread(hdr, 1, 512, t->f) != 512) return 1;
     bool zero = true;
@@ -374,7 +387,7 @@ int dio_tar_next(void* tp, char* name_out, int name_cap, long* size_out) {
         break;
       }
     if (zero) return 1;  // end-of-archive marker
-    const long size = octal(hdr + 124, 12);
+    const long size = tar_numeric(hdr + 124, 12);
     const long pad = (512 - (size % 512)) % 512;
     const char type = hdr[156];
 
@@ -401,13 +414,15 @@ int dio_tar_next(void* tp, char* name_out, int name_cap, long* size_out) {
           const std::string record(rec_start, rec_end);
           if (record.rfind("path=", 0) == 0)
             override_name = record.substr(5);
+          else if (record.rfind("size=", 0) == 0)
+            override_size = std::strtol(record.c_str() + 5, nullptr, 10);
           p += rec;
         }
       }
       continue;  // the following header is the real entry
     }
 
-    if (type == '0' || type == '\0') {
+    if (type == '0' || type == '\0' || type == '7') {  // '7': contiguous file
       std::string name;
       if (!override_name.empty()) {
         name = override_name;
@@ -419,13 +434,15 @@ int dio_tar_next(void* tp, char* name_out, int name_cap, long* size_out) {
       }
       std::snprintf(name_out, static_cast<size_t>(name_cap), "%s",
                     name.c_str());
-      *size_out = size;
-      t->cur_size = size;
-      t->cur_left = size;
+      const long real = override_size >= 0 ? override_size : size;
+      *size_out = real;
+      t->cur_size = real;
+      t->cur_left = real;
       return 0;
     }
     // other non-regular entry (dir, link, ...): skip its data
     override_name.clear();
+    override_size = -1;
     if (std::fseek(t->f, size + pad, SEEK_CUR) != 0) return -1;
   }
 }

@@ -31,13 +31,15 @@ _LIB: Optional[ctypes.CDLL] = None
 
 
 def build_native(force: bool = False) -> Path:
-    if _LIB_PATH.exists() and not force:
-        return _LIB_PATH
-    subprocess.run(
-        ["make", "-C", str(_NATIVE_DIR), "libdataio.so"],
-        check=True,
-        capture_output=True,
-    )
+    try:
+        # make owns staleness: a no-op when the .so is newer than dataio.cpp
+        cmd = ["make", "-C", str(_NATIVE_DIR), "libdataio.so"]
+        if force:
+            cmd.insert(1, "-B")
+        subprocess.run(cmd, check=True, capture_output=True)
+    except Exception:
+        if not _LIB_PATH.exists():  # no toolchain AND no prebuilt lib
+            raise
     return _LIB_PATH
 
 
@@ -183,6 +185,22 @@ class ImagePipeline:
         self._lib.dio_engine_close(self._h)
         u8p = ctypes.POINTER(ctypes.c_ubyte)
         while True:
+            idx = ctypes.c_long()
+            buf = np.empty((self.image_size, self.image_size, 3), np.uint8)
+            rc = self._lib.dio_engine_next(
+                self._h, ctypes.byref(idx), buf.ctypes.data_as(u8p)
+            )
+            if rc == -2:
+                return
+            yield int(idx.value), (buf if rc == 0 else None)
+
+    def collect(self, n: int) -> Iterator[Tuple[int, Optional[np.ndarray]]]:
+        """Drain exactly ``n`` results WITHOUT closing the intake — the
+        engine stays usable for further submits (one engine per epoch,
+        batch-sized submit/collect waves; ``dio_engine_next`` blocks until a
+        worker delivers while the intake is open)."""
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        for _ in range(n):
             idx = ctypes.c_long()
             buf = np.empty((self.image_size, self.image_size, 3), np.uint8)
             rc = self._lib.dio_engine_next(

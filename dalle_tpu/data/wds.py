@@ -62,6 +62,18 @@ def _open_shard(url: str):
     return open(url, "rb")
 
 
+def _sniff_ustar(url: str) -> bool:
+    """True when the file really is an uncompressed ustar/GNU tar — a
+    gzip shard misnamed ``.tar`` must take the tarfile ``r|*`` path (which
+    sniffs compression) instead of erroring in the native reader."""
+    try:
+        with open(url, "rb") as f:
+            hdr = f.read(512)
+    except OSError:
+        return False
+    return len(hdr) == 512 and hdr[257:262] == b"ustar"
+
+
 def _iter_tar_members(url: str) -> Iterator[tuple]:
     """(name, bytes) pairs from a shard.  Local UNCOMPRESSED ``.tar`` files
     use the native C++ tar reader when available; pipes/URLs, compressed
@@ -77,6 +89,7 @@ def _iter_tar_members(url: str) -> Iterator[tuple]:
         nio is not None
         and url.lower().endswith(".tar")
         and not url.startswith(("pipe:", "http://", "https://", "gs://"))
+        and _sniff_ustar(url)
     ):
         yield from nio.TarReader(url)
         return

@@ -25,9 +25,11 @@ TPU-first design choices:
     semantics), keeping shapes static for XLA;
   * **top-k routing with renormalized gates** and the Switch load-balancing
     auxiliary loss ``E · Σ_e f_e · p_e``, sown into the ``losses`` collection
-    (train steps add it to the task loss; under reversible or pipelined
-    execution the detached sublayer apply cannot propagate it — the
-    Transformer warns in those modes).
+    (train steps add it to the task loss).  Under reversible execution the
+    aux rides through the custom-VJP chain (ops/reversible.py); under
+    pipelining gpipe masks warmup/drain ticks and averages per-microbatch
+    aux (parallel/pipeline.py) — load balancing is active in every
+    execution mode.
 
 Expert weights are stacked [E, ...] and sharded over ``ep`` via
 partition.py rules (``experts_wi`` / ``experts_wo``).
@@ -109,6 +111,13 @@ class MoEFeedForward(nn.Module):
         gates = jax.nn.softmax(router(xg.astype(jnp.float32)), axis=-1)
         dispatch, combine, aux = _route(gates, K, capacity)
         self.sow("losses", "moe_aux", c.moe_aux_weight * aux)
+        # capacity-overflow diagnostic: fraction of (token, round) slots
+        # dropped.  Nonzero drops also break greedy-decode/teacher-forcing
+        # parity (decode routes per token and never drops) — watch this when
+        # moe_capacity_factor is tight.  Collected when the caller applies
+        # with mutable=["metrics"]; silently skipped otherwise.
+        kept = jnp.sum(dispatch) / (g * G * K)
+        self.sow("metrics", "moe_dropped_frac", 1.0 - kept)
 
         wi = self.param(
             "experts_wi",

@@ -62,10 +62,19 @@ class OpenAIVAEConfig:
 
 
 class _Block(nn.Module):
-    """Bottleneck residual block (encoder and decoder share the shape)."""
+    """Bottleneck residual block ``id + post_gain * res_path``.
+
+    The released encoder and decoder use DIFFERENT res_path kernel layouts
+    (openai/DALL-E encoder.py vs decoder.py):
+      encoder: conv_1..conv_3 are 3×3 (n_in→hid→hid→hid), conv_4 is 1×1 → n_out
+      decoder: conv_1 is 1×1 (n_in→hid), conv_2..conv_4 are 3×3 (…→n_out)
+    conv_1..conv_4 names mirror the released layout so the name-based weight
+    converter maps 1:1 (golden-tested in tests/test_golden_vae.py).
+    """
 
     n_out: int
     post_gain: float
+    kernels: tuple = (3, 3, 3, 1)  # encoder default; decoder passes (1,3,3,3)
 
     @nn.compact
     def __call__(self, x):
@@ -75,12 +84,12 @@ class _Block(nn.Module):
             if x.shape[-1] == self.n_out
             else nn.Conv(self.n_out, (1, 1), name="id_conv")(x)
         )
-        # conv_1..conv_4 names mirror the released res_path layout so the
-        # name-based weight converter maps 1:1 (openai/DALL-E encoder.py)
-        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_1")(jax.nn.relu(x))
-        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_2")(jax.nn.relu(h))
-        h = nn.Conv(hid, (3, 3), padding="SAME", name="conv_3")(jax.nn.relu(h))
-        h = nn.Conv(self.n_out, (1, 1), name="conv_4")(jax.nn.relu(h))
+        h = x
+        widths = (hid, hid, hid, self.n_out)
+        for i, (kw, w) in enumerate(zip(self.kernels, widths)):
+            h = nn.Conv(w, (kw, kw), padding="SAME", name=f"conv_{i+1}")(
+                jax.nn.relu(h)
+            )
         return idp + self.post_gain * h
 
 
@@ -115,7 +124,10 @@ class OpenAIDecoder(nn.Module):
         widths = [8, 4, 2, 1]
         for g, w in enumerate(widths):
             for b in range(c.n_blk_per_group):
-                h = _Block(w * c.n_hid, pg, name=f"group_{g+1}_blk_{b+1}")(h)
+                h = _Block(
+                    w * c.n_hid, pg, kernels=(1, 3, 3, 3),
+                    name=f"group_{g+1}_blk_{b+1}",
+                )(h)
             if g < c.group_count - 1:
                 bsz, hh, ww, ch = h.shape
                 h = jax.image.resize(h, (bsz, hh * 2, ww * 2, ch), "nearest")

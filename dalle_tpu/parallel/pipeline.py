@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 
 def gpipe(
-    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_fn: Callable[..., Any],
     stacked_params: Any,
     x: jnp.ndarray,
     *,
@@ -39,6 +39,7 @@ def gpipe(
     axis: str = "pp",
     num_microbatches: int = 4,
     extra: Any = None,
+    with_aux: bool = False,
 ):
     """Run ``x`` through S pipeline stages with a GPipe microbatch schedule.
 
@@ -46,6 +47,10 @@ def gpipe(
       stage_fn: ``(params_one_stage, x_mb, stage_idx, mb_idx, extra) -> y_mb``
         applied by every device to its resident stage.  Must be the same
         traced computation for all stages (SPMD) — only the weights differ.
+        With ``with_aux`` it returns ``(y_mb, scalar_aux)`` instead; aux from
+        warmup/drain ticks (which reprocess clamped microbatch indices) is
+        masked out, the rest is averaged over microbatches and summed over
+        stages — so the total matches the sequential stage loop.
       stacked_params: pytree whose leaves carry a leading axis of size
         ``mesh.shape[axis]`` (one slice per stage).
       x: [b, ...] global input batch (replicated w.r.t. ``axis``).
@@ -53,7 +58,8 @@ def gpipe(
       extra: optional pytree broadcast to every stage invocation (e.g. a
         dropout PRNG key).
 
-    Returns [b, ...] output of the final stage, replicated over ``axis``.
+    Returns [b, ...] output of the final stage, replicated over ``axis``
+    (plus the aux scalar when ``with_aux``).
     """
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     S = shape[axis]
@@ -81,10 +87,17 @@ def gpipe(
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, t):
-            buf, outputs = carry
+            buf, outputs, aux_acc = carry
             feed = xm[jnp.clip(t, 0, M - 1)]
             inp = jnp.where(idx == 0, feed, buf)
-            out = stage_fn(my_params, inp, idx, jnp.clip(t - idx, 0, M - 1), extra_in)
+            res = stage_fn(my_params, inp, idx, jnp.clip(t - idx, 0, M - 1), extra_in)
+            out, aux = res if with_aux else (res, jnp.zeros((), jnp.float32))
+            aux = jnp.asarray(aux, jnp.float32)  # no bf16 aux accumulation
+            # a tick is real work only while this stage holds a live
+            # microbatch (idx <= t < idx + M); warmup/drain ticks recompute
+            # clamped microbatches and must not contribute aux
+            valid = ((t >= idx) & (t < idx + M)).astype(aux.dtype)
+            aux_acc = aux_acc + aux * valid
             # the last stage banks its result for microbatch t-(S-1)
             oidx = jnp.clip(t - (S - 1), 0, M - 1)
             prev = jax.lax.dynamic_index_in_dim(outputs, oidx, 0, keepdims=False)
@@ -93,24 +106,53 @@ def gpipe(
             # hand my activation to the next stage (ring hop; stage 0's
             # incoming value is ignored — it always reads from xm)
             buf_next = jax.lax.ppermute(out, axis, perm)
-            return (buf_next, outputs), None
+            return (buf_next, outputs, aux_acc), None
 
         outputs0 = jnp.zeros_like(xm)
         buf0 = jnp.zeros_like(xm[0])
-        (_, outputs), _ = jax.lax.scan(tick, (buf0, outputs0), jnp.arange(T))
+        aux0 = jnp.zeros((), jnp.float32)
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (buf0, outputs0, aux0), jnp.arange(T)
+        )
         # replicate the final-stage outputs to every pp rank
         gathered = jax.lax.all_gather(outputs, axis)  # [S, M, mb, ...]
-        return gathered[S - 1].reshape(b, *x_full.shape[1:])
+        out = gathered[S - 1].reshape(b, *x_full.shape[1:])
+        # Σ over stages of the per-stage microbatch mean; then mean over the
+        # dp groups so the scalar is replicated mesh-wide (out_spec P())
+        aux_total = jax.lax.psum(aux_acc / M, axis)
+        for a in dp_axes:
+            aux_total = jax.lax.pmean(aux_total, a)
+        return out, aux_total
 
-    return jax.shard_map(
+    out, aux = jax.shard_map(
         run,
         mesh=mesh,
         in_specs=(P(axis), P(dp_axes), P()),
-        out_specs=P(dp_axes),
+        out_specs=(P(dp_axes), P()),
         check_vma=False,
     )(stacked_params, x, extra)
+    return (out, aux) if with_aux else out
 
 
-def stack_stage_params(stage_param_trees):
-    """[tree_s for s in stages] -> one tree with leading stage axis."""
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_param_trees)
+def stack_stage_params(stage_param_trees, mesh=None, axis: str = "pp"):
+    """[tree_s for s in stages] -> one tree with leading stage axis.
+
+    With a mesh, each input leaf is first constrained to replicated (an
+    explicit all-gather from however train-time partitioning sharded it) and
+    the stacked leaf to ``P(axis)`` — without these GSPMD cannot reshard the
+    stack's concatenate efficiently and falls back to involuntary full
+    rematerialization (round-1 MULTICHIP log)."""
+    from jax.sharding import NamedSharding
+
+    def stack(*xs):
+        if mesh is not None:
+            rep = NamedSharding(mesh, P(*([None] * xs[0].ndim)))
+            xs = [jax.lax.with_sharding_constraint(v, rep) for v in xs]
+        out = jnp.stack(xs)
+        if mesh is not None:
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh, P(axis))
+            )
+        return out
+
+    return jax.tree_util.tree_map(stack, *stage_param_trees)

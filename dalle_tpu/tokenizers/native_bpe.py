@@ -28,13 +28,15 @@ _LIB_PATH = _NATIVE_DIR / "libbpe.so"
 
 
 def build_native(force: bool = False) -> Path:
-    if _LIB_PATH.exists() and not force:
-        return _LIB_PATH
-    subprocess.run(
-        ["make", "-C", str(_NATIVE_DIR), "libbpe.so"],
-        check=True,
-        capture_output=True,
-    )
+    try:
+        # make owns staleness: a no-op when the .so is newer than bpe.cpp
+        cmd = ["make", "-C", str(_NATIVE_DIR), "libbpe.so"]
+        if force:
+            cmd.insert(1, "-B")
+        subprocess.run(cmd, check=True, capture_output=True)
+    except Exception:
+        if not _LIB_PATH.exists():  # no toolchain AND no prebuilt lib
+            raise
     return _LIB_PATH
 
 

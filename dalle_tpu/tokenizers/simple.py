@@ -40,8 +40,9 @@ VENDORED_MERGES = str(
     Path(__file__).parent / "data" / "bpe_simple_vocab_16e6.txt.gz"
 )
 
+# static search tail; $DALLE_TPU_BPE_PATH is read at resolve time (not
+# import time) so late env changes are honored
 DEFAULT_SEARCH = (
-    os.environ.get("DALLE_TPU_BPE_PATH", ""),
     str(Path.home() / ".cache" / "dalle" / "bpe_simple_vocab_16e6.txt"),
     VENDORED_MERGES,
 )
@@ -135,7 +136,9 @@ class SimpleTokenizer:
                 return str(bpe_path)
             raise FileNotFoundError(f"BPE merges file not found: {bpe_path}")
         env_path = os.environ.get("DALLE_TPU_BPE_PATH", "")
-        if env_path and not Path(env_path).exists():
+        if env_path:
+            if Path(env_path).exists():
+                return env_path
             # same silent-vocab-swap hazard as an explicit argument
             raise FileNotFoundError(
                 f"$DALLE_TPU_BPE_PATH points to a missing file: {env_path}"

