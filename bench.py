@@ -1,4 +1,4 @@
-"""Benchmark: DALLE train-step throughput on the available accelerator.
+"""Benchmark: DALLE train + generate throughput on the available accelerator.
 
 Prints ONE JSON line:
   {"metric": "train_img_tokens_per_sec_per_chip", "value": N,
@@ -6,58 +6,235 @@ Prints ONE JSON line:
 
 The reference publishes no quantitative baseline (BASELINE.md); the
 north-star target is >=45% MFU on the 12-layer config (BASELINE.json), so
-``vs_baseline`` reports measured MFU / 0.45 — >1.0 beats the target.
-The throughput metric itself matches the reference's ``sample_per_sec``
-idea scaled to tokens (reference: train_dalle.py:621-624).
+``vs_baseline`` reports measured MFU / 0.45 — >1.0 beats the target.  The
+throughput metric matches the reference's ``sample_per_sec`` idea scaled
+to tokens (reference: train_dalle.py:621-624); the generation phase covers
+BASELINE.json metric 2 (256x256 end-to-end imgs/sec + CLIP score, reference
+inference loop: dalle_pytorch/dalle_pytorch.py:483-498).
+
+Hardened (round-2 VERDICT ask #2): the TPU behind this session has been
+unreachable in past rounds, so the harness must distinguish "wedged chip"
+from "repo bug".  Structure:
+
+  * parent (no args) — runs a tiny-matmul **preflight** in a
+    timeout-wrapped subprocess (device init can hang forever, not just
+    fail), retries once, then runs the **workload** in a second
+    timeout-wrapped subprocess.  On any failure it re-probes the device
+    and emits a structured diagnostic JSON line
+    ``{"metric": "diagnostic", "phase", "error", "device_state", ...}``
+    instead of a raw traceback.  Exit codes: 0 success, 3 environment
+    (device unreachable/wedged), 4 repo bug (device healthy, workload
+    failed).
+  * ``--preflight`` — import jax, list devices, one tiny matmul, print one
+    JSON line.
+  * ``--workload`` — train bench + on-TPU flash-kernel check + generation
+    bench, print one JSON line.
+
+Every run appends to ``bench_history.jsonl`` so MFU trends across runs are
+visible in the output (``mfu_history``).
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+PREFLIGHT_TIMEOUT_S = 300
+WORKLOAD_TIMEOUT_S = 2700
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_history.jsonl")
 
-from dalle_tpu.models.dalle import DALLE, DALLEConfig
-from dalle_tpu.parallel import make_mesh
-from dalle_tpu.training import (
-    count_params,
-    init_train_state,
-    make_dalle_train_step,
-    make_optimizer,
-)
-from dalle_tpu.training.profiler import dalle_train_flops, detect_peak_tflops
+_PREFLIGHT_CODE = """
+import json, os, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+# BENCH_PLATFORM=cpu forces CPU even under the axon site hook (which
+# re-exports JAX_PLATFORMS=axon); used for CPU smoke runs of this harness
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+devs = jax.devices()
+t1 = time.time()
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print(json.dumps({
+    "platform": jax.default_backend(),
+    "n_devices": len(devs),
+    "device_kind": devs[0].device_kind,
+    "init_s": round(t1 - t0, 1),
+    "matmul_s": round(time.time() - t1, 1),
+    "matmul_ok": bool(float(jnp.sum(y.astype(jnp.float32))) == 256 * 256 * 256),
+}))
+"""
+
+
+# --------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------
+
+
+def _run_preflight():
+    """One preflight attempt in a killable subprocess.
+
+    Returns (info_dict | None, error | None)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _PREFLIGHT_CODE],
+            capture_output=True,
+            text=True,
+            timeout=PREFLIGHT_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return None, (
+            f"preflight timed out after {PREFLIGHT_TIMEOUT_S}s "
+            "(device init or tiny matmul hung)"
+        )
+    if p.returncode != 0:
+        return None, f"preflight rc={p.returncode}: {p.stderr.strip()[-2000:]}"
+    try:
+        return json.loads(p.stdout.strip().splitlines()[-1]), None
+    except (ValueError, IndexError):
+        return None, f"preflight emitted no JSON: {p.stdout[-500:]!r}"
+
+
+def _emit(payload, rc):
+    print(json.dumps(payload))
+    try:
+        with open(HISTORY_PATH, "a") as f:
+            f.write(json.dumps({"t": time.time(), **payload}) + "\n")
+    except OSError:
+        pass
+    sys.exit(rc)
+
+
+def _diagnostic(phase, error, device_state, **extra):
+    _emit(
+        {
+            "metric": "diagnostic",
+            "value": 0,
+            "unit": "none",
+            "vs_baseline": 0.0,
+            "phase": phase,
+            "error": str(error)[-2000:],
+            "device_state": device_state,
+            **extra,
+        },
+        3 if device_state != "healthy" else 4,
+    )
 
 
 def main():
+    attempts = []
+    info = None
+    for attempt in range(2):
+        info, err = _run_preflight()
+        if info is not None and not info.get("matmul_ok"):
+            # device initialized but computes garbage — that's still wedged
+            info, err = None, f"preflight matmul produced wrong result: {info}"
+        if info is not None:
+            break
+        attempts.append(err)
+        time.sleep(5)
+    if info is None:
+        _diagnostic(
+            "preflight",
+            attempts[-1],
+            "unreachable_or_wedged",
+            attempts=len(attempts),
+            all_errors=attempts,
+        )
+
+    print(f"preflight ok: {info}", file=sys.stderr)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--workload"],
+            capture_output=True,
+            text=True,
+            timeout=WORKLOAD_TIMEOUT_S,
+        )
+        workload_err = None if p.returncode == 0 else (
+            f"workload rc={p.returncode}: {p.stderr.strip()[-3000:]}"
+        )
+        stdout = p.stdout
+    except subprocess.TimeoutExpired as e:
+        workload_err = f"workload timed out after {WORKLOAD_TIMEOUT_S}s"
+        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+
+    if workload_err is None:
+        try:
+            result = json.loads(stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            _diagnostic(
+                "workload-parse",
+                f"workload rc=0 but emitted no JSON: {stdout[-500:]!r}",
+                "healthy",
+                preflight=info,
+            )
+        _emit({**result, "preflight": info}, 0)
+
+    # classify: did the device die under us, or is this a repo bug?
+    reprobe, reprobe_err = _run_preflight()
+    state = "healthy" if reprobe is not None else "died_during_workload"
+    _diagnostic(
+        "workload",
+        workload_err,
+        state,
+        preflight=info,
+        reprobe_error=reprobe_err,
+    )
+
+
+# --------------------------------------------------------------------------
+# workload (runs in the child process)
+# --------------------------------------------------------------------------
+
+
+def _train_bench():
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.parallel import make_mesh
+    from dalle_tpu.training import (
+        count_params,
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+    from dalle_tpu.training.profiler import dalle_train_flops, detect_peak_tflops
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    # BASELINE.json flagship: 12-layer DALL-E, 16k VQGAN tokens, 256px f16
     cfg = DALLEConfig(
         num_text_tokens=10000,
-        text_seq_len=256,
-        num_image_tokens=8192,
-        image_fmap_size=32,
-        dim=512,
-        depth=12,
+        text_seq_len=64 if smoke else 256,
+        num_image_tokens=16384,
+        image_fmap_size=8 if smoke else 16,
+        dim=128 if smoke else 512,
+        depth=2 if smoke else 12,
         heads=8,
-        dim_head=64,
+        dim_head=16 if smoke else 64,
         attn_types=("full",),
         dtype=jnp.bfloat16,
     )
     n_dev = len(jax.devices())
     mesh = make_mesh(dp=-1)
-    batch = 8 * n_dev
+    batch = (2 if smoke else 16) * n_dev
     rng = jax.random.PRNGKey(0)
     text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0, 10000)
-    codes = jax.random.randint(rng, (batch, cfg.image_seq_len), 0, 8192)
+    codes = jax.random.randint(rng, (batch, cfg.image_seq_len), 0, cfg.num_image_tokens)
 
     model = DALLE(cfg)
     tx = make_optimizer(3e-4, clip_grad_norm=0.5)
     params, opt_state = init_train_state(model, tx, mesh, {"params": rng}, text, codes)
     step = make_dalle_train_step(model, tx, mesh)
 
-    # warmup/compile
+    t_compile = time.perf_counter()
     params, opt_state, loss = step(params, opt_state, None, text, codes, rng)
     jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t_compile
 
-    iters = 20
+    iters = 3 if smoke else 20
     t0 = time.perf_counter()
     for i in range(iters):
         params, opt_state, loss = step(
@@ -68,26 +245,263 @@ def main():
 
     img_tokens_per_sec = batch * cfg.image_seq_len / dt / n_dev
     flops = dalle_train_flops(cfg, batch)
-    mfu = flops / dt / (detect_peak_tflops() * 1e12 * n_dev)
+    peak = detect_peak_tflops() * 1e12 * n_dev
+    mfu = flops / dt / peak
+    return {
+        "metric": "train_img_tokens_per_sec_per_chip",
+        "value": round(img_tokens_per_sec, 1),
+        "unit": "img_tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "mfu_target": 0.45,
+        "step_time_s": round(dt, 4),
+        "compile_time_s": round(compile_s, 1),
+        "batch": batch,
+        "n_devices": n_dev,
+        "params": count_params(params),
+        "device": jax.devices()[0].device_kind,
+        "platform": jax.default_backend(),
+        "loss": round(float(loss), 4),
+    }, cfg
 
-    print(
-        json.dumps(
-            {
-                "metric": "train_img_tokens_per_sec_per_chip",
-                "value": round(img_tokens_per_sec, 1),
-                "unit": "img_tokens/s/chip",
-                "vs_baseline": round(mfu / 0.45, 4),
-                "mfu": round(mfu, 4),
-                "step_time_s": round(dt, 4),
-                "batch": batch,
-                "n_devices": n_dev,
-                "params": count_params(params),
-                "device": jax.devices()[0].device_kind,
-                "loss": round(float(loss), 4),
+
+def _flash_check():
+    """On-TPU flash kernel evidence (round-2 VERDICT ask #3): non-interpret
+    fwd/bwd vs the dense oracle, fp32 + bf16, causal + block-sparse
+    layouts, and flash-vs-dense step time.  On CPU this records that it was
+    skipped (interpret-mode parity already lives in tests/test_flash.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops.flash import flash_attention, block_layout_from_mask
+    from dalle_tpu.ops.masks import block_sparse_mask, causal_mask
+
+    on_tpu = jax.default_backend() == "tpu"
+    out = {"on_tpu": on_tpu}
+    if not on_tpu and not os.environ.get("BENCH_SMOKE"):
+        out["skipped"] = "no TPU backend — interpret-mode parity in tests/test_flash.py"
+        return out
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    b, h, n, d = (1, 2, 256, 32) if smoke else (4, 8, 1024, 64)
+    blk = 64 if smoke else 128
+    text_len = n // 8
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+
+    sparse_mask = block_sparse_mask(n, text_len, block=blk, num_local_blocks=2)
+    layout = block_layout_from_mask(sparse_mask, blk, blk)
+    cases = [
+        ("causal", None, jnp.asarray(causal_mask(n))),
+        ("block_sparse", layout, jnp.asarray(sparse_mask)),
+    ]
+    for dtype_name, dtype, atol in [("fp32", jnp.float32, 2e-3), ("bf16", jnp.bfloat16, 3e-2)]:
+        q = jax.random.normal(kq, (b, h, n, d), dtype)
+        k = jax.random.normal(kk, (b, h, n, d), dtype)
+        v = jax.random.normal(kv, (b, h, n, d), dtype)
+        g = jax.random.normal(kg, (b, h, n, d), jnp.float32)
+        for case_name, lay, mask in cases:
+
+            def flash_loss(q, k, v):
+                o = flash_attention(q, k, v, layout=lay, causal=True,
+                                    block_q=blk, block_k=blk)
+                return jnp.sum(o.astype(jnp.float32) * g)
+
+            def dense_loss(q, k, v):
+                o = A.masked_attention(q, k, v, mask)
+                return jnp.sum(o.astype(jnp.float32) * g)
+
+            fo = flash_attention(
+                q, k, v, layout=lay, causal=True, block_q=blk, block_k=blk
+            )
+            do_ = A.masked_attention(q, k, v, mask)
+            fwd_err = float(jnp.max(jnp.abs(fo.astype(jnp.float32) - do_.astype(jnp.float32))))
+            gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+            gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+            bwd_err = max(
+                float(jnp.max(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32))))
+                for a, b_ in zip(gf, gd)
+            )
+            out[f"{case_name}_{dtype_name}"] = {
+                "fwd_max_err": round(fwd_err, 6),
+                "bwd_max_err": round(bwd_err, 6),
+                "ok": bool(fwd_err < atol and bwd_err < atol * 10),
             }
+
+    # timing: flash vs dense-masked, bf16 causal
+    q = jax.random.normal(kq, (b, h, n, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, n, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, n, d), jnp.bfloat16)
+    cm = jnp.asarray(causal_mask(n))
+    flash_fn = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=blk, block_k=blk)
+    )
+    dense_fn = jax.jit(lambda q, k, v: A.masked_attention(q, k, v, cm).astype(jnp.bfloat16))
+
+    def timeit(fn, iters=30):
+        r = fn(q, k, v)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(q, k, v)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters
+
+    tf, td = timeit(flash_fn), timeit(dense_fn)
+    out["flash_ms"] = round(tf * 1e3, 3)
+    out["dense_ms"] = round(td * 1e3, 3)
+    out["flash_speedup_vs_dense"] = round(td / tf, 2)
+    return out
+
+
+def _generate_bench(train_cfg):
+    """BASELINE.json metric 2: 256x256 end-to-end generation through the
+    jitted scan decode + VAE decode + CLIP rerank (reference recompute
+    loop: dalle_pytorch/dalle_pytorch.py:483-498)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.clip import CLIP, CLIPConfig
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.generate import generate_images
+    from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    cfg = train_cfg
+    img_size = 2**4 * cfg.image_fmap_size if smoke else 256
+    # 256px VAE with f16 downsampling matches image_fmap_size=16
+    vcfg = DiscreteVAEConfig(
+        image_size=img_size,
+        num_tokens=cfg.num_image_tokens,
+        codebook_dim=64 if smoke else 256,
+        num_layers=4,
+        hidden_dim=16 if smoke else 64,
+        dtype=jnp.bfloat16,
+    )
+    ccfg = CLIPConfig(
+        dim_text=64 if smoke else 256,
+        dim_image=64 if smoke else 256,
+        dim_latent=64 if smoke else 256,
+        num_text_tokens=cfg.num_text_tokens,
+        text_enc_depth=1 if smoke else 4,
+        text_seq_len=cfg.text_seq_len,
+        text_heads=4,
+        visual_enc_depth=1 if smoke else 4,
+        visual_heads=4,
+        visual_image_size=img_size,
+        visual_patch_size=32,
+    )
+    batch = 2 if smoke else 8
+    rng = jax.random.PRNGKey(1)
+    text = jax.random.randint(rng, (batch, cfg.text_seq_len), 1, cfg.num_text_tokens)
+    img = jax.random.uniform(rng, (2, img_size, img_size, 3))
+
+    model = DALLE(cfg)
+    codes0 = jax.random.randint(rng, (batch, cfg.image_seq_len), 0, cfg.num_image_tokens)
+    params = model.init({"params": rng}, text, codes0)["params"]
+    vae = DiscreteVAE(vcfg)
+    vparams = vae.init({"params": rng, "gumbel": rng}, img, return_loss=True)["params"]
+    clip = CLIP(ccfg)
+    cparams = clip.init({"params": rng}, text[:2], img)["params"]
+
+    def gen(text, key):
+        return generate_images(
+            model, params, vae, vparams, text, key,
+            clip=clip, clip_params=cparams,
         )
+
+    # compile + 1 warm run
+    images, scores = gen(text, rng)
+    jax.block_until_ready(images)
+    iters = 1 if smoke else 3
+    t0 = time.perf_counter()
+    for i in range(iters):
+        images, scores = gen(text, jax.random.fold_in(rng, i))
+    jax.block_until_ready(images)
+    dt = (time.perf_counter() - t0) / iters
+    assert images.shape == (batch, img_size, img_size, 3)
+    return {
+        "imgs_per_sec": round(batch / dt, 3),
+        "image_size": img_size,
+        "image_seq_len": cfg.image_seq_len,
+        "batch": batch,
+        "clip_score_mean": round(float(jnp.mean(scores)), 4),
+        "note": "random weights — measures pipeline speed; CLIP score is harness evidence only",
+    }
+
+
+def _mfu_history(platform: str, smoke: bool):
+    """Prior MFU values from runs comparable to this one — same platform,
+    same smoke-ness — so CPU smoke runs never pollute the TPU trend."""
+    hist = []
+    try:
+        with open(HISTORY_PATH) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (
+                    "mfu" in rec
+                    and rec.get("platform") == platform
+                    and bool(rec.get("smoke")) == smoke
+                ):
+                    hist.append(rec["mfu"])
+    except OSError:
+        pass
+    return hist[-10:]
+
+
+def _ingest_bench():
+    from dalle_tpu.data.ingest_bench import ingest_benchmark
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    return ingest_benchmark(
+        n_images=16 if smoke else 64,
+        image_size=64 if smoke else 256,
+        src_size=128 if smoke else 512,
+        batch_size=8 if smoke else 16,
+        epochs=1 if smoke else 2,
     )
 
 
+def workload():
+    result, cfg = _train_bench()
+    result["smoke"] = bool(os.environ.get("BENCH_SMOKE"))
+    for name, fn in [
+        ("flash_check", _flash_check),
+        ("generate", lambda: _generate_bench(cfg)),
+        ("ingest", _ingest_bench),
+    ]:
+        try:
+            result[name] = fn()
+        except Exception as e:  # keep the headline metric even if a side phase dies
+            result[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+    result["mfu_history"] = _mfu_history(result["platform"], result["smoke"]) + [
+        result["mfu"]
+    ]
+    if result["mfu"] < 0.45:
+        result["mfu_gap_note"] = (
+            "below 0.45 target — see training/profiler.py trace window for "
+            "per-op breakdown; rerun bench to extend mfu_history trend"
+        )
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", action="store_true")
+    ap.add_argument("--preflight", action="store_true")
+    args = ap.parse_args()
+    if args.preflight:
+        subprocess.run([sys.executable, "-c", _PREFLIGHT_CODE], check=True)
+    elif args.workload:
+        if os.environ.get("BENCH_PLATFORM"):
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+        workload()
+    else:
+        main()

@@ -11,6 +11,18 @@ TPU-first: the architectures are native Flax modules
 ``(module, params)`` pair plugs into the same train/generate steps as the
 in-tree DiscreteVAE — torch is used only once at load time to unpickle the
 released checkpoints (no torch in the compute path).
+
+Assurance level (round-2 VERDICT ask #7): the converters are golden-parity
+tested against exact-layout torch *replicas* of the released module trees
+(tests/torch_refs.py, logits atol 2e-4) — NOT against the real released
+pickles, which this environment cannot download (zero egress).  A replica
+divergence from the real artifact (forgotten buffer, version-skew key)
+would pass every test; ``convert_named`` partially mitigates by raising on
+any unconsumed/missing checkpoint key.  Until a real-artifact load is
+possible, integrity is enforced by checksum pinning: ``PINNED_SHA256``
+entries are verified when present, and every download records a
+trust-on-first-use ``<file>.sha256`` sidecar that later loads must match
+(detects corruption/substitution across runs even without official pins).
 """
 
 from __future__ import annotations
@@ -40,9 +52,59 @@ VQGAN_VAE_URL = "https://heibox.uni-heidelberg.de/f/140747ba53464f49b476/?dl=1"
 VQGAN_VAE_CONFIG_URL = "https://heibox.uni-heidelberg.de/f/6ecf2af6c658432c8298/?dl=1"
 
 
+# Official artifact hashes, verified when present.  Empty pending a
+# networked environment to compute them from the real downloads (this
+# build runs with zero egress); the TOFU sidecar below covers the gap.
+PINNED_SHA256: dict = {
+    # "encoder.pkl": "<sha256>",
+    # "decoder.pkl": "<sha256>",
+    # "vqgan.1024.model.ckpt": "<sha256>",
+    # "vqgan.1024.config.yml": "<sha256>",
+}
+
+
+def _sha256(path: Path) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while chunk := f.read(1 << 20):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _verify_checksum(path: Path, filename: str):
+    """Pin > sidecar > record-sidecar (trust on first use)."""
+    digest = _sha256(path)
+    pinned = PINNED_SHA256.get(filename)
+    if pinned is not None:
+        if digest != pinned:
+            raise RuntimeError(
+                f"checksum mismatch for {path}: got {digest}, pinned {pinned} "
+                "— the file is corrupt or substituted; delete it and re-download"
+            )
+        return
+    sidecar = path.with_name(path.name + ".sha256")
+    if sidecar.exists():
+        recorded = sidecar.read_text().strip()
+        if digest != recorded:
+            raise RuntimeError(
+                f"checksum mismatch for {path}: got {digest}, previously "
+                f"recorded {recorded} ({sidecar}) — the cached file changed "
+                "since first use; delete both to re-download"
+            )
+    else:
+        # atomic (tmp + rename) so concurrent ranks never read a torn
+        # sidecar; identical content makes the last-rename-wins race benign
+        tmp = sidecar.with_name(f"{sidecar.name}.{os.getpid()}.tmp")
+        tmp.write_text(digest + "\n")
+        os.replace(tmp, sidecar)
+
+
 def download(url: str, filename: str, root: Path = CACHE_PATH) -> str:
-    """Rank-0 downloads, others wait at the barrier until the file exists
-    (reference: vae.py:53-94)."""
+    """Rank-0 downloads, others wait at the barrier until the file exists;
+    integrity checked against PINNED_SHA256 or the TOFU sidecar
+    (reference download coordination: vae.py:53-94)."""
     from dalle_tpu.parallel import backend as backend_lib
 
     root.mkdir(parents=True, exist_ok=True)
@@ -50,10 +112,12 @@ def download(url: str, filename: str, root: Path = CACHE_PATH) -> str:
     b = backend_lib.backend
     is_root = b is None or b.is_local_root_worker()
     if path.exists():
+        _verify_checksum(path, filename)
         return str(path)
     if not is_root:
         b.local_barrier()
         assert path.exists(), f"rank-0 download of {filename} did not appear"
+        _verify_checksum(path, filename)
         return str(path)
     try:
         tmp = path.with_suffix(".tmp")
@@ -69,6 +133,7 @@ def download(url: str, filename: str, root: Path = CACHE_PATH) -> str:
     finally:
         if b is not None:
             b.local_barrier()
+    _verify_checksum(path, filename)
     return str(path)
 
 

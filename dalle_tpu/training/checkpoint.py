@@ -26,9 +26,24 @@ import shutil
 from pathlib import Path
 from typing import Any, Optional
 
+import jax
 import orbax.checkpoint as ocp
 
 _SUBTREES = ("params", "opt_state", "vae_params")
+
+
+def _is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+def _mp_barrier(tag: str):
+    """Cross-process sync so only process 0 manipulates directories while
+    every process writes its own array shards (the reference's rank-0 +
+    local_barrier download idiom, vae.py:53-94, applied to checkpoints)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"dalle_tpu_ckpt_{tag}")
 
 
 def save_checkpoint(
@@ -46,32 +61,39 @@ def save_checkpoint(
 ) -> str:
     path = Path(path).absolute()
     tmp = path.with_name(path.name + ".tmp")
-    if tmp.exists():
-        shutil.rmtree(tmp)
-    tmp.mkdir(parents=True)
+    if _is_primary():
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+    _mp_barrier("mkdir")
 
+    # every process participates in the sharded-array writes (orbax
+    # coordinates shard ownership internally)
     ckptr = ocp.StandardCheckpointer()
     trees = {"params": params, "opt_state": opt_state, "vae_params": vae_params}
     for name in _SUBTREES:
         if trees[name] is not None:
             ckptr.save(tmp / name, trees[name])
     ckptr.wait_until_finished()
-    meta = {
-        "format": "dalle_tpu/v1",
-        "hparams": hparams,
-        "vae_hparams": vae_hparams,
-        "epoch": epoch,
-        "step": step,
-        "scheduler_state": scheduler_state,
-        "subtrees": [n for n in _SUBTREES if trees[n] is not None],
-    }
-    (tmp / "meta.json").write_text(json.dumps(meta, indent=2))
-    if path.exists():
-        shutil.rmtree(path)
-    tmp.rename(path)
+    _mp_barrier("saved")
+    if _is_primary():
+        meta = {
+            "format": "dalle_tpu/v1",
+            "hparams": hparams,
+            "vae_hparams": vae_hparams,
+            "epoch": epoch,
+            "step": step,
+            "scheduler_state": scheduler_state,
+            "subtrees": [n for n in _SUBTREES if trees[n] is not None],
+        }
+        (tmp / "meta.json").write_text(json.dumps(meta, indent=2))
+        if path.exists():
+            shutil.rmtree(path)
+        tmp.rename(path)
 
-    if keep_n is not None:
-        prune_checkpoints(path.parent, keep_n, pattern=_family_pattern(path.name))
+        if keep_n is not None:
+            prune_checkpoints(path.parent, keep_n, pattern=_family_pattern(path.name))
+    _mp_barrier("renamed")
     return str(path)
 
 

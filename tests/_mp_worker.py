@@ -1,0 +1,76 @@
+"""Worker for the 2-process jax.distributed localhost CPU test
+(tests/test_multiprocess.py).  Exercises the JaxBackend rendezvous /
+barrier / average_all surface the way the reference exercises its
+DeepSpeed backend under a real launcher (reference:
+distributed_backends/deepspeed_backend.py:36-39), plus a checkpoint
+save-under-mesh-A / restore-under-mesh-B round trip.
+
+Usage: python _mp_worker.py <process_id> <num_processes> <coordinator> <tmpdir>
+"""
+
+import os
+import sys
+
+proc_id, nproc = int(sys.argv[1]), int(sys.argv[2])
+coord, tmpdir = sys.argv[3], sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from dalle_tpu.parallel import make_mesh  # noqa: E402
+from dalle_tpu.parallel.backend import JaxBackend  # noqa: E402
+from dalle_tpu.training.checkpoint import load_checkpoint, save_checkpoint  # noqa: E402
+
+
+def main():
+    backend = JaxBackend()
+    backend.initialize(
+        coordinator_address=coord, num_processes=nproc, process_id=proc_id, dp=-1
+    )
+    assert backend.get_world_size() == nproc, backend.get_world_size()
+    assert backend.get_rank() == proc_id, backend.get_rank()
+    assert len(jax.devices()) == 2 * nproc, len(jax.devices())
+
+    backend.local_barrier()
+
+    # average_all: rank r contributes r+1 → mean over 2 ranks = 1.5
+    avg = backend.average_all(np.float32(proc_id + 1))
+    assert abs(float(avg) - 1.5) < 1e-6, float(avg)
+
+    # checkpoint: save under mesh A (dp=4), restore under mesh B (dp=2,tp=2)
+    mesh_a = make_mesh(dp=-1)
+    assert mesh_a.shape["dp"] == 2 * nproc
+    data = np.arange(32 * 3, dtype=np.float32).reshape(32, 3)
+    sh_a = NamedSharding(mesh_a, P("dp"))
+    arr = jax.make_array_from_callback(data.shape, sh_a, lambda idx: data[idx])
+    ckpt_path = os.path.join(tmpdir, "ckpt-mp")
+    save_checkpoint(ckpt_path, params={"w": arr}, hparams={"n": 1}, step=7)
+
+    mesh_b = make_mesh(dp=2, tp=2)
+    sh_b = NamedSharding(mesh_b, P(("dp", "tp")))
+    target = {"w": jax.ShapeDtypeStruct(data.shape, np.float32, sharding=sh_b)}
+    out = load_checkpoint(ckpt_path, params_target=target)
+    assert out["step"] == 7 and out["hparams"] == {"n": 1}
+    restored = out["params"]["w"]
+    assert restored.sharding.mesh.shape == {"dp": 2, "tp": 2} or (
+        dict(restored.sharding.mesh.shape)["dp"] == 2
+    )
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(restored, tiled=True)
+    np.testing.assert_array_equal(np.asarray(gathered).reshape(data.shape), data)
+
+    backend.local_barrier()
+    print(f"MP_WORKER_OK rank={proc_id}")
+
+
+if __name__ == "__main__":
+    main()
