@@ -234,6 +234,19 @@ def _train_bench():
     jax.block_until_ready(loss)
     compile_s = time.perf_counter() - t_compile
 
+    # BENCH_PROFILE=<dir>: capture a jax.profiler trace of 3 steps for
+    # per-op MFU attack (training/profiler.py; view with xprof/tensorboard)
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        from dalle_tpu.training.profiler import profile_window
+
+        with profile_window(profile_dir):
+            for i in range(3):
+                params, opt_state, loss = step(
+                    params, opt_state, None, text, codes, jax.random.fold_in(rng, 100 + i)
+                )
+            jax.block_until_ready(loss)
+
     iters = 3 if smoke else 20
     t0 = time.perf_counter()
     for i in range(iters):
@@ -262,6 +275,7 @@ def _train_bench():
         "device": jax.devices()[0].device_kind,
         "platform": jax.default_backend(),
         "loss": round(float(loss), 4),
+        **({"profile_trace": profile_dir} if profile_dir else {}),
     }, cfg
 
 

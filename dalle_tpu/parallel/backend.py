@@ -84,10 +84,27 @@ class Backend:
         raise NotImplementedError
 
     def check_batch_size(self, batch_size: int):
-        # global-batch semantics (reference: distributed_backend.py:56-60)
-        assert batch_size >= self.get_world_size(), (
-            f"global batch size {batch_size} < world size {self.get_world_size()}"
+        # global-batch semantics (reference: distributed_backend.py:56-60),
+        # tightened for SPMD: the batch must actually shard over the mesh's
+        # data axes, so fail at startup with an actionable message instead
+        # of deep inside device_put
+        world = self.get_world_size()
+        assert batch_size >= world, (
+            f"global batch size {batch_size} < world size {world}"
         )
+        assert batch_size % world == 0, (
+            f"global batch size {batch_size} is not divisible by world size "
+            f"{world}; every process must hold an equal local batch"
+        )
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None:
+            data_ways = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            assert batch_size % data_ways == 0, (
+                f"global batch size {batch_size} is not divisible by "
+                f"dp*fsdp = {data_ways} "
+                f"(mesh {dict(mesh.shape)}); raise --batch_size or shrink "
+                "--mesh_dp/--mesh_fsdp"
+            )
 
 
 class SingleBackend(Backend):

@@ -48,6 +48,14 @@ def parse_args(argv=None):
     parser.add_argument("--bpe_path", type=str, default=None)
     parser.add_argument("--clip_path", type=str, default=None,
                         help="optional CLIP checkpoint for reranking scores")
+    # pretrained-VAE override, reference-compatible (reference:
+    # generate.py:86-91): normally the self-describing checkpoint already
+    # embeds the exact VAE; these flags swap in a taming VQGAN instead
+    parser.add_argument("--taming", action="store_true",
+                        help="rebuild the VAE as a taming VQGAN (with the "
+                             "two flags below, or the 1024-token default)")
+    parser.add_argument("--vqgan_model_path", type=str, default=None)
+    parser.add_argument("--vqgan_config_path", type=str, default=None)
     parser.add_argument("--seed", type=int, default=0)
     return parser.parse_args(argv)
 
@@ -64,11 +72,25 @@ def main(argv=None):
     cfg = DALLEConfig.from_dict(ckpt["hparams"])
     model = DALLE(cfg)
     params = jax.device_put(ckpt["params"])
-    assert ckpt.get("vae_hparams"), "checkpoint lacks an embedded VAE"
-    from dalle_tpu.models.vae_registry import build_vae
+    if args.taming or args.vqgan_model_path or args.vqgan_config_path:
+        from dalle_tpu.models.pretrained import load_vqgan
 
-    vae, _ = build_vae(ckpt["vae_hparams"])
-    vae_params = jax.device_put(ckpt["vae_params"])
+        vae, vae_params = load_vqgan(args.vqgan_model_path, args.vqgan_config_path)
+        assert vae.cfg.n_embed == cfg.num_image_tokens, (
+            f"VQGAN codebook {vae.cfg.n_embed} != model's "
+            f"num_image_tokens {cfg.num_image_tokens}"
+        )
+        assert vae.cfg.fmap_size == cfg.image_fmap_size, (
+            f"VQGAN feature map {vae.cfg.fmap_size} != model's "
+            f"image_fmap_size {cfg.image_fmap_size} — wrong downsampling "
+            "factor; decode would scramble the code grid"
+        )
+    else:
+        assert ckpt.get("vae_hparams"), "checkpoint lacks an embedded VAE"
+        from dalle_tpu.models.vae_registry import build_vae
+
+        vae, _ = build_vae(ckpt["vae_hparams"])
+        vae_params = jax.device_put(ckpt["vae_params"])
 
     clip = clip_params = None
     if args.clip_path:

@@ -91,3 +91,119 @@ def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
     assert len(reds) == 3 and len(greens) == 3
     img = Image.open(reds[0])
     assert img.size == (16, 16)
+
+
+def test_train_dalle_webdataset_cli(tmp_path):
+    """train_dalle end to end from tar shards (--wds), the reference's
+    webdataset mode (reference: train_dalle.py:353-374,400-405)."""
+    import io
+    import tarfile
+
+    import numpy as np
+    from PIL import Image
+
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    rng = np.random.RandomState(0)
+    for s in range(2):
+        with tarfile.open(shard_dir / f"shard-{s:04d}.tar", "w") as tar:
+            for i in range(12):
+                img = Image.fromarray(
+                    rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+                )
+                buf = io.BytesIO()
+                img.save(buf, format="PNG")
+                for name, data in (
+                    (f"sample{s}_{i}.png", buf.getvalue()),
+                    (f"sample{s}_{i}.txt", f"caption {s} {i}".encode()),
+                ):
+                    info = tarfile.TarInfo(name)
+                    info.size = len(data)
+                    tar.addfile(info, io.BytesIO(data))
+
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir()
+    for i in range(8):
+        Image.fromarray(
+            rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+        ).save(img_dir / f"im{i}.png")
+
+    import train_vae as tv
+
+    vae_out = tmp_path / "vae"
+    tv.main([
+        "--image_folder", str(img_dir), "--image_size", "16",
+        "--num_tokens", "16", "--num_layers", "2", "--num_resnet_blocks", "0",
+        "--emb_dim", "8", "--hidden_dim", "8", "--batch_size", "8",
+        "--epochs", "1", "--no_wandb", "--output_path", str(vae_out),
+    ])
+
+    import train_dalle as td
+
+    out = tmp_path / "dalle"
+    td.main([
+        "--image_text_folder", str(shard_dir), "--wds", "txt,png",
+        "--dataset_size", "48",  # bound the endless stream: 6 batches/epoch
+        "--vae_path", str(vae_out / "vae-final"),
+        "--epochs", "1", "--batch_size", "8", "--dim", "16", "--depth", "1",
+        "--heads", "2", "--dim_head", "8", "--text_seq_len", "8",
+        "--attn_types", "full", "--truncate_captions", "--no_wandb",
+        "--output_path", str(out),
+    ])
+    assert (out / "dalle-final" / "meta.json").exists()
+
+
+def test_generate_with_vqgan_override(tmp_path):
+    """generate.py --taming/--vqgan_* rebuilds the VAE from a taming-layout
+    checkpoint instead of the embedded one (reference: generate.py:86-91) —
+    incl. the case of a DALLE checkpoint with NO embedded VAE."""
+    import numpy as np
+    import torch
+    import torch_refs as TR
+    from test_golden_vae import _seed_params, _vqgan_yaml
+
+    import jax
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.vqgan import VQGANConfig
+    from dalle_tpu.training.checkpoint import save_checkpoint
+
+    vcfg = VQGANConfig(
+        ch=32, ch_mult=(1, 2), num_res_blocks=1, attn_resolutions=(8,),
+        resolution=16, z_channels=32, n_embed=32, embed_dim=32,
+    )
+    t_model = TR.TVQModel(
+        ch=vcfg.ch, ch_mult=vcfg.ch_mult, num_res_blocks=vcfg.num_res_blocks,
+        attn_resolutions=vcfg.attn_resolutions, resolution=vcfg.resolution,
+        in_channels=3, z_channels=vcfg.z_channels, n_embed=vcfg.n_embed,
+        embed_dim=vcfg.embed_dim, gumbel=False,
+    ).eval()
+    _seed_params(t_model, 3)
+    vq_ckpt = str(tmp_path / "vq.ckpt")
+    torch.save({"state_dict": t_model.state_dict()}, vq_ckpt)
+    vq_yaml = _vqgan_yaml(tmp_path, vcfg, gumbel=False)
+
+    cfg = DALLEConfig(
+        num_text_tokens=49408, text_seq_len=8, num_image_tokens=vcfg.n_embed,
+        image_fmap_size=vcfg.fmap_size, dim=16, depth=1, heads=2, dim_head=8,
+        attn_types=("full",),
+    )
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (1, 8), 1, 100)
+    codes = jax.random.randint(rng, (1, cfg.image_seq_len), 0, vcfg.n_embed)
+    params = model.init({"params": rng}, text, codes)["params"]
+    dalle_ckpt = str(tmp_path / "dalle-no-vae")
+    save_checkpoint(dalle_ckpt, params=params, hparams=cfg.to_dict())
+
+    import generate as gen
+
+    outdir = tmp_path / "out"
+    gen.main([
+        "--dalle_path", dalle_ckpt, "--taming",
+        "--vqgan_model_path", vq_ckpt, "--vqgan_config_path", vq_yaml,
+        "--text", "a tiny test", "--num_images", "2", "--batch_size", "2",
+        "--outputs_dir", str(outdir),
+    ])
+    written = list(outdir.glob("*/*.jpg"))
+    assert len(written) == 2, written

@@ -56,6 +56,11 @@ def parse_args(argv=None):
                         help="folder of paired files, or tar-shard spec (--wds)")
     parser.add_argument("--wds", type=str, default="",
                         help="comma-sep caption,image keys to enable webdataset mode")
+    parser.add_argument("--dataset_size", type=int, default=int(1e9),
+                        help="nominal sample count for endless tar streams; "
+                             "one 'epoch' = dataset_size/batch_size batches "
+                             "(the reference hard-codes 1e9, "
+                             "train_dalle.py:354,403-405)")
     parser.add_argument("--truncate_captions", action="store_true")
     parser.add_argument("--random_resize_crop_lower_ratio", dest="resize_ratio",
                         type=float, default=0.75)
@@ -83,8 +88,10 @@ def parse_args(argv=None):
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
     parser.add_argument("--lr_decay", action="store_true")
-    parser.add_argument("--bf16", "--fp16", dest="bf16", action="store_true",
-                        help="bf16 compute (supersedes the reference's fp16)")
+    parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
+                        action="store_true",
+                        help="bf16 compute (supersedes the reference's "
+                             "fp16/Apex-AMP, train_dalle.py:77-78,466-472)")
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="dalle_ckpt")
     # --- model (reference: train_dalle.py:111-135)
@@ -242,7 +249,7 @@ def main(argv=None):
             text_len=cfg.text_seq_len,
             image_size=image_size,
             truncate_captions=args.truncate_captions,
-            nominal_length=int(1e9 // args.batch_size),
+            nominal_length=max(args.dataset_size // args.batch_size, 1),
         )
         epoch_len = None
     else:
