@@ -123,14 +123,20 @@ def _diagnostic(phase, error, device_state, **extra):
     )
 
 
+def _healthy_preflight():
+    """Preflight + garbage check: a device that initializes but computes a
+    wrong matmul is still wedged.  Returns (info | None, error | None)."""
+    info, err = _run_preflight()
+    if info is not None and not info.get("matmul_ok"):
+        return None, f"preflight matmul produced wrong result: {info}"
+    return info, err
+
+
 def main():
     attempts = []
     info = None
     for attempt in range(2):
-        info, err = _run_preflight()
-        if info is not None and not info.get("matmul_ok"):
-            # device initialized but computes garbage — that's still wedged
-            info, err = None, f"preflight matmul produced wrong result: {info}"
+        info, err = _healthy_preflight()
         if info is not None:
             break
         attempts.append(err)
@@ -173,7 +179,7 @@ def main():
         _emit({**result, "preflight": info}, 0)
 
     # classify: did the device die under us, or is this a repo bug?
-    reprobe, reprobe_err = _run_preflight()
+    reprobe, reprobe_err = _healthy_preflight()
     state = "healthy" if reprobe is not None else "died_during_workload"
     _diagnostic(
         "workload",

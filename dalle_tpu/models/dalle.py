@@ -65,7 +65,8 @@ class DALLEConfig:
     sparse_local_blocks: int = 4
     sparse_random_blocks: Optional[int] = None
     use_flash: Optional[bool] = None  # None = auto (Pallas kernel on TPU)
-    sp_axis: Optional[str] = None  # ring-attention sequence parallelism
+    sp_axis: Optional[str] = None  # sequence parallelism over this mesh axis
+    sp_mode: str = "ring"  # "ring" (ppermute) | "ulysses" (all_to_all)
     pp_stages: int = 1  # GPipe pipeline parallelism over the 'pp' mesh axis
     pp_microbatches: int = 4
     moe_experts: int = 0  # >0: every moe_every-th FF is a routed MoE ('ep' axis)
@@ -119,6 +120,7 @@ class DALLEConfig:
             sparse_random_blocks=self.sparse_random_blocks,
             use_flash=self.use_flash,
             sp_axis=self.sp_axis,
+            sp_mode=self.sp_mode,
             pp_stages=self.pp_stages,
             pp_microbatches=self.pp_microbatches,
             moe_experts=self.moe_experts,

@@ -74,31 +74,54 @@ def _sha256(path: Path) -> str:
 
 
 def _verify_checksum(path: Path, filename: str):
-    """Pin > sidecar > record-sidecar (trust on first use)."""
-    digest = _sha256(path)
-    pinned = PINNED_SHA256.get(filename)
-    if pinned is not None:
-        if digest != pinned:
-            raise RuntimeError(
-                f"checksum mismatch for {path}: got {digest}, pinned {pinned} "
-                "— the file is corrupt or substituted; delete it and re-download"
-            )
-        return
+    """Pin > sidecar > record-sidecar (trust on first use).
+
+    Full-file hashing is NOT free for the ~GB released artifacts, so a
+    cache hit normally pays only a size comparison against the sidecar;
+    the full hash runs when the sidecar is first recorded, when the size
+    disagrees, or when ``DALLE_TPU_VERIFY_ARTIFACTS=1`` forces a deep
+    check (which also re-validates any PINNED_SHA256 entry)."""
     sidecar = path.with_name(path.name + ".sha256")
+    pinned = PINNED_SHA256.get(filename)
+    deep = bool(os.environ.get("DALLE_TPU_VERIFY_ARTIFACTS"))
+    size = path.stat().st_size
+
+    recorded_digest = recorded_size = None
     if sidecar.exists():
-        recorded = sidecar.read_text().strip()
-        if digest != recorded:
+        parts = sidecar.read_text().split()
+        recorded_digest = parts[0] if parts else None
+        recorded_size = int(parts[1]) if len(parts) > 1 else None
+
+    if recorded_digest is not None and not deep:
+        if recorded_size == size:
+            return  # fast path: same size as when first hashed
+        # size drifted → fall through to the full hash for the real verdict
+
+    digest = _sha256(path)
+    if pinned is not None and digest != pinned:
+        raise RuntimeError(
+            f"checksum mismatch for {path}: got {digest}, pinned {pinned} "
+            "— the file is corrupt or substituted; delete it and re-download"
+        )
+    if recorded_digest is not None:
+        if digest != recorded_digest:
             raise RuntimeError(
                 f"checksum mismatch for {path}: got {digest}, previously "
-                f"recorded {recorded} ({sidecar}) — the cached file changed "
-                "since first use; delete both to re-download"
+                f"recorded {recorded_digest} ({sidecar}) — the cached file "
+                "changed since first use; delete both to re-download"
             )
+        if recorded_size != size:  # heal legacy/size-less sidecars
+            _write_sidecar(sidecar, digest, size)
     else:
-        # atomic (tmp + rename) so concurrent ranks never read a torn
-        # sidecar; identical content makes the last-rename-wins race benign
-        tmp = sidecar.with_name(f"{sidecar.name}.{os.getpid()}.tmp")
-        tmp.write_text(digest + "\n")
-        os.replace(tmp, sidecar)
+        _write_sidecar(sidecar, digest, size)
+
+
+def _write_sidecar(sidecar: Path, digest: str, size: int):
+    # atomic (tmp + rename) so concurrent ranks never read a torn sidecar;
+    # identical content makes the last-rename-wins race benign
+    tmp = sidecar.with_name(f"{sidecar.name}.{os.getpid()}.tmp")
+    tmp.write_text(f"{digest} {size}\n")
+    os.replace(tmp, sidecar)
 
 
 def download(url: str, filename: str, root: Path = CACHE_PATH) -> str:

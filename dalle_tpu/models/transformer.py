@@ -78,6 +78,11 @@ class TransformerConfig:
     # sequence parallelism: mesh axis name for ring attention on 'full'
     # layers (requires an ambient mesh via jax.set_mesh); None = off
     sp_axis: Optional[str] = None
+    # which sequence-parallel scheme serves 'full' attention when sp_axis
+    # is set: "ring" = ppermute K/V rotation (parallel/ring.py), "ulysses"
+    # = all_to_all head<->sequence re-shard (parallel/ulysses.py; needs
+    # local heads % sp == 0).  The reference has neither (SURVEY.md §5.7).
+    sp_mode: str = "ring"
     # pipeline parallelism: >1 partitions the depth into contiguous stages
     # executed with a GPipe microbatch schedule over the 'pp' mesh axis
     # (parallel/pipeline.py).  Requires depth % pp_stages == 0 and the
@@ -365,6 +370,14 @@ class JointAttention(nn.Module):
         c = self.cfg
         if c.sp_axis is not None:
             if self.attn_type == "full" and key_pad_mask is None:
+                if c.sp_mode == "ulysses":
+                    from dalle_tpu.parallel.ulysses import (
+                        ulysses_attention_sharded,
+                    )
+
+                    return ulysses_attention_sharded(
+                        q, k, v, sp_axis=c.sp_axis, causal=True
+                    )
                 from dalle_tpu.parallel.ring import ring_attention_sharded
 
                 return ring_attention_sharded(q, k, v, sp_axis=c.sp_axis, causal=True)

@@ -1,0 +1,99 @@
+"""Ulysses-style all-to-all sequence/context parallelism over ``sp``.
+
+The second of the two canonical sequence-parallel schemes (the first, ring
+attention, lives in :mod:`dalle_tpu.parallel.ring`; the reference has
+neither — SURVEY.md §5.7).  Where the ring rotates K/V chunks P times with
+``ppermute``, Ulysses re-shards ONCE each way with ``all_to_all``:
+
+    [b, h, n/P, d]  --all_to_all(head→seq)-->  [b, h/P, n, d]
+        full-sequence attention on a head subset (flash on TPU)
+    [b, h/P, n, d]  --all_to_all(seq→head)-->  [b, h, n/P, d]
+
+Trade-off vs ring: 2 collectives total instead of P rotations (lower
+latency when P is large and heads are plentiful), but each device must
+hold the FULL sequence for its head shard during attention — so it pairs
+with the flash kernel (O(n) memory) rather than a dense n² score matrix.
+Requires ``heads % P == 0``; the ring has no such constraint.  Selection:
+``TransformerConfig.sp_mode = "ring" | "ulysses"`` (CLI ``--sp_mode``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Local view: q, k, v [b, h, n_local, d], sequence sharded over
+    ``axis_name``; h must divide by the axis size.  Returns the local
+    output chunk [b, h, n_local, d]."""
+    p_size = jax.lax.axis_size(axis_name)
+    b, h, nl, d = q.shape
+    assert h % p_size == 0, (
+        f"ulysses needs tp-LOCAL heads % sp == 0, got local heads={h} "
+        f"(model heads / mesh tp size), sp={p_size} — raise heads, shrink "
+        "tp or sp, or use sp_mode='ring' which has no head constraint"
+    )
+
+    def to_seq(x):  # [b, h, n/P, d] -> [b, h/P, n, d]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def to_heads(x):  # [b, h/P, n, d] -> [b, h, n/P, d]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qg, kg, vg = to_seq(q), to_seq(k), to_seq(v)
+    if causal and jax.default_backend() == "tpu":
+        # O(n)-memory local attention — the pairing that makes Ulysses a
+        # long-context scheme rather than an n² trade
+        from dalle_tpu.ops.flash import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=True)
+    else:
+        from dalle_tpu.ops import attention as attn_ops
+
+        if causal:
+            out = attn_ops.full_causal_attention(qg, kg, vg)
+        else:
+            out = attn_ops._sdpa(qg, kg, vg, None)
+    return to_heads(out.astype(q.dtype))
+
+
+def ulysses_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    sp_axis: str = "sp",
+    causal: bool = True,
+    mesh=None,
+):
+    """Global view: q, k, v [b, h, n, d] under jit with an (ambient) mesh.
+    Same spec-wiring as :func:`ring_attention_sharded`: batch over
+    (dp, fsdp), heads over tp, sequence over ``sp_axis``."""
+    if mesh is None:
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        mesh = get_ambient_mesh()
+    assert mesh is not None, (
+        "ulysses attention needs a mesh: pass mesh= or run the step under "
+        "dalle_tpu.parallel.mesh.ambient(mesh) (train_lib does this)"
+    )
+    spec = P(("dp", "fsdp"), "tp", sp_axis, None)
+    fn = functools.partial(ulysses_attention, axis_name=sp_axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

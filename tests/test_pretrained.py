@@ -170,6 +170,16 @@ def test_download_checksum_tofu_and_pin(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="checksum mismatch"):
         P.download("http://unused", "artifact.bin", root=tmp_path)
 
+    # same-SIZE mutation passes the cheap boot check by design, but the
+    # deep-verify env flag catches it
+    f.write_bytes(b"release-bytes-v2")  # same length as v1
+    P.download("http://unused", "artifact.bin", root=tmp_path)  # fast path
+    monkeypatch.setenv("DALLE_TPU_VERIFY_ARTIFACTS", "1")
+    with pytest.raises(RuntimeError, match="checksum mismatch"):
+        P.download("http://unused", "artifact.bin", root=tmp_path)
+    monkeypatch.delenv("DALLE_TPU_VERIFY_ARTIFACTS")
+    f.write_bytes(b"release-bytes-v1")
+
     # a wrong official pin also fails, sidecar or not
     f.write_bytes(b"release-bytes-v1")
     sidecar.unlink()

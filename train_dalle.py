@@ -120,7 +120,15 @@ def parse_args(argv=None):
                         help="pipeline-parallel stages (needs --mesh_pp)")
     parser.add_argument("--pp_microbatches", type=int, default=4)
     parser.add_argument("--sp_ring", action="store_true",
-                        help="ring-attention sequence parallelism over mesh_sp")
+                        help="sequence parallelism over mesh_sp (scheme "
+                             "chosen by --sp_mode)")
+    parser.add_argument("--sp_mode", type=str, default=None,
+                        choices=("ring", "ulysses"),
+                        help="enables sequence parallelism with the given "
+                             "scheme (implies --sp_ring): ring = ppermute "
+                             "K/V rotation; ulysses = all_to_all head<->seq "
+                             "re-shard (tp-local heads, i.e. heads/mesh_tp, "
+                             "must divide by mesh_sp)")
     parser.add_argument("--moe_experts", type=int, default=0,
                         help=">0: every moe_every-th FF is a routed MoE "
                              "(expert weights shard over --mesh_ep)")
@@ -219,7 +227,10 @@ def main(argv=None):
             use_remat=args.use_remat,
             pp_stages=args.pp_stages,
             pp_microbatches=args.pp_microbatches,
-            sp_axis="sp" if args.sp_ring else None,
+            # --sp_mode alone enables SP too: asking for a scheme means
+            # asking for sequence parallelism
+            sp_axis="sp" if (args.sp_ring or args.sp_mode) else None,
+            sp_mode=args.sp_mode or "ring",
             moe_experts=args.moe_experts,
             moe_every=args.moe_every,
             moe_top_k=args.moe_top_k,
