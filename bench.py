@@ -187,6 +187,7 @@ def main():
         state,
         preflight=info,
         reprobe_error=reprobe_err,
+        partial_stdout=stdout.strip()[-500:],
     )
 
 

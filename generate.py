@@ -57,6 +57,11 @@ def parse_args(argv=None):
     parser.add_argument("--vqgan_model_path", type=str, default=None)
     parser.add_argument("--vqgan_config_path", type=str, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    # sharded inference (beyond-reference: the reference generates on one
+    # GPU only, generate.py:93-95): shard params over a device mesh and run
+    # the scan decode under it — needed for models too big for one chip
+    for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
+        parser.add_argument(f"--mesh_{ax}", type=int, default=None)
     return parser.parse_args(argv)
 
 
@@ -98,59 +103,86 @@ def main(argv=None):
         clip = CLIP(CLIPConfig.from_dict(cp["hparams"]))
         clip_params = jax.device_put(cp["params"])
 
-    rng = jax.random.PRNGKey(args.seed)
-    for prompt_i, raw_text in enumerate(args.text.split("|")):
-        raw_text = raw_text.strip()
-        if args.gentxt:
-            # text completion (reference: generate.py:104-106)
-            prompt_ids = np.asarray(
-                tokenizer.tokenize(raw_text, cfg.text_seq_len, truncate_text=True)
-            )[0]
-            prompt_ids = prompt_ids[prompt_ids != 0][None]
-            completed = generate_texts(
-                model, params, jax.random.fold_in(rng, 7 * prompt_i),
-                text=jnp.asarray(prompt_ids),
-            )
-            raw_text = tokenizer.decode(
-                np.asarray(completed)[0],
-                pad_tokens=frozenset(
-                    range(cfg.num_text_tokens, cfg.total_text_tokens)
-                ),
-            )
-            print(f"completed prompt: {raw_text!r}")
-        tokens = tokenizer.tokenize(
-            raw_text, cfg.text_seq_len, truncate_text=True
-        ).astype(np.int32)
+    # optional sharded inference: any --mesh_* flag builds a mesh, shards
+    # the transformer params over it (tp rules split heads/FF; VAE convs
+    # replicate), and runs the whole prompt loop under the ambient mesh —
+    # parity with unsharded decode pinned by tests/test_generate.py
+    mesh_kw = {
+        ax: getattr(args, f"mesh_{ax}")
+        for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep")
+        if getattr(args, f"mesh_{ax}", None)
+    }
+    import contextlib
 
-        outdir = Path(args.outputs_dir) / raw_text.replace(" ", "_")[:100]
-        outdir.mkdir(parents=True, exist_ok=True)
-        (outdir / "caption.txt").write_text(raw_text + "\n")
+    stack = contextlib.ExitStack()
+    if mesh_kw:
+        from dalle_tpu.parallel import make_mesh
+        from dalle_tpu.parallel.mesh import ambient
+        from dalle_tpu.parallel.partition import shard_params
 
-        made = 0
-        chunk_i = 0
-        while made < args.num_images:
-            n = min(args.batch_size, args.num_images - made)
-            text_batch = jnp.asarray(np.repeat(tokens, args.batch_size, axis=0))
-            key = jax.random.fold_in(rng, prompt_i * 10_000 + chunk_i)
-            out = generate_images(
-                model, params, vae, vae_params, text_batch, key,
-                filter_thres=args.top_k, temperature=args.temperature,
-                clip=clip, clip_params=clip_params,
-            )
-            images, scores = out if clip is not None else (out, None)
-            images = np.asarray(images, np.float32)[:n]
-            order = (
-                np.argsort(-np.asarray(scores)[:n]) if scores is not None else range(n)
-            )
-            from PIL import Image
+        mesh = make_mesh(**mesh_kw)
+        params = shard_params(params, mesh)
+        vae_params = shard_params(vae_params, mesh)
+        if clip_params is not None:
+            clip_params = shard_params(clip_params, mesh)
+        stack.enter_context(ambient(mesh))
+        print(f"sharded inference over mesh {dict(mesh.shape)}")
 
-            for rank_j, j in enumerate(order):
-                arr = (np.clip(images[j], 0, 1) * 255).astype(np.uint8)
-                Image.fromarray(arr).save(outdir / f"{made + rank_j}.jpg")
-            made += n
-            chunk_i += 1
-        print(f"wrote {made} images to {outdir}/")
+    try:
+        rng = jax.random.PRNGKey(args.seed)
+        for prompt_i, raw_text in enumerate(args.text.split("|")):
+            raw_text = raw_text.strip()
+            if args.gentxt:
+                # text completion (reference: generate.py:104-106)
+                prompt_ids = np.asarray(
+                    tokenizer.tokenize(raw_text, cfg.text_seq_len, truncate_text=True)
+                )[0]
+                prompt_ids = prompt_ids[prompt_ids != 0][None]
+                completed = generate_texts(
+                    model, params, jax.random.fold_in(rng, 7 * prompt_i),
+                    text=jnp.asarray(prompt_ids),
+                )
+                raw_text = tokenizer.decode(
+                    np.asarray(completed)[0],
+                    pad_tokens=frozenset(
+                        range(cfg.num_text_tokens, cfg.total_text_tokens)
+                    ),
+                )
+                print(f"completed prompt: {raw_text!r}")
+            tokens = tokenizer.tokenize(
+                raw_text, cfg.text_seq_len, truncate_text=True
+            ).astype(np.int32)
 
+            outdir = Path(args.outputs_dir) / raw_text.replace(" ", "_")[:100]
+            outdir.mkdir(parents=True, exist_ok=True)
+            (outdir / "caption.txt").write_text(raw_text + "\n")
+
+            made = 0
+            chunk_i = 0
+            while made < args.num_images:
+                n = min(args.batch_size, args.num_images - made)
+                text_batch = jnp.asarray(np.repeat(tokens, args.batch_size, axis=0))
+                key = jax.random.fold_in(rng, prompt_i * 10_000 + chunk_i)
+                out = generate_images(
+                    model, params, vae, vae_params, text_batch, key,
+                    filter_thres=args.top_k, temperature=args.temperature,
+                    clip=clip, clip_params=clip_params,
+                )
+                images, scores = out if clip is not None else (out, None)
+                images = np.asarray(images, np.float32)[:n]
+                order = (
+                    np.argsort(-np.asarray(scores)[:n]) if scores is not None else range(n)
+                )
+                from PIL import Image
+
+                for rank_j, j in enumerate(order):
+                    arr = (np.clip(images[j], 0, 1) * 255).astype(np.uint8)
+                    Image.fromarray(arr).save(outdir / f"{made + rank_j}.jpg")
+                made += n
+                chunk_i += 1
+            print(f"wrote {made} images to {outdir}/")
+    finally:
+        stack.close()
 
 if __name__ == "__main__":
     main()
