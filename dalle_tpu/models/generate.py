@@ -38,7 +38,7 @@ PRIME_FRACTION = 0.4375
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "num_steps", "start", "filter_thres", "temperature"),
+    static_argnames=("model", "num_steps", "start", "filter_thres", "temperature", "top_p"),
 )
 def scan_decode(
     model: DALLE,
@@ -51,6 +51,7 @@ def scan_decode(
     prefill_text: Optional[jnp.ndarray] = None,
     filter_thres: float = 0.9,
     temperature: float = 1.0,
+    top_p: Optional[float] = None,
 ):
     """Decode positions [start, start+num_steps); returns sampled combined
     ids [b, num_steps] where entry i is the sample from position
@@ -74,7 +75,8 @@ def scan_decode(
             {"params": params}, fed, p, cache, method=DALLE.decode_step
         )
         sampled = sample_logits(
-            k, logits, temperature=temperature, filter_thres=filter_thres
+            k, logits, temperature=temperature, filter_thres=filter_thres,
+            top_p=top_p,
         ).astype(jnp.int32)
         return (cache, sampled), sampled
 
@@ -115,6 +117,7 @@ def generate_image_codes(
     *,
     filter_thres: float = 0.9,
     temperature: float = 1.0,
+    top_p: Optional[float] = None,
     prime_codes: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """text [b, text_seq_len] → image codes [b, image_seq_len]."""
@@ -133,6 +136,7 @@ def generate_image_codes(
         prefill_text=text.astype(jnp.int32),
         filter_thres=filter_thres,
         temperature=temperature,
+        top_p=top_p,
     )
     img_samples = samples - c.total_text_tokens
     codes = jnp.clip(img_samples, 0, c.num_image_tokens - 1)
@@ -152,6 +156,7 @@ def generate_images(
     *,
     filter_thres: float = 0.9,
     temperature: float = 1.0,
+    top_p: Optional[float] = None,
     img: Optional[jnp.ndarray] = None,
     num_init_img_tokens: Optional[int] = None,
     clip=None,
@@ -181,6 +186,7 @@ def generate_images(
         key,
         filter_thres=filter_thres,
         temperature=temperature,
+        top_p=top_p,
         prime_codes=prime_codes,
     )
     images = vae.apply({"params": vae_params}, codes, method=type(vae).decode)

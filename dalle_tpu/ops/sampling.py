@@ -26,14 +26,43 @@ def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
+def top_p_filter(logits: jnp.ndarray, top_p: float = 0.9) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest logit set whose probability
+    mass reaches ``top_p``, -inf the rest.  Beyond-reference (the reference
+    offers only fractional top-k); jit-safe — a sort, a cumsum, and a
+    gather-back, no dynamic shapes."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # position i is kept iff the mass BEFORE it is < top_p (so the token
+    # that crosses the threshold is included)
+    keep_sorted = (cum - probs) < top_p
+    # threshold value = smallest kept logit; everything below is cut
+    kth = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
 def sample_logits(
     key: jax.Array,
     logits: jnp.ndarray,
     *,
     temperature: float = 1.0,
     filter_thres: float = 0.5,
+    top_p: float | None = None,
 ) -> jnp.ndarray:
-    """Top-k filter → temperature → categorical sample.  Returns int32 ids."""
-    filtered = top_k_filter(logits, filter_thres)
+    """(Top-p | top-k) filter → temperature → categorical sample.
+
+    ``top_p`` (nucleus) takes precedence over the reference's fractional
+    top-k when given.  Returns int32 ids."""
+    if top_p is not None:
+        assert 0.0 < top_p <= 1.0, (
+            f"top_p must be in (0, 1], got {top_p} — <=0 would silence "
+            "every token and always emit id 0"
+        )
+        filtered = top_p_filter(logits, top_p)
+    else:
+        filtered = top_k_filter(logits, filter_thres)
     t = jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
     return jax.random.categorical(key, filtered / t, axis=-1)

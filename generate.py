@@ -39,6 +39,9 @@ def parse_args(argv=None):
     parser.add_argument("--batch_size", type=int, default=4)
     parser.add_argument("--top_k", type=float, default=0.9,
                         help="fractional top-k filter threshold")
+    parser.add_argument("--top_p", type=float, default=None,
+                        help="nucleus sampling mass (overrides --top_k; "
+                             "beyond-reference)")
     parser.add_argument("--temperature", type=float, default=1.0)
     parser.add_argument("--outputs_dir", type=str, default="outputs")
     parser.add_argument("--gentxt", action="store_true",
@@ -166,7 +169,7 @@ def main(argv=None):
                 out = generate_images(
                     model, params, vae, vae_params, text_batch, key,
                     filter_thres=args.top_k, temperature=args.temperature,
-                    clip=clip, clip_params=clip_params,
+                    top_p=args.top_p, clip=clip, clip_params=clip_params,
                 )
                 images, scores = out if clip is not None else (out, None)
                 images = np.asarray(images, np.float32)[:n]

@@ -184,3 +184,18 @@ def test_tp_sharded_generation_matches_unsharded(rng):
     with ambient(mesh):
         out = generate_image_codes(model, shard_params(params, mesh), text, rng)
     np.testing.assert_array_equal(np.asarray(base), np.asarray(out))
+
+
+def test_generate_with_top_p(rng):
+    """top_p (nucleus) threads through the jitted scan decode and changes
+    the sampling distribution vs top-k (beyond-reference)."""
+    model, params, text, _ = build(rng)
+    out = generate_image_codes(model, params, text, rng, top_p=0.9)
+    assert out.shape == (2, N_IMG)
+    assert int(out.min()) >= 0 and int(out.max()) < 20
+    # near-zero mass → greedy: equals temperature→0 top-k decode
+    greedy_p = generate_image_codes(model, params, text, rng, top_p=1e-6)
+    greedy_k = generate_image_codes(
+        model, params, text, rng, filter_thres=0.0, temperature=1e-8
+    )
+    np.testing.assert_array_equal(np.asarray(greedy_p), np.asarray(greedy_k))

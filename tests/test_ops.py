@@ -137,3 +137,31 @@ def test_sample_logits_respects_filter(rng):
         jax.random.split(rng, 32)
     )
     assert (np.asarray(ids) == 3).all()
+
+
+def test_top_p_filter_keeps_nucleus():
+    from dalle_tpu.ops.sampling import top_p_filter
+
+    # probs ~ [0.643, 0.236, 0.087, 0.032, 0.002] for logits [4,3,2,1,-2]
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0, -2.0]])
+    out = np.asarray(top_p_filter(logits, top_p=0.8))
+    # 0.643 < 0.8 → keep; 0.643+0.236=0.879 crosses 0.8 → token 2 is the
+    # crossing token and is kept; everything after is cut
+    assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+    assert out[0, 2] == -np.inf and out[0, 3] == -np.inf and out[0, 4] == -np.inf
+    # top_p=1.0 keeps everything
+    assert np.isfinite(np.asarray(top_p_filter(logits, top_p=1.0))).all()
+    # tiny top_p keeps exactly the argmax
+    out_min = np.asarray(top_p_filter(logits, top_p=1e-6))
+    assert np.isfinite(out_min[0, 0]) and (out_min[0, 1:] == -np.inf).all()
+
+
+def test_sample_logits_top_p_respects_nucleus(rng):
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0, -2.0]])
+    keys = jax.random.split(rng, 64)
+    ids = np.asarray(
+        [sample_logits(k, logits, top_p=0.8, temperature=1.0)[0] for k in keys]
+    )
+    assert set(ids) <= {0, 1}
+
+
