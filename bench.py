@@ -68,6 +68,11 @@ print(json.dumps({
 """
 
 
+def _smoke() -> bool:
+    """BENCH_SMOKE=1 shrinks every phase for CPU harness validation."""
+    return bool(os.environ.get("BENCH_SMOKE"))
+
+
 # --------------------------------------------------------------------------
 # parent orchestration
 # --------------------------------------------------------------------------
@@ -210,7 +215,7 @@ def _train_bench():
     )
     from dalle_tpu.training.profiler import dalle_train_flops, detect_peak_tflops
 
-    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    smoke = _smoke()
 
     def build(use_flash):
         # BASELINE.json flagship: 12-layer DALL-E, 16k VQGAN tokens, 256px f16
@@ -324,11 +329,11 @@ def _flash_check():
 
     on_tpu = jax.default_backend() == "tpu"
     out = {"on_tpu": on_tpu}
-    if not on_tpu and not os.environ.get("BENCH_SMOKE"):
+    if not on_tpu and not _smoke():
         out["skipped"] = "no TPU backend — interpret-mode parity in tests/test_flash.py"
         return out
 
-    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    smoke = _smoke()
     b, h, n, d = (1, 2, 256, 32) if smoke else (4, 8, 1024, 64)
     blk = 64 if smoke else 128
     text_len = n // 8
@@ -412,7 +417,7 @@ def _generate_bench(train_cfg):
     from dalle_tpu.models.generate import generate_images
     from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
 
-    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    smoke = _smoke()
     cfg = train_cfg
     img_size = 2**4 * cfg.image_fmap_size if smoke else 256
     # 256px VAE with f16 downsampling matches image_fmap_size=16
@@ -501,7 +506,7 @@ def _mfu_history(platform: str, smoke: bool):
 def _ingest_bench():
     from dalle_tpu.data.ingest_bench import ingest_benchmark
 
-    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    smoke = _smoke()
     return ingest_benchmark(
         n_images=16 if smoke else 64,
         image_size=64 if smoke else 256,
@@ -513,7 +518,7 @@ def _ingest_bench():
 
 def workload():
     result, cfg = _train_bench()
-    result["smoke"] = bool(os.environ.get("BENCH_SMOKE"))
+    result["smoke"] = _smoke()
     for name, fn in [
         ("flash_check", _flash_check),
         ("generate", lambda: _generate_bench(cfg)),

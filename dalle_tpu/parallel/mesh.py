@@ -102,3 +102,13 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def mesh_kwargs_from_args(args) -> dict:
+    """{axis: size} for every --mesh_<axis> CLI flag that was set — the
+    shared idiom of the three CLIs (train_vae / train_dalle / generate)."""
+    return {
+        ax: getattr(args, f"mesh_{ax}")
+        for ax in AXES
+        if getattr(args, f"mesh_{ax}", None)
+    }

@@ -16,6 +16,7 @@ chunk — not image_seq_len full forwards per image.
 """
 
 import argparse
+import contextlib
 from pathlib import Path
 
 import jax
@@ -110,13 +111,9 @@ def main(argv=None):
     # the transformer params over it (tp rules split heads/FF; VAE convs
     # replicate), and runs the whole prompt loop under the ambient mesh —
     # parity with unsharded decode pinned by tests/test_generate.py
-    mesh_kw = {
-        ax: getattr(args, f"mesh_{ax}")
-        for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep")
-        if getattr(args, f"mesh_{ax}", None)
-    }
-    import contextlib
+    from dalle_tpu.parallel.mesh import mesh_kwargs_from_args
 
+    mesh_kw = mesh_kwargs_from_args(args)
     stack = contextlib.ExitStack()
     if mesh_kw:
         from dalle_tpu.parallel import make_mesh

@@ -68,12 +68,9 @@ def main(argv=None):
     dalle_tpu.force_cpu_if_virtual()
     args = parse_args(argv)
     distr = backend_lib.set_backend_from_args(args)
-    mesh_kw = {
-        ax: getattr(args, f"mesh_{ax}")
-        for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep")
-        if getattr(args, f"mesh_{ax}", None)
-    }
-    distr.initialize(**mesh_kw)
+    from dalle_tpu.parallel.mesh import mesh_kwargs_from_args
+
+    distr.initialize(**mesh_kwargs_from_args(args))
     distr.check_batch_size(args.batch_size)
     is_root = distr.is_root_worker()
 
