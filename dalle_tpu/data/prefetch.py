@@ -1,0 +1,55 @@
+"""Host→device prefetch: overlap the next batch's H2D transfer with the
+current step's compute.
+
+The reference moves each batch to the accelerator synchronously inside the
+loop (`text, images = map(lambda t: t.cuda(), ...)` — reference:
+train_dalle.py:572).  On TPU the idiomatic form keeps ``depth`` batches in
+flight: ``jax.device_put`` only *enqueues* the transfer, so issuing it one
+iteration early lets DMA run under the previous step's compute instead of
+serializing with it.  The jitted train steps treat an already-correctly-
+sharded input's ``device_put`` as a no-op, so wrapping the loader is the
+whole integration.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator
+
+import jax
+
+
+def device_prefetch(it: Iterable, sharding, depth: int = 2) -> Iterator:
+    """Yield items of ``it`` as device arrays placed with ``sharding``,
+    keeping up to ``depth`` transfers in flight ahead of the consumer.
+    Tuples/pytrees of host arrays are transferred leaf-wise."""
+    assert depth >= 1
+    queue: collections.deque = collections.deque()
+
+    def put(item):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), item
+        )
+
+    for item in it:
+        queue.append(put(item))
+        if len(queue) >= depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
+
+
+def local_rows(arr, k: int):
+    """First ``k`` rows of ``arr`` addressable on THIS process, as host
+    numpy.  On a globally-sharded batch (multi-host run), ``arr[:k]`` /
+    ``np.asarray(arr)`` would touch non-addressable shards and raise;
+    logging/sampling paths only need *some* local rows, which this
+    provides (single-process: identical to ``arr[:k]``)."""
+    import numpy as np
+
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        shards = sorted(
+            arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards])[:k]
+    return np.asarray(arr[:k])

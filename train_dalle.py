@@ -22,6 +22,8 @@ import numpy as np
 import optax
 
 from dalle_tpu.data import BatchedWebLoader, DataLoader, TextImageDataset, WebDataset
+from dalle_tpu.data.prefetch import device_prefetch, local_rows
+from dalle_tpu.parallel.mesh import batch_sharding
 from dalle_tpu.models.dalle import DALLE, DALLEConfig
 from dalle_tpu.models.generate import generate_images
 from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
@@ -360,7 +362,8 @@ def main(argv=None):
         # the host only syncs on the logging cadence and at epoch end
         loss_sum = None
         loss_count = 0
-        for i, (text, images) in enumerate(loader):
+        batches = device_prefetch(loader, batch_sharding(distr.mesh))
+        for i, (text, images) in enumerate(batches):
             if args.flops_profiler and global_step == 200 and is_root:
                 jax.profiler.start_trace(str(ckpt_dir / "profile"))
             out = step_fn(
@@ -400,7 +403,9 @@ def main(argv=None):
                 )
             if is_root and global_step % 100 == 0 and global_step != 0:
                 # in-loop sample generation (reference: train_dalle.py:604-619)
-                sample_text = jnp.asarray(text[:1])
+                # local_rows: text is a globally-sharded device batch under
+                # multi-host prefetch; plain text[:1] would touch remote shards
+                sample_text = jnp.asarray(local_rows(text, 1))
                 imgs = generate_images(
                     model, params, vae, vae_params, sample_text,
                     # distinct stream from the train-step keys (fold_in

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_tpu.data import DataLoader, ImageFolderDataset
+from dalle_tpu.data.prefetch import device_prefetch, local_rows
+from dalle_tpu.parallel.mesh import batch_sharding
 from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
 from dalle_tpu.parallel import backend as backend_lib
 from dalle_tpu.training import (
@@ -137,7 +139,7 @@ def main(argv=None):
 
     for epoch in range(args.epochs):
         loader.set_epoch(epoch)
-        for images in loader:
+        for images in device_prefetch(loader, batch_sharding(distr.mesh)):
             params, opt_state, loss, recons = step_fn(
                 params, opt_state, images, temp, jax.random.fold_in(rng, global_step)
             )
@@ -151,13 +153,15 @@ def main(argv=None):
                 opt_state = set_learning_rate(opt_state, lr)
                 if is_root:
                     k = args.num_images_save
-                    images_np = np.asarray(images[:k])
-                    codes = encode_fn(params, images[:k])
+                    # local_rows: under multi-host prefetch the batch is
+                    # globally sharded; images[:k] would touch remote shards
+                    images_np = local_rows(images, k)
+                    codes = encode_fn(params, jnp.asarray(images_np))
                     hard = np.asarray(decode_fn(params, codes))
                     run.log_images("original", images_np, global_step)
                     run.log_images("hard_recon", np.clip(hard, 0, 1), global_step)
                     run.log_images(
-                        "soft_recon", np.clip(np.asarray(recons[:k]), 0, 1), global_step
+                        "soft_recon", np.clip(local_rows(recons, k), 0, 1), global_step
                     )
                     run.log_histogram(
                         "codebook_indices", np.asarray(codes), global_step
