@@ -25,11 +25,24 @@ def device_prefetch(it: Iterable, sharding, depth: int = 2) -> Iterator:
     Tuples/pytrees of host arrays are transferred leaf-wise."""
     assert depth >= 1
     queue: collections.deque = collections.deque()
+    multiproc = jax.process_count() > 1
+
+    def put_leaf(x):
+        if multiproc:
+            # each process's loader yields its LOCAL batch rows
+            # (loader.py rank/world slicing); device_put with a global
+            # sharding would misread them as the global array —
+            # make_array_from_process_local_data assembles the true
+            # global batch from the per-process pieces
+            import numpy as np
+
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            )
+        return jax.device_put(x, sharding)
 
     def put(item):
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding), item
-        )
+        return jax.tree_util.tree_map(put_leaf, item)
 
     for item in it:
         queue.append(put(item))
@@ -48,8 +61,12 @@ def local_rows(arr, k: int):
     import numpy as np
 
     if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
-        shards = sorted(
-            arr.addressable_shards, key=lambda s: s.index[0].start or 0
-        )
+        # dedupe replicated shards (tp/sp replicate the batch dim): keep
+        # one shard per distinct index, ordered by batch start
+        unique = {}
+        for s in arr.addressable_shards:
+            key = tuple((sl.start, sl.stop) for sl in s.index)
+            unique.setdefault(key, s)
+        shards = sorted(unique.values(), key=lambda s: s.index[0].start or 0)
         return np.concatenate([np.asarray(s.data) for s in shards])[:k]
     return np.asarray(arr[:k])

@@ -77,14 +77,25 @@ def main(argv=None):
     tokenizer = get_tokenizer(bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese)
 
     assert is_checkpoint(args.dalle_path), f"{args.dalle_path}: not a checkpoint"
+    # orbax restores arrays with the sharding they were SAVED under — i.e.
+    # each artifact's own training mesh.  Mixing checkpoints trained on
+    # different meshes (DALLE on 8 devices, CLIP on 4) inside one jit is an
+    # error, so place everything on one device here; the --mesh_* branch
+    # below re-shards for sharded inference.
+    device0 = jax.devices()[0]
+
+    def place(tree):
+        return jax.device_put(tree, device0)
+
     ckpt = load_checkpoint(args.dalle_path)
     cfg = DALLEConfig.from_dict(ckpt["hparams"])
     model = DALLE(cfg)
-    params = jax.device_put(ckpt["params"])
+    params = place(ckpt["params"])
     if args.taming or args.vqgan_model_path or args.vqgan_config_path:
         from dalle_tpu.models.pretrained import load_vqgan
 
         vae, vae_params = load_vqgan(args.vqgan_model_path, args.vqgan_config_path)
+        vae_params = place(vae_params)
         assert vae.cfg.n_embed == cfg.num_image_tokens, (
             f"VQGAN codebook {vae.cfg.n_embed} != model's "
             f"num_image_tokens {cfg.num_image_tokens}"
@@ -99,13 +110,17 @@ def main(argv=None):
         from dalle_tpu.models.vae_registry import build_vae
 
         vae, _ = build_vae(ckpt["vae_hparams"])
-        vae_params = jax.device_put(ckpt["vae_params"])
+        vae_params = place(ckpt["vae_params"])
 
     clip = clip_params = None
     if args.clip_path:
         cp = load_checkpoint(args.clip_path)
         clip = CLIP(CLIPConfig.from_dict(cp["hparams"]))
-        clip_params = jax.device_put(cp["params"])
+        clip_params = place(cp["params"])
+        assert clip.cfg.text_seq_len == cfg.text_seq_len, (
+            f"CLIP text_seq_len {clip.cfg.text_seq_len} != DALLE's "
+            f"{cfg.text_seq_len}; rerank scores need matching tokenization"
+        )
 
     # optional sharded inference: any --mesh_* flag builds a mesh, shards
     # the transformer params over it (tp rules split heads/FF; VAE convs

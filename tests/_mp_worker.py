@@ -45,8 +45,35 @@ def main():
     avg = backend.average_all(np.float32(proc_id + 1))
     assert abs(float(avg) - 1.5) < 1e-6, float(avg)
 
-    # checkpoint: save under mesh A (dp=4), restore under mesh B (dp=2,tp=2)
+    # device_prefetch multi-host: each process contributes its LOCAL batch
+    # rows; the assembled global array must contain every process's rows
+    # exactly once (prefetch.py uses make_array_from_process_local_data)
+    from jax.experimental import multihost_utils
+
+    from dalle_tpu.data.prefetch import device_prefetch, local_rows
+
     mesh_a = make_mesh(dp=-1)
+    sh = NamedSharding(mesh_a, P("dp"))
+    local = (np.arange(8, dtype=np.float32).reshape(4, 2) + 100 * proc_id,)
+    [(batch,)] = list(device_prefetch(iter([local]), sh, depth=2))
+    assert batch.shape == (4 * nproc, 2), batch.shape
+    gathered = multihost_utils.process_allgather(batch, tiled=True)
+    want = np.concatenate(
+        [np.arange(8, dtype=np.float32).reshape(4, 2) + 100 * r for r in range(nproc)]
+    )
+    np.testing.assert_array_equal(np.asarray(gathered), want)
+    # local_rows returns this process's own rows, no cross-process fetch
+    np.testing.assert_array_equal(local_rows(batch, 2), local[0][:2])
+
+    # with tp in the mesh the batch dim is REPLICATED across tp shards;
+    # local_rows must dedupe replicas, not concatenate duplicate rows
+    mesh_c = make_mesh(dp=2, tp=2)
+    sh_c = NamedSharding(mesh_c, P("dp"))
+    local_c = (np.arange(4, dtype=np.float32).reshape(2, 2) + 100 * proc_id,)
+    [(batch_c,)] = list(device_prefetch(iter([local_c]), sh_c, depth=2))
+    np.testing.assert_array_equal(local_rows(batch_c, 2), local_c[0][:2])
+
+    # checkpoint: save under mesh A (dp=4), restore under mesh B (dp=2,tp=2)
     assert mesh_a.shape["dp"] == 2 * nproc
     data = np.arange(32 * 3, dtype=np.float32).reshape(32, 3)
     sh_a = NamedSharding(mesh_a, P("dp"))

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""CLIP training CLI (beyond-reference).
+
+The reference trains CLIP only through a README code snippet
+(reference: README.md:210-235) and wires reranking into
+``DALLE.generate_images(clip=...)`` (reference: dalle_pytorch.py:505-507)
+— it ships no way to actually produce a CLIP checkpoint from the command
+line.  This CLI closes that workflow gap: paired text-image folder (same
+dataset contract as train_dalle) → contrastive InfoNCE training via the
+jitted ``make_clip_train_step`` → a self-describing checkpoint that
+``generate.py --clip_path`` loads for reranking.
+"""
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from dalle_tpu.data import DataLoader, TextImageDataset
+from dalle_tpu.data.prefetch import device_prefetch
+from dalle_tpu.models.clip import CLIP, CLIPConfig
+from dalle_tpu.parallel import backend as backend_lib
+from dalle_tpu.parallel.mesh import batch_sharding, mesh_kwargs_from_args
+from dalle_tpu.training import (
+    count_params,
+    init_train_state,
+    make_clip_train_step,
+    make_optimizer,
+)
+from dalle_tpu.training.checkpoint import save_checkpoint
+from dalle_tpu.training.logging import Run
+from dalle_tpu.tokenizers import get_tokenizer
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="Train CLIP (TPU-native)")
+    parser.add_argument("--image_text_folder", type=str, required=True,
+                        help="folder of stem-paired *.txt / image files "
+                             "(same contract as train_dalle)")
+    parser.add_argument("--truncate_captions", action="store_true")
+    parser.add_argument("--chinese", action="store_true")
+    parser.add_argument("--hug", action="store_true")
+    parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--epochs", type=int, default=20)
+    parser.add_argument("--learning_rate", type=float, default=3e-4)
+    parser.add_argument("--clip_grad_norm", type=float, default=0.5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--output_path", type=str, default="clip_ckpt")
+    parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--wandb_name", type=str, default="clip_train")
+    parser.add_argument("--no_wandb", action="store_true")
+    # model (defaults mirror the reference README snippet, README.md:210-227)
+    parser.add_argument("--dim_text", type=int, default=512)
+    parser.add_argument("--dim_image", type=int, default=512)
+    parser.add_argument("--dim_latent", type=int, default=512)
+    parser.add_argument("--text_seq_len", type=int, default=256)
+    parser.add_argument("--text_enc_depth", type=int, default=6)
+    parser.add_argument("--text_heads", type=int, default=8)
+    parser.add_argument("--visual_enc_depth", type=int, default=6)
+    parser.add_argument("--visual_heads", type=int, default=8)
+    parser.add_argument("--image_size", type=int, default=256)
+    parser.add_argument("--patch_size", type=int, default=32)
+    parser.add_argument("--num_text_tokens", type=int, default=None,
+                        help="default: tokenizer vocab size")
+    for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
+        parser.add_argument(f"--mesh_{ax}", type=int, default=None)
+    parser.add_argument("--distributed_backend", "--distr_backend",
+                        type=str, default=None)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    import dalle_tpu
+
+    dalle_tpu.force_cpu_if_virtual()
+    args = parse_args(argv)
+    distr = backend_lib.set_backend_from_args(args)
+    distr.initialize(**mesh_kwargs_from_args(args))
+    distr.check_batch_size(args.batch_size)
+    is_root = distr.is_root_worker()
+    rank, world = distr.get_rank(), distr.get_world_size()
+
+    tokenizer = get_tokenizer(
+        bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese
+    )
+    ds = TextImageDataset(
+        args.image_text_folder,
+        text_len=args.text_seq_len,
+        image_size=args.image_size,
+        truncate_captions=args.truncate_captions,
+        tokenizer=tokenizer,
+        shuffle=True,
+        seed=args.seed,
+    )
+    assert len(ds) > 0, f"no image-text pairs at {args.image_text_folder}"
+    loader = DataLoader(
+        ds, args.batch_size, shuffle=True, seed=args.seed, rank=rank, world=world
+    )
+
+    cfg = CLIPConfig(
+        dim_text=args.dim_text,
+        dim_image=args.dim_image,
+        dim_latent=args.dim_latent,
+        num_text_tokens=args.num_text_tokens or tokenizer.vocab_size,
+        text_enc_depth=args.text_enc_depth,
+        text_seq_len=args.text_seq_len,
+        text_heads=args.text_heads,
+        visual_enc_depth=args.visual_enc_depth,
+        visual_heads=args.visual_heads,
+        visual_image_size=args.image_size,
+        visual_patch_size=args.patch_size,
+    )
+    clip = CLIP(cfg)
+    rng = jax.random.PRNGKey(args.seed)
+    text0 = np.zeros((args.batch_size // world, args.text_seq_len), np.int32)
+    img0 = np.zeros(
+        (args.batch_size // world, args.image_size, args.image_size, 3), np.float32
+    )
+    tx = make_optimizer(args.learning_rate, clip_grad_norm=args.clip_grad_norm)
+    params, opt_state = init_train_state(
+        clip, tx, distr.mesh, {"params": rng}, text0, img0
+    )
+    step_fn = make_clip_train_step(clip, tx, distr.mesh)
+    if is_root:
+        print(f"CLIP params: {count_params(params):,}; dataset: {len(ds)} pairs")
+
+    from pathlib import Path
+
+    ckpt_dir = Path(args.output_path)
+    run = Run(
+        "dalle_tpu_train_clip",
+        config={**cfg.to_dict(), "batch_size": args.batch_size,
+                "lr": args.learning_rate},
+        name=args.wandb_name,
+        use_wandb=not args.no_wandb,
+    ) if is_root else None
+
+    def save(name):
+        if is_root:
+            save_checkpoint(
+                str(ckpt_dir / name), params=params, hparams=cfg.to_dict(),
+                step=global_step,
+            )
+
+    global_step = 0
+    save("clip-init")  # fail-early (reference idiom: train_dalle.py:561-563)
+    t0 = time.time()
+    for epoch in range(args.epochs):
+        loader.set_epoch(epoch)
+        for text, images in device_prefetch(loader, batch_sharding(distr.mesh)):
+            params, opt_state, loss = step_fn(
+                params, opt_state, text, images, jax.random.fold_in(rng, global_step)
+            )
+            if global_step % 10 == 0:
+                loss_f = float(distr.average_all(loss))
+                # first log is 1 step in (and includes compile): no rate yet
+                rate = (
+                    args.batch_size * 10 / max(time.time() - t0, 1e-9)
+                    if global_step else 0.0
+                )
+                t0 = time.time()
+                if is_root:
+                    print(
+                        f"epoch {epoch} step {global_step} loss {loss_f:.5f} "
+                        f"({rate:.1f} samples/s)"
+                    )
+                    run.log(
+                        {"loss": loss_f, "epoch": epoch,
+                         "samples_per_sec": rate},
+                        step=global_step,
+                    )
+            if global_step and global_step % args.save_every_n_steps == 0:
+                save(f"clip-step{global_step}")
+            global_step += 1
+        save(f"clip-epoch{epoch}")
+    save("clip-final")
+    if is_root:
+        run.log_artifact(str(ckpt_dir / "clip-final"), name="trained-clip")
+        run.finish()
+        print(f"saved {ckpt_dir/'clip-final'}")
+
+
+if __name__ == "__main__":
+    main(None)
