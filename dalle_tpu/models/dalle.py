@@ -59,6 +59,7 @@ class DALLEConfig:
     rotary_emb: bool = False
     reversible: bool = False
     use_remat: bool = False
+    remat_policy: str = "full"  # "full" | "dots" | "dots_no_batch"
     kernel_size: int = 5
     dilation: int = 1
     sparse_block: int = 16
@@ -110,6 +111,7 @@ class DALLEConfig:
             causal=True,
             reversible=self.reversible,
             use_remat=self.use_remat,
+            remat_policy=self.remat_policy,
             rotary=self.rotary_emb,
             shift_tokens=self.shift_tokens,
             sandwich_norm=self.sandwich_norm,

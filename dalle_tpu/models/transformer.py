@@ -62,6 +62,11 @@ class TransformerConfig:
     causal: bool = True
     reversible: bool = False
     use_remat: bool = False  # jax.checkpoint each block (memory lever)
+    # what the checkpointed blocks may KEEP instead of recomputing:
+    #   "full"          — save nothing (max memory savings, 2x flops in bwd)
+    #   "dots"          — save matmul outputs, recompute elementwise only
+    #   "dots_no_batch" — save only batch-free matmuls (the usual TP choice)
+    remat_policy: str = "full"
     rotary: bool = False
     shift_tokens: bool = False
     sandwich_norm: bool = False
@@ -173,6 +178,24 @@ def _warn_constraint_skipped_once(shape, wanted, used, sp_dropped):
         "replicated/partial sharding for this shape (correct but slower)",
         stacklevel=3,
     )
+
+
+def _layer_cls(c: "TransformerConfig"):
+    """SubLayer, optionally wrapped in nn.remat with the configured
+    rematerialization policy (SURVEY.md §7 stage 7: remat is the idiomatic
+    memory lever next to true reversibility)."""
+    if not c.use_remat:
+        return SubLayer
+    policies = {
+        "full": None,
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    assert c.remat_policy in policies, (
+        f"unknown remat_policy {c.remat_policy!r}; options: {sorted(policies)}"
+    )
+    policy = policies[c.remat_policy]
+    return nn.remat(SubLayer, policy=policy) if policy else nn.remat(SubLayer)
 
 
 def _sum_sown_losses(mut) -> jnp.ndarray:
@@ -641,7 +664,7 @@ class TransformerStage(nn.Module):
     def setup(self):
         c = self.cfg
         per = c.depth // c.pp_stages
-        layer_cls = nn.remat(SubLayer) if c.use_remat else SubLayer
+        layer_cls = _layer_cls(c)
         pairs = []
         for j in range(per):
             gi = self.stage_ind * per + j  # global index (LayerScale init)
@@ -720,7 +743,7 @@ class Transformer(nn.Module):
         # use_remat: recompute each sublayer in backward instead of storing
         # activations — the idiomatic JAX stand-in for the reference's
         # reversible autograd trick (reference: reversible.py:108-124).
-        layer_cls = nn.remat(SubLayer) if c.use_remat else SubLayer
+        layer_cls = _layer_cls(c)
         pairs = []
         for i in range(c.depth):
             atype = c.attn_type_for_layer(i)

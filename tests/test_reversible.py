@@ -2,6 +2,7 @@
 coupled loop, standalone and inside DALLE."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -112,3 +113,36 @@ def test_dalle_reversible_under_jit_and_grad(rng):
 
     l, g = step(params)
     assert np.isfinite(float(l))
+
+
+@pytest.mark.parametrize("policy", ["full", "dots", "dots_no_batch"])
+def test_remat_policies_value_parity(rng, policy):
+    """jax.checkpoint policies change what is SAVED, never the values: loss
+    and grads equal the no-remat baseline for every policy."""
+    import dataclasses
+
+    import numpy as np
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    cfg = DALLEConfig(
+        num_text_tokens=30, text_seq_len=4, num_image_tokens=20,
+        image_fmap_size=2, dim=32, depth=2, heads=2, dim_head=16,
+        attn_types=("full",),
+    )
+    text = jax.random.randint(rng, (2, 4), 1, 30)
+    codes = jax.random.randint(rng, (2, 4), 0, 20)
+    base = DALLE(cfg)
+    params = base.init({"params": rng}, text, codes)["params"]
+
+    def loss_of(model):
+        return jax.value_and_grad(
+            lambda p: model.apply({"params": p}, text, codes, return_loss=True)
+        )(params)
+
+    l0, g0 = loss_of(base)
+    model = DALLE(dataclasses.replace(cfg, use_remat=True, remat_policy=policy))
+    l1, g1 = loss_of(model)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

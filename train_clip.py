@@ -12,7 +12,6 @@ jitted ``make_clip_train_step`` → a self-describing checkpoint that
 """
 
 import argparse
-import time
 
 import jax
 import numpy as np
@@ -138,37 +137,40 @@ def main(argv=None):
     ) if is_root else None
 
     def save(name):
-        if is_root:
-            save_checkpoint(
-                str(ckpt_dir / name), params=params, hparams=cfg.to_dict(),
-                step=global_step,
-            )
+        # every process calls: save_checkpoint is a collective under
+        # multi-host (orbax sharded writes + cross-process barriers,
+        # checkpoint.py); it gates directory ops on process 0 itself
+        save_checkpoint(
+            str(ckpt_dir / name), params=params, hparams=cfg.to_dict(),
+            step=global_step,
+        )
+
+    from dalle_tpu.training.profiler import Meter
 
     global_step = 0
     save("clip-init")  # fail-early (reference idiom: train_dalle.py:561-563)
-    t0 = time.time()
+    meter = Meter(
+        flops_per_step=0.0,  # no analytic CLIP FLOP model; mfu not reported
+        tokens_per_step=args.batch_size * args.text_seq_len,
+        samples_per_step=args.batch_size,
+    )
     for epoch in range(args.epochs):
         loader.set_epoch(epoch)
         for text, images in device_prefetch(loader, batch_sharding(distr.mesh)):
             params, opt_state, loss = step_fn(
                 params, opt_state, text, images, jax.random.fold_in(rng, global_step)
             )
-            if global_step % 10 == 0:
+            m = meter.step()
+            if m is not None:
                 loss_f = float(distr.average_all(loss))
-                # first log is 1 step in (and includes compile): no rate yet
-                rate = (
-                    args.batch_size * 10 / max(time.time() - t0, 1e-9)
-                    if global_step else 0.0
-                )
-                t0 = time.time()
                 if is_root:
                     print(
                         f"epoch {epoch} step {global_step} loss {loss_f:.5f} "
-                        f"({rate:.1f} samples/s)"
+                        f"({m['samples_per_sec']:.1f} samples/s)"
                     )
                     run.log(
                         {"loss": loss_f, "epoch": epoch,
-                         "samples_per_sec": rate},
+                         "samples_per_sec": m["samples_per_sec"]},
                         step=global_step,
                     )
             if global_step and global_step % args.save_every_n_steps == 0:

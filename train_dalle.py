@@ -105,6 +105,11 @@ def parse_args(argv=None):
     parser.add_argument("--reversible", action="store_true")
     parser.add_argument("--use_remat", action="store_true",
                         help="rematerialize layer activations (memory lever)")
+    parser.add_argument("--remat_policy", type=str, default="full",
+                        choices=("full", "dots", "dots_no_batch"),
+                        help="with --use_remat: what checkpointed blocks "
+                             "keep (full=save nothing; dots=save matmuls; "
+                             "dots_no_batch=save batch-free matmuls only)")
     parser.add_argument("--loss_img_weight", type=int, default=7)
     parser.add_argument("--attn_types", type=str, default="full",
                         help="comma-sep cycle: full,axial_row,axial_col,conv_like,sparse,mlp")
@@ -224,6 +229,7 @@ def main(argv=None):
             rotary_emb=args.rotary_emb,
             reversible=args.reversible,
             use_remat=args.use_remat,
+            remat_policy=args.remat_policy,
             pp_stages=args.pp_stages,
             pp_microbatches=args.pp_microbatches,
             # --sp_mode alone enables SP too: asking for a scheme means
@@ -329,18 +335,20 @@ def main(argv=None):
     global_step = 0
 
     def save(tag):
-        if is_root:
-            save_checkpoint(
-                str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}"),
-                params=params,
-                hparams=cfg.to_dict(),
-                vae_params=vae_params,
-                vae_hparams=vae_cfg.to_dict() if vae_cfg else None,
-                epoch=epoch,
-                step=global_step,
-                scheduler_state=sched.state_dict() if sched else None,
-                keep_n=args.keep_n_checkpoints,
-            )
+        # every process calls: save_checkpoint is a collective under
+        # multi-host (orbax sharded writes + cross-process barriers,
+        # checkpoint.py); it gates directory ops on process 0 itself
+        save_checkpoint(
+            str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}"),
+            params=params,
+            hparams=cfg.to_dict(),
+            vae_params=vae_params,
+            vae_hparams=vae_cfg.to_dict() if vae_cfg else None,
+            epoch=epoch,
+            step=global_step,
+            scheduler_state=sched.state_dict() if sched else None,
+            keep_n=args.keep_n_checkpoints,
+        )
 
     # fail-early checkpoint (reference: train_dalle.py:561-563)
     epoch = start_epoch

@@ -128,14 +128,16 @@ def main(argv=None):
     t10 = time.perf_counter()
 
     def save(name):
-        if is_root:
-            save_checkpoint(
-                f"{args.output_path}/{name}",
-                params=params,
-                hparams=cfg.to_dict(),
-                step=global_step,
-                scheduler_state=sched.state_dict(),
-            )
+        # every process calls: save_checkpoint is a collective under
+        # multi-host (orbax sharded writes + cross-process barriers,
+        # checkpoint.py); it gates directory ops on process 0 itself
+        save_checkpoint(
+            f"{args.output_path}/{name}",
+            params=params,
+            hparams=cfg.to_dict(),
+            step=global_step,
+            scheduler_state=sched.state_dict(),
+        )
 
     for epoch in range(args.epochs):
         loader.set_epoch(epoch)
