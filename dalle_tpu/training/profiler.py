@@ -92,12 +92,18 @@ class Meter:
         self._steps = 0
 
     def step(self) -> Optional[dict]:
-        """Call once per train step; every `window` steps returns metrics."""
+        """Call once per train step; every `window` steps returns metrics.
+
+        The FIRST window is treated as warmup and returns None: it is
+        dominated by the jit compile of step 0, so its samples/sec would
+        understate throughput by orders of magnitude."""
         self._steps += 1
         if self._steps % self.window:
             return None
         dt = time.perf_counter() - self._t0
         self._t0 = time.perf_counter()
+        if self._steps == self.window:
+            return None  # warmup window: includes compilation
         per_step = dt / self.window
         return {
             "step_time_s": per_step,

@@ -121,6 +121,8 @@ def test_profiler_meter_and_flops():
     assert detect_peak_tflops() > 0
     meter = Meter(flops, tokens_per_step=96, samples_per_step=4, window=2)
     assert meter.step() is None
+    assert meter.step() is None  # first full window = compile warmup
+    assert meter.step() is None
     m = meter.step()
     assert m and m["mfu"] >= 0 and m["samples_per_sec"] > 0
 

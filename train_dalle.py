@@ -393,8 +393,12 @@ def main(argv=None):
             if global_step != 0 and global_step % args.save_every_n_steps == 0:
                 save(f"step{global_step}")
             m = meter.step()
-            if is_root and m is not None:
+            if m is not None:
+                # average_all is a COLLECTIVE under multi-host
+                # (process_allgather): every process must enter it; only
+                # the print/log below is root-gated
                 avg_loss = float(distr.average_all(loss))
+            if is_root and m is not None:
                 extras = {k: float(v) for k, v in step_metrics.items()}
                 print(
                     f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "

@@ -171,8 +171,11 @@ def main(argv=None):
                     run.log({"temperature": temp, "lr": lr}, step=global_step)
             if global_step % args.save_every_n_steps == 0:
                 save("vae")
-            if is_root and global_step % 10 == 0:
+            if global_step % 10 == 0:
+                # collective: every process enters average_all (multi-host
+                # process_allgather); print/log stays root-gated below
                 avg_loss = float(distr.average_all(loss))
+            if is_root and global_step % 10 == 0:
                 dt = time.perf_counter() - t10
                 t10 = time.perf_counter()
                 sps = args.batch_size * 10 / dt if global_step else 0.0
