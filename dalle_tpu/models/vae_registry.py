@@ -28,6 +28,38 @@ def vae_hparams(vae, cfg) -> dict:
     raise TypeError(f"unknown VAE family: {type(vae)}")
 
 
+def params_eval_shape(vae, conf):
+    """ShapeDtypeStruct pytree of the VAE family's params (trace-only, no
+    compute) — the restore target that keeps orbax loads typed and placed."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.pretrained import OpenAIDiscreteVAE
+    from dalle_tpu.models.vqgan import VQGAN as _VQGAN
+
+    rng = jax.random.PRNGKey(0)
+    img = jnp.zeros((1, conf.image_size, conf.image_size, 3), jnp.float32)
+    if isinstance(vae, DiscreteVAE):
+        shapes = jax.eval_shape(
+            lambda: vae.init({"params": rng, "gumbel": rng}, img, return_loss=True)
+        )
+    elif isinstance(vae, _VQGAN):
+        shapes = jax.eval_shape(
+            lambda: vae.init({"params": rng}, img, method=_VQGAN._init_all)
+        )
+    elif isinstance(vae, OpenAIDiscreteVAE):
+        shapes = jax.eval_shape(
+            lambda: vae.init(
+                {"params": rng},
+                jnp.zeros((1, 32, 32, 3), jnp.float32),
+                method=OpenAIDiscreteVAE._init_all,
+            )
+        )
+    else:
+        raise TypeError(f"unknown VAE family: {type(vae)}")
+    return shapes["params"]
+
+
 def build_vae(hparams: dict):
     """tagged dict → (module, config-like).  config-like exposes
     num_tokens / fmap_size / image_size for DALLEConfig construction."""

@@ -148,5 +148,30 @@ def load_checkpoint(
     return out
 
 
+def load_subtree(path: str, name: str, target: Any = None) -> Any:
+    """Restore ONE subtree (params / opt_state / vae_params) of a
+    checkpoint, optionally into a target pytree of ShapeDtypeStructs —
+    restoring with a target keeps container types (e.g. optax NamedTuple
+    states) and lets orbax place shards directly, instead of the
+    'generally UNSAFE' target-less dict restore."""
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    if target is not None:
+        return ckptr.restore(path / name, target)
+    return ckptr.restore(path / name)
+
+
+def shape_dtype_of(tree: Any, sharding: Any = None) -> Any:
+    """Pytree of jax.ShapeDtypeStruct mirroring ``tree``; keeps each
+    leaf's own sharding (sharded restore) unless ``sharding`` overrides."""
+    import jax
+
+    def leaf(x):
+        sh = sharding if sharding is not None else getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 def is_checkpoint(path: str) -> bool:
     return (Path(path) / "meta.json").exists()

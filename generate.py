@@ -26,8 +26,7 @@ import numpy as np
 from dalle_tpu.models.clip import CLIP, CLIPConfig
 from dalle_tpu.models.dalle import DALLE, DALLEConfig
 from dalle_tpu.models.generate import generate_images, generate_texts
-from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
-from dalle_tpu.training.checkpoint import is_checkpoint, load_checkpoint
+from dalle_tpu.training.checkpoint import is_checkpoint
 from dalle_tpu.tokenizers import get_tokenizer
 
 
@@ -77,25 +76,33 @@ def main(argv=None):
     tokenizer = get_tokenizer(bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese)
 
     assert is_checkpoint(args.dalle_path), f"{args.dalle_path}: not a checkpoint"
-    # orbax restores arrays with the sharding they were SAVED under — i.e.
-    # each artifact's own training mesh.  Mixing checkpoints trained on
-    # different meshes (DALLE on 8 devices, CLIP on 4) inside one jit is an
-    # error, so place everything on one device here; the --mesh_* branch
-    # below re-shards for sharded inference.
-    device0 = jax.devices()[0]
+    # Every restore below passes a TARGET tree with an explicit single-device
+    # sharding: (a) orbax otherwise restores arrays with whatever sharding
+    # they were SAVED under (the artifact's training mesh) — mixing
+    # checkpoints trained on different meshes inside one jit is an error;
+    # (b) target-less restores are 'generally UNSAFE' per orbax.  The
+    # --mesh_* branch below re-shards for sharded inference.  Only the
+    # needed subtrees load (generation never reads opt_state).
+    from dalle_tpu.training.checkpoint import load_meta, load_subtree, shape_dtype_of
 
-    def place(tree):
-        return jax.device_put(tree, device0)
+    single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
-    ckpt = load_checkpoint(args.dalle_path)
-    cfg = DALLEConfig.from_dict(ckpt["hparams"])
+    meta = load_meta(args.dalle_path)
+    cfg = DALLEConfig.from_dict(meta["hparams"])
     model = DALLE(cfg)
-    params = place(ckpt["params"])
+    text0 = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
+    codes0 = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    p_shapes = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
+    )["params"]
+    params = load_subtree(
+        args.dalle_path, "params", shape_dtype_of(p_shapes, sharding=single)
+    )
     if args.taming or args.vqgan_model_path or args.vqgan_config_path:
         from dalle_tpu.models.pretrained import load_vqgan
 
         vae, vae_params = load_vqgan(args.vqgan_model_path, args.vqgan_config_path)
-        vae_params = place(vae_params)
+        vae_params = jax.device_put(vae_params, single)
         assert vae.cfg.n_embed == cfg.num_image_tokens, (
             f"VQGAN codebook {vae.cfg.n_embed} != model's "
             f"num_image_tokens {cfg.num_image_tokens}"
@@ -106,17 +113,30 @@ def main(argv=None):
             "factor; decode would scramble the code grid"
         )
     else:
-        assert ckpt.get("vae_hparams"), "checkpoint lacks an embedded VAE"
-        from dalle_tpu.models.vae_registry import build_vae
+        assert meta.get("vae_hparams"), "checkpoint lacks an embedded VAE"
+        from dalle_tpu.models.vae_registry import build_vae, params_eval_shape
 
-        vae, _ = build_vae(ckpt["vae_hparams"])
-        vae_params = place(ckpt["vae_params"])
+        vae, vconf = build_vae(meta["vae_hparams"])
+        vae_params = load_subtree(
+            args.dalle_path, "vae_params",
+            shape_dtype_of(params_eval_shape(vae, vconf), sharding=single),
+        )
 
     clip = clip_params = None
     if args.clip_path:
-        cp = load_checkpoint(args.clip_path)
-        clip = CLIP(CLIPConfig.from_dict(cp["hparams"]))
-        clip_params = place(cp["params"])
+        cmeta = load_meta(args.clip_path)
+        clip = CLIP(CLIPConfig.from_dict(cmeta["hparams"]))
+        ct0 = jnp.zeros((1, clip.cfg.text_seq_len), jnp.int32)
+        ci0 = jnp.zeros(
+            (1, clip.cfg.visual_image_size, clip.cfg.visual_image_size, 3),
+            jnp.float32,
+        )
+        c_shapes = jax.eval_shape(
+            lambda: clip.init({"params": jax.random.PRNGKey(0)}, ct0, ci0)
+        )["params"]
+        clip_params = load_subtree(
+            args.clip_path, "params", shape_dtype_of(c_shapes, sharding=single)
+        )
         assert clip.cfg.text_seq_len == cfg.text_seq_len, (
             f"CLIP text_seq_len {clip.cfg.text_seq_len} != DALLE's "
             f"{cfg.text_seq_len}; rerank scores need matching tokenization"

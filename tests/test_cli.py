@@ -92,6 +92,18 @@ def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
     img = Image.open(reds[0])
     assert img.size == (16, 16)
 
+    # --gentxt: model completes the prompt first (reference:
+    # generate.py:104-106), then generates images for the completed text
+    gen_dir = str(tmp_path / "outputs_gentxt")
+    generate.main([
+        "--dalle_path", dalle_out + "/dalle-final",
+        "--text", "red", "--gentxt",
+        "--num_images", "2", "--batch_size", "2",
+        "--outputs_dir", gen_dir,
+    ])
+    written = list(Path(gen_dir).glob("*/*.jpg"))
+    assert len(written) == 2, written
+
 
 def test_train_dalle_webdataset_cli(tmp_path):
     """train_dalle end to end from tar shards (--wds), the reference's

@@ -37,9 +37,10 @@ from dalle_tpu.training import (
 )
 from dalle_tpu.training.checkpoint import (
     is_checkpoint,
-    load_checkpoint,
     load_meta,
+    load_subtree,
     save_checkpoint,
+    shape_dtype_of,
 )
 from dalle_tpu.training.logging import Run
 from dalle_tpu.training.schedule import ReduceLROnPlateau
@@ -150,20 +151,29 @@ def parse_args(argv=None):
     return parser.parse_args(argv)
 
 
-def resolve_vae(args, resume_meta):
+def resolve_vae(args, resume_meta, mesh):
     """VAE resolution order (reference: train_dalle.py:235-289):
     resume ckpt's embedded vae → --vae_path → --taming → OpenAI default.
     Returns (module, params, cfg-like with num_tokens/fmap_size/image_size)."""
     from dalle_tpu.models.vae_registry import build_vae
 
+    from dalle_tpu.models.vae_registry import params_eval_shape
+    from dalle_tpu.parallel.mesh import replicated
+
+    # replicated-over-mesh restore target: fully addressable on every
+    # process (multi-host safe — a single-device target would not be),
+    # and makes the later replication device_put a no-op
+    repl = replicated(mesh)
     if resume_meta is not None and resume_meta.get("vae_hparams"):
         vae, cfg = build_vae(resume_meta["vae_hparams"])
-        return vae, resume_meta["vae_params"], cfg
+        target = shape_dtype_of(params_eval_shape(vae, cfg), sharding=repl)
+        return vae, load_subtree(args.dalle_path, "vae_params", target), cfg
     if args.vae_path:
         assert is_checkpoint(args.vae_path), f"{args.vae_path} is not a checkpoint"
-        out = load_checkpoint(args.vae_path)
-        cfg = DiscreteVAEConfig.from_dict(out["hparams"])
-        return DiscreteVAE(cfg), out["params"], cfg
+        cfg = DiscreteVAEConfig.from_dict(load_meta(args.vae_path)["hparams"])
+        vae = DiscreteVAE(cfg)
+        target = shape_dtype_of(params_eval_shape(vae, cfg), sharding=repl)
+        return vae, load_subtree(args.vae_path, "params", target), cfg
     if args.taming or args.vqgan_model_path or args.vqgan_config_path:
         from dalle_tpu.models.pretrained import load_vqgan
 
@@ -200,10 +210,13 @@ def main(argv=None):
     start_epoch = 0
     if args.dalle_path:
         assert is_checkpoint(args.dalle_path), f"{args.dalle_path}: no checkpoint"
-        resume_meta = load_checkpoint(args.dalle_path)
+        # metadata only here; the arrays restore later with TARGETS (typed
+        # containers + direct sharded placement) once the model/optimizer
+        # templates exist
+        resume_meta = load_meta(args.dalle_path)
         start_epoch = resume_meta.get("epoch", 0)
 
-    vae, vae_params, vae_cfg = resolve_vae(args, resume_meta)
+    vae, vae_params, vae_cfg = resolve_vae(args, resume_meta, distr.mesh)
 
     if resume_meta is not None:
         cfg = DALLEConfig.from_dict(resume_meta["hparams"])
@@ -296,10 +309,27 @@ def main(argv=None):
         model, tx, distr.mesh, {"params": rng}, text0, codes0
     )
     if resume_meta is not None:
-        params = jax.device_put(
-            resume_meta["params"],
-            jax.tree_util.tree_map(lambda x: x.sharding, params),
-        )
+        # targeted restores: typed containers + direct sharded placement
+        params = load_subtree(args.dalle_path, "params", shape_dtype_of(params))
+        if "opt_state" in resume_meta.get("subtrees", ()):
+            # optimizer state resumes too (reference: train_dalle.py:424);
+            # a changed optimizer config (e.g. different --ga_steps) makes
+            # the saved tree incompatible — warn and start fresh then
+            try:
+                opt_state = load_subtree(
+                    args.dalle_path, "opt_state", shape_dtype_of(opt_state)
+                )
+            # only STRUCTURE/shape mismatches mean "different optimizer
+            # config"; I/O or corruption errors must propagate, not be
+            # silently converted into a fresh-optimizer resume
+            except (ValueError, TypeError, KeyError) as e:
+                import warnings
+
+                warnings.warn(
+                    "checkpoint optimizer state is incompatible with this "
+                    f"run's optimizer config ({type(e).__name__}); resuming "
+                    "with a FRESH optimizer (params still restored)"
+                )
     # replicate the (frozen, small) VAE params onto THIS run's mesh — the
     # checkpoint may have been written under a different mesh shape
     from dalle_tpu.parallel.mesh import replicated
@@ -342,6 +372,7 @@ def main(argv=None):
             str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}"),
             params=params,
             hparams=cfg.to_dict(),
+            opt_state=opt_state,  # resume restores it (reference :424)
             vae_params=vae_params,
             vae_hparams=vae_cfg.to_dict() if vae_cfg else None,
             epoch=epoch,
