@@ -35,12 +35,15 @@ def test_mfu_history_filters_platform_and_smoke(tmp_path, monkeypatch):
         {"mfu": 0.20, "platform": "cpu", "smoke": False},
         {"mfu": 0.50, "platform": "tpu", "smoke": False},
         {"mfu": 0.55, "platform": "tpu", "smoke": False},
+        # tiny-fallback headline must not pollute the flagship trend
+        {"mfu": 0.08, "platform": "tpu", "smoke": False, "tiny": True},
         {"metric": "diagnostic", "phase": "preflight"},  # no mfu: ignored
         {"mfu": 0.60},  # legacy record without platform: ignored
     ]
     hist.write_text("\n".join(json.dumps(r) for r in records) + "\n")
     monkeypatch.setattr(bench, "HISTORY_PATH", str(hist))
     assert bench._mfu_history("tpu", False) == [0.50, 0.55]
+    assert bench._mfu_history("tpu", False, tiny=True) == [0.08]
     assert bench._mfu_history("cpu", True) == [0.10]
     assert bench._mfu_history("cpu", False) == [0.20]
 
