@@ -1,0 +1,60 @@
+"""Config-file override layer for the training CLIs.
+
+The reference can merge a DeepSpeed JSON config file into its in-script
+config dict, with documented precedence and a warning per conflicting key
+(reference: distributed_backends/deepspeed_backend.py:66-133, consumed at
+train_dalle.py:500-507).  The TPU-native equivalent keeps one uniform,
+easy-to-reason rule: ``--config_json FILE`` holds a flat JSON object of
+flag names (no leading dashes) applied over the parsed args — the file
+wins over the command line, every value it changes is warned about, and
+unknown keys are an error so a typo can't silently train the wrong model.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+
+def apply_config_json(args, path: str | None):
+    """Apply a JSON config file's overrides onto parsed argparse args.
+
+    Returns ``args`` (mutated).  File values take precedence over CLI
+    values; each effective override emits a warning; keys that don't match
+    a known flag raise ``ValueError``.
+    """
+    if not path:
+        return args
+    with open(path) as f:
+        overrides = json.load(f)
+    if not isinstance(overrides, dict):
+        raise ValueError(f"{path} must hold a JSON object of {{flag: value}}")
+    for key, value in sorted(overrides.items()):
+        if not hasattr(args, key):
+            raise ValueError(
+                f"--config_json key {key!r} is not a known flag of this CLI"
+            )
+        old = getattr(args, key)
+        # coerce to the flag's current type so a JSON string "32" can't
+        # bypass the argparse type= check and explode later ("batch_size"
+        # reaching `// world` as str); bools must be real JSON booleans
+        if old is not None and not isinstance(value, type(old)):
+            if isinstance(old, bool):
+                raise ValueError(
+                    f"--config_json key {key!r} must be a JSON boolean, "
+                    f"got {value!r}"
+                )
+            try:
+                value = type(old)(value)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"--config_json key {key!r}: cannot coerce {value!r} "
+                    f"to {type(old).__name__}: {e}"
+                ) from None
+        if old != value:
+            warnings.warn(
+                f"--config_json overrides --{key}: {old!r} -> {value!r}",
+                stacklevel=2,
+            )
+        setattr(args, key, value)
+    return args

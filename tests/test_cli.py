@@ -268,3 +268,70 @@ def test_train_clip_then_rerank_generate(tiny_data, tmp_path):
     from pathlib import Path
 
     assert len(list((Path(out_dir) / "red_square").glob("*.jpg"))) == 2
+
+
+def test_config_json_overrides_cli(tmp_path):
+    """--config_json: file wins over CLI with a warning per override,
+    unknown keys error (reference's DeepSpeed-config precedence,
+    deepspeed_backend.py:66-133)."""
+    import json
+    import warnings
+
+    import train_dalle
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"batch_size": 32, "depth": 5, "bf16": True}))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        args = train_dalle.parse_args([
+            "--image_text_folder", "/tmp/x",
+            "--batch_size", "4",
+            "--depth", "5",  # equals the file value: must NOT warn
+            "--config_json", str(cfg),
+        ])
+    assert args.batch_size == 32 and args.depth == 5 and args.bf16 is True
+    msgs = [str(x.message) for x in w]
+    assert any("batch_size" in m for m in msgs)  # explicit CLI value overridden
+    assert not any("depth" in m for m in msgs)  # same value -> no warning
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"no_such_flag": 1}))
+    with pytest.raises(ValueError, match="no_such_flag"):
+        train_dalle.parse_args([
+            "--image_text_folder", "/tmp/x", "--config_json", str(bad),
+        ])
+
+    # JSON string where the flag is int: coerced like argparse would
+    stry = tmp_path / "stry.json"
+    stry.write_text(json.dumps({"batch_size": "64", "bf16": 1}))
+    with pytest.raises(ValueError, match="bf16.*boolean"):
+        train_dalle.parse_args([
+            "--image_text_folder", "/tmp/x", "--config_json", str(stry),
+        ])
+    strg = tmp_path / "strg.json"
+    strg.write_text(json.dumps({"batch_size": "64"}))
+    args = train_dalle.parse_args([
+        "--image_text_folder", "/tmp/x", "--config_json", str(strg),
+    ])
+    assert args.batch_size == 64 and isinstance(args.batch_size, int)
+
+
+def test_config_json_works_for_vae_and_clip(tmp_path):
+    import json
+
+    import train_clip
+    import train_vae
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"num_tokens": 99}))
+    args = train_vae.parse_args([
+        "--image_folder", "/tmp/x", "--config_json", str(cfg),
+    ])
+    assert args.num_tokens == 99
+
+    ccfg = tmp_path / "ccfg.json"
+    ccfg.write_text(json.dumps({"dim_latent": 77}))
+    args = train_clip.parse_args([
+        "--image_text_folder", "/tmp/x", "--config_json", str(ccfg),
+    ])
+    assert args.dim_latent == 77

@@ -27,6 +27,7 @@ from dalle_tpu.training import (
     make_clip_train_step,
     make_optimizer,
 )
+from dalle_tpu.training.config import apply_config_json
 from dalle_tpu.training.checkpoint import save_checkpoint
 from dalle_tpu.training.logging import Run
 from dalle_tpu.tokenizers import get_tokenizer
@@ -67,7 +68,11 @@ def parse_args(argv=None):
         parser.add_argument(f"--mesh_{ax}", type=int, default=None)
     parser.add_argument("--distributed_backend", "--distr_backend",
                         type=str, default=None)
-    return parser.parse_args(argv)
+    parser.add_argument("--config_json", type=str, default=None,
+                        help="JSON file of {flag: value} overriding the "
+                             "command line (file wins, warns per override)")
+    args = parser.parse_args(argv)
+    return apply_config_json(args, args.config_json)
 
 
 def main(argv=None):

@@ -35,6 +35,7 @@ from dalle_tpu.training import (
     make_optimizer,
     set_learning_rate,
 )
+from dalle_tpu.training.config import apply_config_json
 from dalle_tpu.training.checkpoint import (
     is_checkpoint,
     load_meta,
@@ -147,8 +148,14 @@ def parse_args(argv=None):
                              "fall through the residual")
     parser.add_argument("--moe_aux_weight", type=float, default=0.01,
                         help="load-balancing loss weight")
+    parser.add_argument("--config_json", type=str, default=None,
+                        help="JSON file of {flag: value} overriding the "
+                             "command line (file wins, warns per override; "
+                             "the reference's DeepSpeed-config precedence, "
+                             "deepspeed_backend.py:66-133)")
     parser = backend_lib.wrap_arg_parser(parser)
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    return apply_config_json(args, args.config_json)
 
 
 def resolve_vae(args, resume_meta, mesh):

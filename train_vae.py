@@ -29,6 +29,7 @@ from dalle_tpu.training import (
     make_vae_train_step,
     set_learning_rate,
 )
+from dalle_tpu.training.config import apply_config_json
 from dalle_tpu.training.checkpoint import save_checkpoint
 from dalle_tpu.training.logging import Run
 from dalle_tpu.training.schedule import ExponentialDecay
@@ -60,8 +61,12 @@ def parse_args(argv=None):
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
     parser.add_argument("--wandb_name", type=str, default="dalle_tpu_train_vae")
     parser.add_argument("--no_wandb", action="store_true")
+    parser.add_argument("--config_json", type=str, default=None,
+                        help="JSON file of {flag: value} overriding the "
+                             "command line (file wins, warns per override)")
     parser = backend_lib.wrap_arg_parser(parser)
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    return apply_config_json(args, args.config_json)
 
 
 def main(argv=None):
