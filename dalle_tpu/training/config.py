@@ -12,16 +12,69 @@ unknown keys are an error so a typo can't silently train the wrong model.
 
 from __future__ import annotations
 
+import argparse
 import json
 import warnings
 
 
-def apply_config_json(args, path: str | None):
+def _coerce(key, value, action):
+    """Validate/coerce a JSON value against the flag's argparse contract.
+
+    Mirrors what argparse's ``type=`` would have enforced on the command
+    line: booleans only for store_true/store_false flags, no booleans
+    smuggled into int flags (bool subclasses int!), no silent float
+    truncation, strings run through the registered type callable.
+    """
+    is_bool_flag = isinstance(
+        action, (argparse._StoreTrueAction, argparse._StoreFalseAction)
+    )
+    if is_bool_flag:
+        if not isinstance(value, bool):
+            raise ValueError(
+                f"--config_json key {key!r} must be a JSON boolean, got {value!r}"
+            )
+        return value
+    if isinstance(value, bool):
+        raise ValueError(
+            f"--config_json key {key!r}: JSON boolean given for a "
+            f"non-boolean flag"
+        )
+    ty = action.type
+    if ty is None or value is None:
+        return value
+    if isinstance(value, str):
+        try:
+            return ty(value)  # exactly what argparse would do
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"--config_json key {key!r}: cannot coerce {value!r} "
+                f"via {getattr(ty, '__name__', ty)}: {e}"
+            ) from None
+    if ty is int and isinstance(value, float):
+        if not value.is_integer():
+            raise ValueError(
+                f"--config_json key {key!r}: {value!r} is not an integer"
+            )
+        return int(value)
+    if ty is float and isinstance(value, (int, float)):
+        return float(value)
+    if isinstance(value, ty):
+        return value
+    raise ValueError(
+        f"--config_json key {key!r}: expected "
+        f"{getattr(ty, '__name__', ty)}, got {type(value).__name__} {value!r}"
+    )
+
+
+def apply_config_json(args, path: str | None, parser=None):
     """Apply a JSON config file's overrides onto parsed argparse args.
 
     Returns ``args`` (mutated).  File values take precedence over CLI
     values; each effective override emits a warning; keys that don't match
-    a known flag raise ``ValueError``.
+    a known flag raise ``ValueError``.  With ``parser`` given, values are
+    validated/coerced against each flag's registered argparse type (the
+    robust path — all three CLIs pass it); without it, a best-effort
+    coercion against the current value's type applies.
     """
     if not path:
         return args
@@ -29,16 +82,20 @@ def apply_config_json(args, path: str | None):
         overrides = json.load(f)
     if not isinstance(overrides, dict):
         raise ValueError(f"{path} must hold a JSON object of {{flag: value}}")
+    by_dest = (
+        {a.dest: a for a in parser._actions} if parser is not None else {}
+    )
     for key, value in sorted(overrides.items()):
         if not hasattr(args, key):
             raise ValueError(
                 f"--config_json key {key!r} is not a known flag of this CLI"
             )
         old = getattr(args, key)
-        # coerce to the flag's current type so a JSON string "32" can't
-        # bypass the argparse type= check and explode later ("batch_size"
-        # reaching `// world` as str); bools must be real JSON booleans
-        if old is not None and not isinstance(value, type(old)):
+        if key in by_dest:
+            value = _coerce(key, value, by_dest[key])
+        elif old is not None and not isinstance(value, type(old)):
+            # fallback when no parser is available: coerce to the current
+            # value's type so a JSON string "32" can't land on an int flag
             if isinstance(old, bool):
                 raise ValueError(
                     f"--config_json key {key!r} must be a JSON boolean, "

@@ -335,3 +335,43 @@ def test_config_json_works_for_vae_and_clip(tmp_path):
         "--image_text_folder", "/tmp/x", "--config_json", str(ccfg),
     ])
     assert args.dim_latent == 77
+
+
+def test_config_json_parser_typed_validation(tmp_path):
+    """Parser-aware coercion: None-default flags still get typed, booleans
+    can't smuggle into int flags, floats don't silently truncate."""
+    import json
+
+    import train_dalle
+
+    # None-default flag (--mesh_dp) given as a JSON string: coerced to int
+    c1 = tmp_path / "c1.json"
+    c1.write_text(json.dumps({"mesh_dp": "2"}))
+    args = train_dalle.parse_args(
+        ["--image_text_folder", "/tmp/x", "--config_json", str(c1)]
+    )
+    assert args.mesh_dp == 2 and isinstance(args.mesh_dp, int)
+
+    # JSON boolean into an int flag: bool is a subclass of int — rejected
+    c2 = tmp_path / "c2.json"
+    c2.write_text(json.dumps({"depth": False}))
+    with pytest.raises(ValueError, match="depth.*boolean"):
+        train_dalle.parse_args(
+            ["--image_text_folder", "/tmp/x", "--config_json", str(c2)]
+        )
+
+    # non-integral float into an int flag: rejected, not truncated
+    c3 = tmp_path / "c3.json"
+    c3.write_text(json.dumps({"batch_size": 3.5}))
+    with pytest.raises(ValueError, match="batch_size.*not an integer"):
+        train_dalle.parse_args(
+            ["--image_text_folder", "/tmp/x", "--config_json", str(c3)]
+        )
+
+    # int into a float flag: fine (widening)
+    c4 = tmp_path / "c4.json"
+    c4.write_text(json.dumps({"learning_rate": 1}))
+    args = train_dalle.parse_args(
+        ["--image_text_folder", "/tmp/x", "--config_json", str(c4)]
+    )
+    assert args.learning_rate == 1.0 and isinstance(args.learning_rate, float)

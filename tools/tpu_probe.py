@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""TPU availability prober.
+
+The single tunneled chip behind this environment comes and goes (see
+ROUND3_NOTES.md); this tool makes the evidence reproducible.  One shot:
+
+    python tools/tpu_probe.py              # one probe, prints one JSON line
+
+Watch mode (used to catch availability windows; append-only JSONL log):
+
+    python tools/tpu_probe.py --watch --interval 480 --log /tmp/tpu_watch.jsonl
+
+Each probe runs ``bench.py --preflight``'s tiny-matmul check in a killable
+subprocess (device init can hang forever, not just fail — observed in
+rounds 1-3), so the prober itself can never wedge.  Exit code (one-shot):
+0 = chip up and computing correctly, 3 = down/wedged.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (the hardened preflight lives there)
+
+
+def probe(timeout_s: float):
+    info, err = bench._healthy_preflight(timeout_s)
+    rec = {"t": time.time(), "ts": time.strftime("%Y-%m-%d %H:%M:%S")}
+    if info is not None:
+        rec.update(state="up", **info)
+    else:
+        rec.update(state="down", error=str(err)[-300:])
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watch", action="store_true")
+    ap.add_argument("--interval", type=float, default=480.0,
+                    help="seconds between watch-mode probes")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-probe subprocess timeout")
+    ap.add_argument("--log", type=str, default=None,
+                    help="append each probe result to this JSONL file")
+    ap.add_argument("--busy_file", type=str, default="/tmp/tpu_busy",
+                    help="watch mode skips probing while this file exists "
+                         "(the tunnel admits one client; probing during a "
+                         "bench run could collide with it)")
+    args = ap.parse_args()
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if args.log:
+            with open(args.log, "a") as f:
+                f.write(line + "\n")
+
+    if not args.watch:
+        rec = probe(args.timeout)
+        emit(rec)
+        sys.exit(0 if rec["state"] == "up" else 3)
+
+    while True:
+        if os.path.exists(args.busy_file):
+            emit({"t": time.time(), "state": "skipped_busy"})
+        else:
+            emit(probe(args.timeout))
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,7 +72,7 @@ def parse_args(argv=None):
                         help="JSON file of {flag: value} overriding the "
                              "command line (file wins, warns per override)")
     args = parser.parse_args(argv)
-    return apply_config_json(args, args.config_json)
+    return apply_config_json(args, args.config_json, parser)
 
 
 def main(argv=None):

@@ -155,7 +155,7 @@ def parse_args(argv=None):
                              "deepspeed_backend.py:66-133)")
     parser = backend_lib.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
-    return apply_config_json(args, args.config_json)
+    return apply_config_json(args, args.config_json, parser)
 
 
 def resolve_vae(args, resume_meta, mesh):

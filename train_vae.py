@@ -66,7 +66,7 @@ def parse_args(argv=None):
                              "command line (file wins, warns per override)")
     parser = backend_lib.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
-    return apply_config_json(args, args.config_json)
+    return apply_config_json(args, args.config_json, parser)
 
 
 def main(argv=None):
