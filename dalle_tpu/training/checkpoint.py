@@ -29,7 +29,7 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
-_SUBTREES = ("params", "opt_state", "vae_params")
+_SUBTREES = ("params", "opt_state", "vae_params", "ema_params")
 
 
 def _is_primary() -> bool:
@@ -53,6 +53,7 @@ def save_checkpoint(
     hparams: dict,
     opt_state: Any = None,
     vae_params: Any = None,
+    ema_params: Any = None,
     vae_hparams: Optional[dict] = None,
     epoch: int = 0,
     step: int = 0,
@@ -70,7 +71,12 @@ def save_checkpoint(
     # every process participates in the sharded-array writes (orbax
     # coordinates shard ownership internally)
     ckptr = ocp.StandardCheckpointer()
-    trees = {"params": params, "opt_state": opt_state, "vae_params": vae_params}
+    trees = {
+        "params": params,
+        "opt_state": opt_state,
+        "vae_params": vae_params,
+        "ema_params": ema_params,
+    }
     for name in _SUBTREES:
         if trees[name] is not None:
             ckptr.save(tmp / name, trees[name])
@@ -103,6 +109,31 @@ def _family_pattern(name: str) -> str:
 
     m = re.match(r"(.*?)(\d+)$", name)
     return (m.group(1) + "*") if m else name
+
+
+def find_latest_checkpoint(parent, prefix: str):
+    """Newest checkpoint dir under ``parent`` named ``{prefix}-*``.
+
+    "Newest" = highest saved ``step`` in meta.json, mtime as tiebreak.
+    Returns the path string or None.  Powers ``--auto_resume``: restart
+    recovery without hand-passing ``--dalle_path`` (the reference's
+    recovery model is manual restart-from-checkpoint, SURVEY.md §5.3).
+    """
+    parent = Path(parent)
+    if not parent.is_dir():
+        return None
+    best, best_key = None, None
+    for d in parent.glob(f"{prefix}-*"):
+        if not (d.is_dir() and (d / "meta.json").exists()):
+            continue
+        try:
+            step = json.loads((d / "meta.json").read_text()).get("step", 0)
+        except (ValueError, OSError):
+            continue
+        key = (step, d.stat().st_mtime)
+        if best_key is None or key > best_key:
+            best, best_key = d, key
+    return str(best) if best else None
 
 
 def prune_checkpoints(parent: Path, keep_n: int, pattern: str = "*"):

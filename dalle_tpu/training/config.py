@@ -39,12 +39,22 @@ def _coerce(key, value, action):
             f"--config_json key {key!r}: JSON boolean given for a "
             f"non-boolean flag"
         )
+    if value is None:
+        # JSON null only makes sense for flags whose unset state IS None
+        # (e.g. --mesh_*); for anything else it's a config mistake that
+        # must fail here, not as an opaque TypeError mid-startup
+        if action.default is None:
+            return value
+        raise ValueError(
+            f"--config_json key {key!r}: null is not a valid value "
+            f"(flag default is {action.default!r})"
+        )
     ty = action.type
-    if ty is None or value is None:
-        return value
+    if ty is None:
+        return _check_choices(key, value, action)
     if isinstance(value, str):
         try:
-            return ty(value)  # exactly what argparse would do
+            return _check_choices(key, ty(value), action)  # as argparse would
         except (TypeError, ValueError) as e:
             raise ValueError(
                 f"--config_json key {key!r}: cannot coerce {value!r} "
@@ -55,15 +65,25 @@ def _coerce(key, value, action):
             raise ValueError(
                 f"--config_json key {key!r}: {value!r} is not an integer"
             )
-        return int(value)
+        return _check_choices(key, int(value), action)
     if ty is float and isinstance(value, (int, float)):
-        return float(value)
+        return _check_choices(key, float(value), action)
     if isinstance(value, ty):
-        return value
+        return _check_choices(key, value, action)
     raise ValueError(
         f"--config_json key {key!r}: expected "
         f"{getattr(ty, '__name__', ty)}, got {type(value).__name__} {value!r}"
     )
+
+
+def _check_choices(key, value, action):
+    """Enforce argparse ``choices=`` just like the command line would."""
+    if action.choices is not None and value not in action.choices:
+        raise ValueError(
+            f"--config_json key {key!r}: {value!r} is not one of "
+            f"{tuple(action.choices)}"
+        )
+    return value
 
 
 def apply_config_json(args, path: str | None, parser=None):

@@ -60,6 +60,9 @@ def parse_args(argv=None):
     parser.add_argument("--vqgan_model_path", type=str, default=None)
     parser.add_argument("--vqgan_config_path", type=str, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--no_ema", action="store_true",
+                        help="use raw training params even when the "
+                             "checkpoint carries an ema_params subtree")
     # sharded inference (beyond-reference: the reference generates on one
     # GPU only, generate.py:93-95): shard params over a device mesh and run
     # the scan decode under it — needed for models too big for one chip
@@ -95,8 +98,17 @@ def main(argv=None):
     p_shapes = jax.eval_shape(
         lambda: model.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
     )["params"]
+    # prefer the EMA weights when the trainer kept them (--ema_decay);
+    # --no_ema forces the raw training params
+    subtree = (
+        "ema_params"
+        if ("ema_params" in meta.get("subtrees", ()) and not args.no_ema)
+        else "params"
+    )
+    if subtree == "ema_params":
+        print("using EMA params (pass --no_ema for the raw weights)")
     params = load_subtree(
-        args.dalle_path, "params", shape_dtype_of(p_shapes, sharding=single)
+        args.dalle_path, subtree, shape_dtype_of(p_shapes, sharding=single)
     )
     if args.taming or args.vqgan_model_path or args.vqgan_config_path:
         from dalle_tpu.models.pretrained import load_vqgan

@@ -375,3 +375,88 @@ def test_config_json_parser_typed_validation(tmp_path):
         ["--image_text_folder", "/tmp/x", "--config_json", str(c4)]
     )
     assert args.learning_rate == 1.0 and isinstance(args.learning_rate, float)
+
+
+def test_auto_resume_and_ema(tiny_data, tmp_path, capsys):
+    """--auto_resume picks the newest checkpoint in --output_path;
+    --ema_decay tracks EMA params that generate.py prefers."""
+    import train_dalle
+    import train_vae
+
+    vae_out = str(tmp_path / "vae_ckpt")
+    train_vae.main([
+        "--image_folder", tiny_data, "--image_size", "16",
+        "--batch_size", "4", "--epochs", "1", "--num_tokens", "16",
+        "--num_layers", "2", "--num_resnet_blocks", "0", "--emb_dim", "8",
+        "--hidden_dim", "8", "--output_path", vae_out, "--no_wandb",
+        "--mesh_dp", "4",
+    ])
+
+    out = str(tmp_path / "dalle_ckpt")
+    common = [
+        "--image_text_folder", tiny_data,
+        "--batch_size", "4", "--dim", "16", "--depth", "1",
+        "--heads", "2", "--dim_head", "8", "--text_seq_len", "8",
+        "--attn_types", "full", "--truncate_captions",
+        "--output_path", out, "--no_wandb", "--ema_decay", "0.9",
+        "--auto_resume", "--mesh_dp", "4",
+    ]
+    # fresh start: no checkpoint yet -> needs the VAE path
+    train_dalle.main(common + ["--vae_path", vae_out + "/vae-final",
+                               "--epochs", "1"])
+    capsys.readouterr()
+
+    # restart: --auto_resume finds the newest checkpoint on its own
+    # (no --vae_path / --dalle_path given)
+    train_dalle.main(common + ["--epochs", "2"])
+    outp = capsys.readouterr().out
+    assert "--auto_resume: resuming from" in outp
+
+    from dalle_tpu.training.checkpoint import find_latest_checkpoint, load_meta
+
+    latest = find_latest_checkpoint(out, "dalle")
+    meta = load_meta(latest)
+    assert "ema_params" in meta["subtrees"]
+
+    # generate prefers the EMA subtree
+    import generate
+
+    gen_out = str(tmp_path / "outputs")
+    generate.main([
+        "--dalle_path", out + "/dalle-final",
+        "--text", "red square", "--num_images", "1", "--batch_size", "1",
+        "--outputs_dir", gen_out,
+    ])
+    outp = capsys.readouterr().out
+    assert "using EMA params" in outp
+    from pathlib import Path
+
+    assert len(list(Path(gen_out).glob("*/*.jpg"))) == 1
+
+
+def test_config_json_null_and_choices(tmp_path):
+    """JSON null only valid for None-default flags; choices= enforced."""
+    import json
+
+    import train_dalle
+
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"mesh_dp": None}))  # default None: allowed
+    args = train_dalle.parse_args(
+        ["--image_text_folder", "/tmp/x", "--config_json", str(ok)]
+    )
+    assert args.mesh_dp is None
+
+    nul = tmp_path / "nul.json"
+    nul.write_text(json.dumps({"batch_size": None}))
+    with pytest.raises(ValueError, match="batch_size.*null"):
+        train_dalle.parse_args(
+            ["--image_text_folder", "/tmp/x", "--config_json", str(nul)]
+        )
+
+    ch = tmp_path / "ch.json"
+    ch.write_text(json.dumps({"remat_policy": "dotz"}))
+    with pytest.raises(ValueError, match="remat_policy.*not one of"):
+        train_dalle.parse_args(
+            ["--image_text_folder", "/tmp/x", "--config_json", str(ch)]
+        )

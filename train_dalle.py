@@ -92,6 +92,15 @@ def parse_args(argv=None):
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
     parser.add_argument("--lr_decay", action="store_true")
+    parser.add_argument("--auto_resume", action="store_true",
+                        help="resume from the newest checkpoint in "
+                             "--output_path if one exists (restart "
+                             "recovery without hand-passing --dalle_path)")
+    parser.add_argument("--ema_decay", type=float, default=0.0,
+                        help=">0 keeps an exponential moving average of "
+                             "the params (e.g. 0.999), saved as the "
+                             "ema_params checkpoint subtree; generate.py "
+                             "prefers it (beyond-reference)")
     parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
                         action="store_true",
                         help="bf16 compute (supersedes the reference's "
@@ -212,6 +221,19 @@ def main(argv=None):
     tokenizer = get_tokenizer(
         bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese
     )
+
+    if args.auto_resume and not args.dalle_path:
+        from dalle_tpu.training.checkpoint import find_latest_checkpoint
+
+        latest = find_latest_checkpoint(
+            args.output_path, args.dalle_output_file_name
+        )
+        if latest:
+            args.dalle_path = latest
+            if is_root:
+                print(f"--auto_resume: resuming from {latest}")
+        elif is_root:
+            print("--auto_resume: no checkpoint found, starting fresh")
 
     resume_meta = None
     start_epoch = 0
@@ -337,6 +359,31 @@ def main(argv=None):
                     f"run's optimizer config ({type(e).__name__}); resuming "
                     "with a FRESH optimizer (params still restored)"
                 )
+    # EMA of the params (beyond-reference; saved as its own checkpoint
+    # subtree, preferred by generate.py).  The tracking tree must be a REAL
+    # copy: the train step donates params, and an aliasing tree would be
+    # invalidated with the donated buffers.
+    ema_params = None
+    ema_step = None
+    if args.ema_decay > 0.0:
+        d = float(args.ema_decay)
+        if resume_meta is not None and "ema_params" in resume_meta.get(
+            "subtrees", ()
+        ):
+            ema_params = load_subtree(
+                args.dalle_path, "ema_params", shape_dtype_of(params)
+            )
+        else:
+            ema_params = jax.jit(
+                lambda p: jax.tree_util.tree_map(jnp.copy, p)
+            )(params)
+        ema_step = jax.jit(
+            lambda e, p: jax.tree_util.tree_map(
+                lambda a, b: a * d + b.astype(a.dtype) * (1.0 - d), e, p
+            ),
+            donate_argnums=(0,),
+        )
+
     # replicate the (frozen, small) VAE params onto THIS run's mesh — the
     # checkpoint may have been written under a different mesh shape
     from dalle_tpu.parallel.mesh import replicated
@@ -369,7 +416,10 @@ def main(argv=None):
         print(f"DALLE params: {count_params(params):,}")
 
     ckpt_dir = Path(args.output_path)
-    global_step = 0
+    # restore the step counter so step-tagged checkpoints keep ascending
+    # across restarts (--auto_resume ranks checkpoints by saved step —
+    # a reset counter would make newer checkpoints look older)
+    global_step = resume_meta.get("step", 0) if resume_meta else 0
 
     def save(tag):
         # every process calls: save_checkpoint is a collective under
@@ -381,6 +431,7 @@ def main(argv=None):
             hparams=cfg.to_dict(),
             opt_state=opt_state,  # resume restores it (reference :424)
             vae_params=vae_params,
+            ema_params=ema_params,
             vae_hparams=vae_cfg.to_dict() if vae_cfg else None,
             epoch=epoch,
             step=global_step,
@@ -421,6 +472,8 @@ def main(argv=None):
             else:
                 params, opt_state, loss = out
                 step_metrics = {}
+            if ema_step is not None:
+                ema_params = ema_step(ema_params, params)
             if args.flops_profiler and global_step == 201 and is_root:
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
