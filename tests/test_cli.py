@@ -460,3 +460,83 @@ def test_config_json_null_and_choices(tmp_path):
         train_dalle.parse_args(
             ["--image_text_folder", "/tmp/x", "--config_json", str(ch)]
         )
+
+
+def test_ga_lr_decay_and_pruning(tiny_data, tmp_path):
+    """Previously-untested trainer knobs in one run: --ga_steps (optax
+    MultiSteps), --lr_decay (plateau scheduler through set_learning_rate on
+    a MultiSteps state), --keep_n_checkpoints + --save_every_n_steps
+    (step-family retention pruning, reference: train_dalle.py:523-526)."""
+    import train_dalle
+    import train_vae
+
+    vae_out = str(tmp_path / "vae_ckpt")
+    train_vae.main([
+        "--image_folder", tiny_data, "--image_size", "16",
+        "--batch_size", "4", "--epochs", "1", "--num_tokens", "16",
+        "--num_layers", "2", "--num_resnet_blocks", "0", "--emb_dim", "8",
+        "--hidden_dim", "8", "--output_path", vae_out, "--no_wandb",
+        "--mesh_dp", "4",
+    ])
+
+    out = tmp_path / "dalle_ckpt"
+    train_dalle.main([
+        "--image_text_folder", tiny_data,
+        "--vae_path", vae_out + "/vae-final",
+        "--batch_size", "4", "--dim", "16", "--depth", "1",
+        "--heads", "2", "--dim_head", "8", "--text_seq_len", "8",
+        "--attn_types", "full", "--truncate_captions",
+        "--output_path", str(out), "--no_wandb", "--mesh_dp", "4",
+        "--epochs", "3",
+        "--ga_steps", "2",
+        "--lr_decay",
+        "--save_every_n_steps", "2",
+        "--keep_n_checkpoints", "2",
+    ])
+    from dalle_tpu.training.checkpoint import is_checkpoint, load_meta
+
+    assert is_checkpoint(str(out / "dalle-final"))
+    # 3 epochs x 3 steps = 9 steps -> step2/step4/step6/step8 saved, pruned
+    # to the newest 2 of the step family (init/epochN/final untouched)
+    steps = sorted(d.name for d in out.glob("dalle-step*"))
+    assert len(steps) == 2, steps
+    assert steps == ["dalle-step6", "dalle-step8"], steps
+    assert is_checkpoint(str(out / "dalle-init"))
+    # scheduler state rides in the checkpoint for resume
+    meta = load_meta(str(out / "dalle-final"))
+    assert meta["scheduler_state"] is not None
+
+
+def test_prune_and_find_latest_units(tmp_path):
+    """Unit semantics of the checkpoint-directory helpers."""
+    import json
+    import time
+
+    from dalle_tpu.training.checkpoint import (
+        find_latest_checkpoint,
+        prune_checkpoints,
+    )
+
+    def fake_ckpt(name, step):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "meta.json").write_text(json.dumps({"step": step}))
+        return d
+
+    fake_ckpt("dalle-step10", 10)
+    time.sleep(0.02)
+    fake_ckpt("dalle-step30", 30)
+    time.sleep(0.02)
+    fake_ckpt("dalle-epoch0", 15)
+    (tmp_path / "dalle-bogus").mkdir()  # no meta.json: ignored
+
+    # highest step wins regardless of mtime
+    assert find_latest_checkpoint(tmp_path, "dalle").endswith("dalle-step30")
+    # unknown dir / no matches
+    assert find_latest_checkpoint(tmp_path / "nope", "dalle") is None
+    assert find_latest_checkpoint(tmp_path, "other") is None
+
+    # pruning keeps newest-by-mtime within the glob family only
+    prune_checkpoints(tmp_path, 1, pattern="dalle-step*")
+    left = sorted(p.name for p in tmp_path.glob("dalle-*") if p.is_dir())
+    assert left == ["dalle-bogus", "dalle-epoch0", "dalle-step30"], left
