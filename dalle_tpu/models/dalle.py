@@ -60,6 +60,7 @@ class DALLEConfig:
     reversible: bool = False
     use_remat: bool = False
     remat_policy: str = "full"  # "full" | "dots" | "dots_no_batch"
+    scan_layers: bool = False  # lax.scan over stacked layers (O(1) compile)
     kernel_size: int = 5
     dilation: int = 1
     sparse_block: int = 16
@@ -112,6 +113,7 @@ class DALLEConfig:
             reversible=self.reversible,
             use_remat=self.use_remat,
             remat_policy=self.remat_policy,
+            scan_layers=self.scan_layers,
             rotary=self.rotary_emb,
             shift_tokens=self.shift_tokens,
             sandwich_norm=self.sandwich_norm,

@@ -1,0 +1,85 @@
+"""Convert scan-over-layers (stacked) params to the unrolled layout.
+
+``scan_layers=True`` trains with ONE scanned layer body whose params carry
+a leading ``[depth // cycle]`` axis (transformer.py ScanStack).  Decode and
+the KV-cache machinery run in the unrolled layout; this module bridges the
+two so a scanned checkpoint is directly usable by ``generate.py`` and the
+in-loop sampler.
+
+Layout mapping (cycle = len(attn_types), i = g * cycle + j):
+
+    transformer/scan/layers/pair{j}_{attn|ff}/<leaf>[g, ...]
+        -> transformer/layer_{i}_{attn|ff}/<leaf>[...]
+
+LayerScale is the one non-trivial leaf: ScanGroup reparameterizes it
+(stacked param init 1.0, per-depth init constant multiplied outside), so
+the unrolled-equivalent value is ``stacked[g] * _layer_scale_init(i)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.models.transformer import _layer_scale_init
+
+
+def unstack_scan_params(params, cfg):
+    """DALLE (or bare-transformer) scanned param tree → unrolled tree.
+
+    ``cfg``: the DALLEConfig/TransformerConfig the params were trained
+    with (``scan_layers=True``); uses only ``depth`` and ``attn_types``.
+    Non-transformer subtrees pass through untouched.  Works on concrete
+    arrays and on ShapeDtypeStruct trees alike.
+    """
+    cycle = len(cfg.attn_types)
+
+    def convert_transformer(t):
+        scan = t.get("scan")
+        if scan is None:  # already unrolled
+            return t
+        layers = scan["layers"]
+        out = {k: v for k, v in t.items() if k != "scan"}
+        some_leaf = jax.tree_util.tree_leaves(layers)[0]
+        groups = some_leaf.shape[0]
+
+        def take(leaf, g):
+            if hasattr(leaf, "value"):  # flax Partitioned etc.
+                leaf = leaf.value
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(leaf.shape[1:], leaf.dtype)
+            return leaf[g]
+
+        for g in range(groups):
+            for j in range(cycle):
+                i = g * cycle + j
+                for kind in ("attn", "ff"):
+                    sub = jax.tree_util.tree_map(
+                        lambda leaf: take(leaf, g), layers[f"pair{j}_{kind}"]
+                    )
+                    # fold the per-depth LayerScale constant back in
+                    if "layerscale" in sub and not isinstance(
+                        sub["layerscale"], jax.ShapeDtypeStruct
+                    ):
+                        sub = dict(sub)
+                        sub["layerscale"] = (
+                            sub["layerscale"] * _layer_scale_init(i)
+                        ).astype(sub["layerscale"].dtype)
+                    out[f"layer_{i}_{kind}"] = sub
+        return out
+
+    params = dict(params)
+    if "transformer" in params:
+        params["transformer"] = convert_transformer(dict(params["transformer"]))
+        return params
+    return convert_transformer(params)
+
+
+def unrolled_eval_setup(cfg):
+    """(eval_cfg, convert) for running decode on a scanned-trained model:
+    ``eval_cfg`` is ``cfg`` with scan_layers off; ``convert`` maps live
+    scanned params to the unrolled layout."""
+    import dataclasses
+
+    eval_cfg = dataclasses.replace(cfg, scan_layers=False)
+    return eval_cfg, lambda params: unstack_scan_params(params, cfg)

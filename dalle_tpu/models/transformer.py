@@ -96,6 +96,14 @@ class TransformerConfig:
     pp_stages: int = 1
     pp_microbatches: int = 4
     pp_axis: str = "pp"
+    # scan-over-layers (MaxText/T5X idiom): ONE traced layer body iterated
+    # with jax.lax.scan over stacked [depth, ...] params — compile time is
+    # O(1) in depth instead of O(depth), the decisive lever for the deep
+    # (64-layer) configs.  Training-forward only: generate.py and the
+    # in-loop sampler unstack to the unrolled layout first
+    # (models/scan_params.py).  Requires homogeneous layers (no
+    # reversible / pipeline / MoE).  Beyond-reference.
+    scan_layers: bool = False
     # mixture-of-experts FF (models/moe.py): every moe_every-th block's FF
     # becomes a top-k routed expert layer; expert weights shard over 'ep'.
     # Beyond-reference (the reference FF is always dense, transformer.py:72-88).
@@ -180,12 +188,8 @@ def _warn_constraint_skipped_once(shape, wanted, used, sp_dropped):
     )
 
 
-def _layer_cls(c: "TransformerConfig"):
-    """SubLayer, optionally wrapped in nn.remat with the configured
-    rematerialization policy (SURVEY.md §7 stage 7: remat is the idiomatic
-    memory lever next to true reversibility)."""
-    if not c.use_remat:
-        return SubLayer
+def _remat_policy(c: "TransformerConfig"):
+    """Map config remat_policy name to a jax.checkpoint policy (or None)."""
     policies = {
         "full": None,
         "dots": jax.checkpoint_policies.checkpoint_dots,
@@ -194,7 +198,16 @@ def _layer_cls(c: "TransformerConfig"):
     assert c.remat_policy in policies, (
         f"unknown remat_policy {c.remat_policy!r}; options: {sorted(policies)}"
     )
-    policy = policies[c.remat_policy]
+    return policies[c.remat_policy]
+
+
+def _layer_cls(c: "TransformerConfig"):
+    """SubLayer, optionally wrapped in nn.remat with the configured
+    rematerialization policy (SURVEY.md §7 stage 7: remat is the idiomatic
+    memory lever next to true reversibility)."""
+    if not c.use_remat:
+        return SubLayer
+    policy = _remat_policy(c)
     return nn.remat(SubLayer, policy=policy) if policy else nn.remat(SubLayer)
 
 
@@ -547,6 +560,11 @@ class SubLayer(nn.Module):
     cfg: TransformerConfig
     layer_ind: int
     kind: str  # "attn:<type>" | "ff"
+    # scan-over-layers reparameterization: the stacked layerscale param is
+    # initialized to this value (1.0) and the per-depth init constant is
+    # multiplied OUTSIDE (ScanGroup) — same function at init, per-depth
+    # init values survive the shared scan init fn.  None = direct init.
+    scale_init: Optional[float] = None
 
     def setup(self):
         c = self.cfg
@@ -568,9 +586,14 @@ class SubLayer(nn.Module):
             self.fn = MoEFeedForward(c, name="fn")
         else:
             self.fn = FeedForward(c, name="fn")
+        init_val = (
+            self.scale_init
+            if self.scale_init is not None
+            else _layer_scale_init(self.layer_ind)
+        )
         self.scale = self.param(
             "layerscale",
-            nn.initializers.constant(_layer_scale_init(self.layer_ind)),
+            nn.initializers.constant(init_val),
             (c.dim,),
         )
 
@@ -648,6 +671,78 @@ class SubLayer(nn.Module):
         return y * self.scale.astype(y.dtype), new_cache
 
 
+class ScanGroup(nn.Module):
+    """One attn-types cycle of (attn, ff) pairs — the body nn.scan iterates.
+
+    LayerScale is reparameterized: the stacked param initializes to 1.0 and
+    the per-depth init constant arrives as a scanned input (``consts``,
+    [cycle] for this group), multiplied outside the sublayer — identical
+    function at init to the unrolled stack, exact conversion in
+    models/scan_params.py (unrolled scale = stacked scale × const).
+    """
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        c = self.cfg
+        layer_cls = (
+            nn.remat(SubLayer, prevent_cse=False, policy=_remat_policy(c))
+            if c.use_remat
+            else SubLayer
+        )
+        pairs = []
+        for j, atype in enumerate(c.attn_types):
+            pairs.append(
+                (
+                    layer_cls(c, 0, f"attn:{atype}", scale_init=1.0,
+                              name=f"pair{j}_attn"),
+                    layer_cls(c, 0, "ff", scale_init=1.0, name=f"pair{j}_ff"),
+                )
+            )
+        self.pairs = pairs
+
+    def __call__(self, x, consts, key_pad_mask=None, deterministic=True):
+        c = self.cfg
+        for j, (attn, ff) in enumerate(self.pairs):
+            s = consts[j].astype(x.dtype)
+            x = x + s * attn(
+                x, key_pad_mask=key_pad_mask, deterministic=deterministic
+            )
+            x = x + s * ff(x, deterministic=deterministic)
+            x = _constrain_activations(x, c)
+        return x, None
+
+
+class ScanStack(nn.Module):
+    """jax.lax.scan over ``depth // cycle`` ScanGroups with stacked params
+    (leading [groups] axis on every leaf) — ONE traced/compiled layer body
+    regardless of depth (the MaxText/T5X pattern)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, key_pad_mask=None, deterministic=True):
+        c = self.cfg
+        cycle = len(c.attn_types)
+        groups = c.depth // cycle
+        consts = jnp.asarray(
+            [
+                [_layer_scale_init(g * cycle + j) for j in range(cycle)]
+                for g in range(groups)
+            ],
+            jnp.float32,
+        )  # [groups, cycle]
+        scanned = nn.scan(
+            ScanGroup,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(0, nn.broadcast, nn.broadcast),
+            length=groups,
+        )
+        x, _ = scanned(c, name="layers")(x, consts, key_pad_mask, deterministic)
+        return x
+
+
 class TransformerStage(nn.Module):
     """A contiguous slice of the stack: one pipeline stage.
 
@@ -720,6 +815,16 @@ class Transformer(nn.Module):
 
     def setup(self):
         c = self.cfg
+        if c.scan_layers:
+            assert not c.reversible, "scan_layers + reversible not supported"
+            assert c.pp_stages == 1, "scan_layers + pipeline not supported"
+            assert c.moe_experts == 0, "scan_layers + MoE not supported"
+            assert c.depth % len(c.attn_types) == 0, (
+                f"depth {c.depth} not divisible by the attn_types cycle "
+                f"({len(c.attn_types)}) — required for scan_layers"
+            )
+            self.scan_stack = ScanStack(c, name="scan")
+            return
         if c.pp_stages > 1:
             assert not c.reversible, "reversible + pipeline not supported"
             assert c.depth % c.pp_stages == 0, (
@@ -757,6 +862,8 @@ class Transformer(nn.Module):
 
     def __call__(self, x, key_pad_mask=None, deterministic=True):
         c = self.cfg
+        if c.scan_layers:
+            return self.scan_stack(x, key_pad_mask, deterministic)
         if c.pp_stages > 1:
             return self._pipeline_forward(x, key_pad_mask, deterministic)
         if c.reversible:
@@ -899,6 +1006,12 @@ class Transformer(nn.Module):
         return out
 
     def init_cache(self, batch: int) -> Cache:
+        if self.cfg.scan_layers:
+            raise NotImplementedError(
+                "decode with scan_layers: unstack to the unrolled layout "
+                "first (models/scan_params.unstack_scan_params) — "
+                "generate.py and the in-loop sampler do this automatically"
+            )
         if self.cfg.pp_stages > 1:
             return {
                 f"stage_{s}": st.init_cache(batch)

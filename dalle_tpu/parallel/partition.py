@@ -53,6 +53,10 @@ def _spec_for(path: str, shape, mesh_shape) -> PartitionSpec:
     tp = mesh_shape.get("tp", 1)
     fsdp = mesh_shape.get("fsdp", 1)
     ep = mesh_shape.get("ep", 1)
+    # scan-over-layers stacked leaves: axis 0 is the lax.scan depth axis —
+    # never shard it (a dynamic-slice over a sharded dim inside scan makes
+    # GSPMD gather the whole stack every iteration)
+    stacked = "scan/layers/" in path
     spec = None
     for suffix, rule in _MOE_RULES:
         if path.endswith(suffix) and len(shape) == len(rule):
@@ -65,8 +69,14 @@ def _spec_for(path: str, shape, mesh_shape) -> PartitionSpec:
     if spec is None and tp > 1:
         for suffix, rule in _TP_RULES:
             if path.endswith(suffix):
-                ax = [rule.index(a) for a in rule if a == "tp"]
-                if shape[ax[0]] % tp == 0:
+                tp_i = rule.index("tp")
+                if stacked and len(shape) == len(rule) + 1:
+                    # scan-over-layers stacked leaf [depth, ...]: the rule's
+                    # dims shift right by one; the depth axis stays free for
+                    # the fsdp pass below
+                    if shape[tp_i + 1] % tp == 0:
+                        spec = PartitionSpec(None, *rule)
+                elif shape[tp_i] % tp == 0:
                     spec = rule
                 break
     dims = list(spec) if spec is not None else [None] * len(shape)
@@ -75,8 +85,10 @@ def _spec_for(path: str, shape, mesh_shape) -> PartitionSpec:
     if fsdp > 1:
         # shard the first still-free axis divisible by fsdp (largest params
         # first benefit automatically: embeddings/kernels have axis0 = vocab
-        # or fan-in)
+        # or fan-in); for stacked leaves, skip the depth axis
         for i, d in enumerate(dims):
+            if stacked and i == 0:
+                continue
             if d is None and shape[i] % fsdp == 0 and shape[i] >= fsdp:
                 dims[i] = "fsdp"
                 break

@@ -92,11 +92,19 @@ def main(argv=None):
 
     meta = load_meta(args.dalle_path)
     cfg = DALLEConfig.from_dict(meta["hparams"])
+    # scanned-trained checkpoints (--scan_layers) store stacked params;
+    # decode runs unrolled — load in the stored layout, then convert
+    trained_cfg, convert = cfg, None
+    if cfg.scan_layers:
+        from dalle_tpu.models.scan_params import unrolled_eval_setup
+
+        cfg, convert = unrolled_eval_setup(cfg)
     model = DALLE(cfg)
     text0 = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
     codes0 = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    load_model = DALLE(trained_cfg) if convert else model
     p_shapes = jax.eval_shape(
-        lambda: model.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
+        lambda: load_model.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
     )["params"]
     # prefer the EMA weights when the trainer kept them (--ema_decay);
     # --no_ema forces the raw training params
@@ -110,6 +118,8 @@ def main(argv=None):
     params = load_subtree(
         args.dalle_path, subtree, shape_dtype_of(p_shapes, sharding=single)
     )
+    if convert is not None:
+        params = convert(params)
     if args.taming or args.vqgan_model_path or args.vqgan_config_path:
         from dalle_tpu.models.pretrained import load_vqgan
 

@@ -116,6 +116,10 @@ def parse_args(argv=None):
     parser.add_argument("--reversible", action="store_true")
     parser.add_argument("--use_remat", action="store_true",
                         help="rematerialize layer activations (memory lever)")
+    parser.add_argument("--scan_layers", action="store_true",
+                        help="lax.scan over stacked layers: O(1)-in-depth "
+                             "compile time (MaxText/T5X idiom); requires "
+                             "homogeneous layers — no reversible/pp/MoE")
     parser.add_argument("--remat_policy", type=str, default="full",
                         choices=("full", "dots", "dots_no_batch"),
                         help="with --use_remat: what checkpointed blocks "
@@ -272,6 +276,7 @@ def main(argv=None):
             reversible=args.reversible,
             use_remat=args.use_remat,
             remat_policy=args.remat_policy,
+            scan_layers=args.scan_layers,
             pp_stages=args.pp_stages,
             pp_microbatches=args.pp_microbatches,
             # --sp_mode alone enables SP too: asking for a scheme means
@@ -445,6 +450,16 @@ def main(argv=None):
 
     from dalle_tpu.training.profiler import Meter, dalle_train_flops
 
+    # in-loop sampling decodes in the unrolled layout; scanned-trained
+    # params convert per call (models/scan_params.py)
+    if cfg.scan_layers:
+        from dalle_tpu.models.scan_params import unrolled_eval_setup
+
+        eval_cfg, unstack = unrolled_eval_setup(cfg)
+        eval_model = DALLE(eval_cfg)
+    else:
+        eval_model, unstack = model, lambda p: p
+
     meter = Meter(
         flops_per_step=dalle_train_flops(cfg, args.batch_size),
         tokens_per_step=args.batch_size * cfg.total_seq_len,
@@ -510,7 +525,7 @@ def main(argv=None):
                 # multi-host prefetch; plain text[:1] would touch remote shards
                 sample_text = jnp.asarray(local_rows(text, 1))
                 imgs = generate_images(
-                    model, params, vae, vae_params, sample_text,
+                    eval_model, unstack(params), vae, vae_params, sample_text,
                     # distinct stream from the train-step keys (fold_in
                     # requires a non-negative value: uint32)
                     jax.random.fold_in(
