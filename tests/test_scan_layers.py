@@ -184,3 +184,32 @@ def test_scan_cli_train_then_generate(tmp_path):
     from pathlib import Path
 
     assert len(list(Path(gen_out).glob("*/*.jpg"))) == 1
+
+
+def test_scan_composes_with_sequence_parallelism(rng):
+    """shard_map-based SP attention inside the lax.scan layer body: the
+    scanned stack must train under a dp x tp x sp mesh with either scheme
+    (ring ppermute / ulysses all_to_all), and the two schemes must agree
+    (same params/init seed)."""
+    from dalle_tpu.parallel import make_mesh
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    tx = make_optimizer(1e-3)
+    losses = {}
+    for sp_mode in ("ring", "ulysses"):
+        cfg = _cfg(heads=4, dim_head=8, sp_axis="sp", sp_mode=sp_mode)
+        model = DALLE(cfg)
+        text, codes = _data(cfg, rng, b=4)
+        params, opt = init_train_state(
+            model, tx, mesh, {"params": rng}, text, codes
+        )
+        step = make_dalle_train_step(model, tx, mesh)
+        _, _, loss = step(params, opt, None, text, codes, rng)
+        assert np.isfinite(float(loss)), sp_mode
+        losses[sp_mode] = float(loss)
+    assert abs(losses["ring"] - losses["ulysses"]) < 1e-4, losses
