@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Compile-time sweep: scan-over-layers vs unrolled stack by depth.
+
+Reproduces the O(1)-vs-O(depth) compile-time evidence behind
+``--scan_layers`` (README / docs/SCALING.md).  Times jit(grad(loss))
+compilation of a small-width DALLE at increasing depths in both layouts
+and prints one JSON line per depth.
+
+    python tools/compile_bench.py --depths 12,24,48,64
+
+Runs on whatever backend JAX selects; pass BENCH_PLATFORM=cpu to force
+CPU under the axon site hook (which re-exports JAX_PLATFORMS).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depths", type=str, default="12,24,48")
+    ap.add_argument("--dim", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    def time_compile(depth, scan):
+        cfg = DALLEConfig(
+            num_text_tokens=300, text_seq_len=32, num_image_tokens=512,
+            image_fmap_size=8, dim=args.dim, depth=depth, heads=4,
+            dim_head=args.dim // 4, attn_types=("full",), scan_layers=scan,
+        )
+        model = DALLE(cfg)
+        rng = jax.random.PRNGKey(0)
+        text = jax.random.randint(rng, (2, 32), 1, 300)
+        codes = jax.random.randint(rng, (2, cfg.image_seq_len), 0, 512)
+        params = model.init({"params": rng}, text, codes)["params"]
+        f = jax.jit(
+            jax.grad(
+                lambda p: model.apply({"params": p}, text, codes, return_loss=True)
+            )
+        )
+        t0 = time.time()
+        jax.block_until_ready(f(params))
+        return round(time.time() - t0, 1)
+
+    for depth in (int(d) for d in args.depths.split(",")):
+        tu = time_compile(depth, False)
+        ts = time_compile(depth, True)
+        print(json.dumps({
+            "depth": depth,
+            "unrolled_compile_s": tu,
+            "scanned_compile_s": ts,
+            "speedup": round(tu / ts, 2),
+            "platform": jax.default_backend(),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
