@@ -37,6 +37,7 @@ class CLIPConfig:
     visual_image_size: int = 256
     visual_patch_size: int = 32
     channels: int = 3
+    scan_layers: bool = False  # lax.scan over stacked encoder layers
     dtype: Any = jnp.float32
 
     @property
@@ -53,7 +54,7 @@ class CLIPConfig:
         return cls(**dict(d))
 
 
-def _enc_config(dim, depth, heads, seq_len, dtype) -> TransformerConfig:
+def _enc_config(dim, depth, heads, seq_len, dtype, scan=False) -> TransformerConfig:
     return TransformerConfig(
         dim=dim,
         depth=depth,
@@ -63,6 +64,7 @@ def _enc_config(dim, depth, heads, seq_len, dtype) -> TransformerConfig:
         fmap_size=0,
         attn_types=("full",),
         causal=False,
+        scan_layers=scan,
         dtype=dtype,
     )
 
@@ -76,14 +78,16 @@ class CLIP(nn.Module):
         self.text_emb = nn.Embed(c.num_text_tokens, c.dim_text, embedding_init=init)
         self.text_pos_emb = nn.Embed(c.text_seq_len, c.dim_text, embedding_init=init)
         self.text_transformer = Transformer(
-            _enc_config(c.dim_text, c.text_enc_depth, c.text_heads, c.text_seq_len, c.dtype)
+            _enc_config(c.dim_text, c.text_enc_depth, c.text_heads,
+                        c.text_seq_len, c.dtype, scan=c.scan_layers)
         )
         self.to_text_latent = nn.Dense(c.dim_latent, use_bias=False, dtype=c.dtype)
 
         self.patch_emb = nn.Dense(c.dim_image, dtype=c.dtype)
         self.image_pos_emb = nn.Embed(c.num_patches, c.dim_image, embedding_init=init)
         self.visual_transformer = Transformer(
-            _enc_config(c.dim_image, c.visual_enc_depth, c.visual_heads, c.num_patches, c.dtype)
+            _enc_config(c.dim_image, c.visual_enc_depth, c.visual_heads,
+                        c.num_patches, c.dtype, scan=c.scan_layers)
         )
         self.to_visual_latent = nn.Dense(c.dim_latent, use_bias=False, dtype=c.dtype)
 

@@ -213,3 +213,57 @@ def test_scan_composes_with_sequence_parallelism(rng):
         assert np.isfinite(float(loss)), sp_mode
         losses[sp_mode] = float(loss)
     assert abs(losses["ring"] - losses["ulysses"]) < 1e-4, losses
+
+
+def test_clip_scan_layers(rng):
+    """CLIP encoders under scan: forward-only model, so the scanned layout
+    is used directly end to end (loss finite, differs-from-zero) and the
+    param tree carries the stacked scan module."""
+    from dalle_tpu.models.clip import CLIP, CLIPConfig
+
+    cfg = CLIPConfig(
+        dim_text=32, dim_image=32, dim_latent=32, num_text_tokens=100,
+        text_enc_depth=2, text_seq_len=8, text_heads=2,
+        visual_enc_depth=2, visual_heads=2, visual_image_size=16,
+        visual_patch_size=8, scan_layers=True,
+    )
+    clip = CLIP(cfg)
+    text = jax.random.randint(rng, (2, 8), 1, 100)
+    img = jax.random.uniform(rng, (2, 16, 16, 3))
+    params = clip.init({"params": rng}, text, img)["params"]
+    assert "scan" in params["text_transformer"]
+    assert "scan" in params["visual_transformer"]
+    loss = clip.apply({"params": params}, text, img, return_loss=True)
+    assert np.isfinite(float(loss))
+    # round-trips through to_dict/from_dict (generate.py --clip_path path)
+    assert CLIPConfig.from_dict(cfg.to_dict()).scan_layers is True
+
+
+def test_train_step_determinism(rng):
+    """Same seed, same data -> bit-identical losses across two fresh
+    train-step constructions (regression guard for hidden nondeterminism
+    in init, dropout threading, or scan rng splitting)."""
+    from dalle_tpu.parallel import make_mesh
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    cfg = _cfg(attn_dropout=0.1, ff_dropout=0.1)
+    text, codes = _data(cfg, rng, b=4)
+    losses = []
+    for _ in range(2):
+        model = DALLE(cfg)
+        mesh = make_mesh(dp=2)
+        tx = make_optimizer(1e-3)
+        params, opt = init_train_state(
+            model, tx, mesh, {"params": rng}, text, codes
+        )
+        step = make_dalle_train_step(model, tx, mesh)
+        for i in range(2):
+            params, opt, loss = step(
+                params, opt, None, text, codes, jax.random.fold_in(rng, i)
+            )
+        losses.append(float(loss))
+    assert losses[0] == losses[1], losses

@@ -50,18 +50,20 @@ def main():
                 lambda p: model.apply({"params": p}, text, codes, return_loss=True)
             )
         )
+        # AOT: trace+lower+compile only — no execution cost polluting the
+        # measurement (a grad step's runtime is O(depth) in both layouts)
         t0 = time.time()
-        jax.block_until_ready(f(params))
-        return round(time.time() - t0, 1)
+        f.lower(params).compile()
+        return time.time() - t0
 
     for depth in (int(d) for d in args.depths.split(",")):
         tu = time_compile(depth, False)
         ts = time_compile(depth, True)
         print(json.dumps({
             "depth": depth,
-            "unrolled_compile_s": tu,
-            "scanned_compile_s": ts,
-            "speedup": round(tu / ts, 2),
+            "unrolled_compile_s": round(tu, 1),
+            "scanned_compile_s": round(ts, 1),
+            "speedup": round(tu / ts, 2) if ts > 0 else None,
             "platform": jax.default_backend(),
         }), flush=True)
 

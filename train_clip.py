@@ -64,6 +64,10 @@ def parse_args(argv=None):
     parser.add_argument("--patch_size", type=int, default=32)
     parser.add_argument("--num_text_tokens", type=int, default=None,
                         help="default: tokenizer vocab size")
+    parser.add_argument("--scan_layers", action="store_true",
+                        help="lax.scan over stacked encoder layers (O(1) "
+                             "compile in depth); CLIP is forward-only so "
+                             "no layout conversion is ever needed")
     for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
         parser.add_argument(f"--mesh_{ax}", type=int, default=None)
     parser.add_argument("--distributed_backend", "--distr_backend",
@@ -115,6 +119,7 @@ def main(argv=None):
         visual_heads=args.visual_heads,
         visual_image_size=args.image_size,
         visual_patch_size=args.patch_size,
+        scan_layers=args.scan_layers,
     )
     clip = CLIP(cfg)
     rng = jax.random.PRNGKey(args.seed)
