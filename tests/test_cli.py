@@ -540,3 +540,36 @@ def test_prune_and_find_latest_units(tmp_path):
     prune_checkpoints(tmp_path, 1, pattern="dalle-step*")
     left = sorted(p.name for p in tmp_path.glob("dalle-*") if p.is_dir())
     assert left == ["dalle-bogus", "dalle-epoch0", "dalle-step30"], left
+
+
+def test_train_vae_resume(tiny_data, tmp_path, capsys):
+    """train_vae --auto_resume: params/opt/scheduler/step restore and the
+    step counter keeps ascending (the reference's train_vae cannot resume
+    at all — recovery there means retraining from scratch)."""
+    import train_vae
+
+    out = str(tmp_path / "vae_ckpt")
+    common = [
+        "--image_folder", tiny_data, "--image_size", "16",
+        "--batch_size", "4", "--num_tokens", "16", "--num_layers", "2",
+        "--num_resnet_blocks", "0", "--emb_dim", "8", "--hidden_dim", "8",
+        "--output_path", out, "--no_wandb", "--mesh_dp", "4",
+        "--auto_resume",
+    ]
+    train_vae.main(common + ["--epochs", "1"])
+    from dalle_tpu.training.checkpoint import load_meta
+
+    step1 = load_meta(out + "/vae-final")["step"]
+    assert "opt_state" in load_meta(out + "/vae-final")["subtrees"]
+    capsys.readouterr()
+
+    train_vae.main(common + ["--epochs", "2"])
+    outp = capsys.readouterr().out
+    assert "--auto_resume: resuming from" in outp
+    meta2 = load_meta(out + "/vae-final")
+    assert meta2["step"] > step1  # counter continued, not reset
+    assert meta2["epoch"] == 2  # "epoch to resume FROM": run is complete
+
+    # resuming a COMPLETED run is a no-op (no extra epochs retrained)
+    train_vae.main(common + ["--epochs", "2"])
+    assert load_meta(out + "/vae-final")["step"] == meta2["step"]

@@ -426,10 +426,18 @@ def main(argv=None):
     # a reset counter would make newer checkpoints look older)
     global_step = resume_meta.get("step", 0) if resume_meta else 0
 
-    def save(tag):
+    # the epoch a restart should resume FROM: the in-progress epoch for
+    # in-loop saves (partial-epoch data progress isn't checkpointed), the
+    # NEXT epoch once an epoch completes — so resuming a finished run is
+    # a no-op instead of re-training the last epoch
+    resume_epoch = start_epoch
+
+    def save(tag, *, in_loop=False):
         # every process calls: save_checkpoint is a collective under
         # multi-host (orbax sharded writes + cross-process barriers,
-        # checkpoint.py); it gates directory ops on process 0 itself
+        # checkpoint.py); it gates directory ops on process 0 itself.
+        # in_loop saves run BEFORE the step counter increments, so the
+        # stored step is global_step+1 (= number of applied updates).
         save_checkpoint(
             str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}"),
             params=params,
@@ -438,14 +446,13 @@ def main(argv=None):
             vae_params=vae_params,
             ema_params=ema_params,
             vae_hparams=vae_cfg.to_dict() if vae_cfg else None,
-            epoch=epoch,
-            step=global_step,
+            epoch=resume_epoch,
+            step=global_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict() if sched else None,
             keep_n=args.keep_n_checkpoints,
         )
 
     # fail-early checkpoint (reference: train_dalle.py:561-563)
-    epoch = start_epoch
     save("init")
 
     from dalle_tpu.training.profiler import Meter, dalle_train_flops
@@ -467,6 +474,7 @@ def main(argv=None):
     )
     lr = args.learning_rate
     for epoch in range(start_epoch, args.epochs):
+        resume_epoch = epoch
         if hasattr(loader, "set_epoch"):
             loader.set_epoch(epoch)
         # device-side loss accumulation: float(loss) every step would block
@@ -497,7 +505,7 @@ def main(argv=None):
             loss_count += 1
 
             if global_step != 0 and global_step % args.save_every_n_steps == 0:
-                save(f"step{global_step}")
+                save(f"step{global_step}", in_loop=True)
             m = meter.step()
             if m is not None:
                 # average_all is a COLLECTIVE under multi-host
@@ -543,6 +551,7 @@ def main(argv=None):
         if sched is not None and loss_count:
             lr = sched.step(float(loss_sum) / loss_count)
             opt_state = set_learning_rate(opt_state, lr)
+        resume_epoch = epoch + 1
         save(f"epoch{epoch}")
         if is_root:
             run.log_artifact(

@@ -64,6 +64,13 @@ def parse_args(argv=None):
     parser.add_argument("--config_json", type=str, default=None,
                         help="JSON file of {flag: value} overriding the "
                              "command line (file wins, warns per override)")
+    parser.add_argument("--vae_resume_path", type=str, default=None,
+                        help="resume from this VAE checkpoint dir (params, "
+                             "optimizer state, step, scheduler; the "
+                             "reference's train_vae cannot resume at all)")
+    parser.add_argument("--auto_resume", action="store_true",
+                        help="resume from the newest checkpoint in "
+                             "--output_path if one exists")
     parser = backend_lib.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
@@ -81,18 +88,55 @@ def main(argv=None):
     distr.check_batch_size(args.batch_size)
     is_root = distr.is_root_worker()
 
-    cfg = DiscreteVAEConfig(
-        image_size=args.image_size,
-        num_tokens=args.num_tokens,
-        codebook_dim=args.emb_dim,
-        num_layers=args.num_layers,
-        num_resnet_blocks=args.num_resnet_blocks,
-        hidden_dim=args.hidden_dim,
-        smooth_l1_loss=args.smooth_l1_loss,
-        temperature=args.starting_temp,
-        straight_through=args.straight_through,
-        kl_div_loss_weight=args.kl_loss_weight,
-    )
+    from dalle_tpu.training.checkpoint import is_checkpoint, load_meta
+
+    if args.auto_resume and not args.vae_resume_path:
+        # periodic saves are named "vae" (reference: vae.pt), final is
+        # "vae-final" — pick whichever carries the highest step
+        from pathlib import Path as _P
+
+        cands = [
+            str(_P(args.output_path) / n) for n in ("vae", "vae-final")
+        ]
+        cands = [c for c in cands if is_checkpoint(c)]
+        if cands:
+            args.vae_resume_path = max(
+                cands, key=lambda c: load_meta(c).get("step", 0)
+            )
+            if is_root:
+                print(f"--auto_resume: resuming from {args.vae_resume_path}")
+        elif is_root:
+            print("--auto_resume: no checkpoint found, starting fresh")
+
+    resume_meta = None
+    if args.vae_resume_path:
+        assert is_checkpoint(args.vae_resume_path), (
+            f"{args.vae_resume_path}: not a checkpoint"
+        )
+        resume_meta = load_meta(args.vae_resume_path)
+        cfg = DiscreteVAEConfig.from_dict(resume_meta["hparams"])
+        if args.image_size != cfg.image_size:
+            import warnings
+
+            warnings.warn(
+                f"--image_size {args.image_size} != checkpoint's "
+                f"{cfg.image_size}; using the checkpoint's so the training "
+                "distribution doesn't silently change on resume"
+            )
+            args.image_size = cfg.image_size
+    else:
+        cfg = DiscreteVAEConfig(
+            image_size=args.image_size,
+            num_tokens=args.num_tokens,
+            codebook_dim=args.emb_dim,
+            num_layers=args.num_layers,
+            num_resnet_blocks=args.num_resnet_blocks,
+            hidden_dim=args.hidden_dim,
+            smooth_l1_loss=args.smooth_l1_loss,
+            temperature=args.starting_temp,
+            straight_through=args.straight_through,
+            kl_div_loss_weight=args.kl_loss_weight,
+        )
     vae = DiscreteVAE(cfg)
 
     dataset = ImageFolderDataset(args.image_folder, image_size=args.image_size)
@@ -112,6 +156,25 @@ def main(argv=None):
     params, opt_state = init_train_state(
         vae, tx, distr.mesh, {"params": rng, "gumbel": rng}, sample, return_loss=True
     )
+    if resume_meta is not None:
+        from dalle_tpu.training.checkpoint import load_subtree, shape_dtype_of
+
+        params = load_subtree(
+            args.vae_resume_path, "params", shape_dtype_of(params)
+        )
+        if "opt_state" in resume_meta.get("subtrees", ()):
+            try:
+                opt_state = load_subtree(
+                    args.vae_resume_path, "opt_state", shape_dtype_of(opt_state)
+                )
+            except (ValueError, TypeError, KeyError) as e:
+                import warnings
+
+                warnings.warn(
+                    "checkpoint optimizer state incompatible with this "
+                    f"run's optimizer config ({type(e).__name__}); resuming "
+                    "with a FRESH optimizer (params still restored)"
+                )
     step_fn = make_vae_train_step(vae, tx, distr.mesh)
     encode_fn = jax.jit(
         lambda p, img: vae.apply({"params": p}, img, method=DiscreteVAE.get_codebook_indices)
@@ -128,23 +191,48 @@ def main(argv=None):
         print(f"VAE params: {count_params(params):,}; dataset: {len(dataset)} images")
 
     sched = ExponentialDecay(lr=args.learning_rate, gamma=args.lr_decay_rate)
-    temp = args.starting_temp
+    start_epoch = 0
     global_step = 0
+    if resume_meta is not None:
+        global_step = resume_meta.get("step", 0)
+        start_epoch = resume_meta.get("epoch", 0)
+        if resume_meta.get("scheduler_state"):
+            sched.load_state_dict(resume_meta["scheduler_state"])
+            opt_state = set_learning_rate(opt_state, sched.lr)
+    # anneal is a pure function of step and the checkpoint's hparams carry
+    # the original starting temperature (cfg.temperature), so the resumed
+    # value is exactly what the crashed run had even if --starting_temp is
+    # not repeated on the resume command line
+    start_temp = cfg.temperature
+    temp = max(
+        start_temp * math.exp(-args.anneal_rate * global_step),
+        args.temp_min,
+    )
+    # the epoch a restart should resume FROM: the in-progress epoch for
+    # in-loop saves (partial-epoch data progress isn't checkpointed), the
+    # NEXT epoch once an epoch completes — so resuming a finished run is a
+    # no-op instead of re-training the last epoch
+    resume_epoch = start_epoch
     t10 = time.perf_counter()
 
-    def save(name):
+    def save(name, *, in_loop=False):
         # every process calls: save_checkpoint is a collective under
         # multi-host (orbax sharded writes + cross-process barriers,
-        # checkpoint.py); it gates directory ops on process 0 itself
+        # checkpoint.py); it gates directory ops on process 0 itself.
+        # in_loop saves run BEFORE the step counter increments, so the
+        # stored step is global_step+1 (= number of applied updates).
         save_checkpoint(
             f"{args.output_path}/{name}",
             params=params,
             hparams=cfg.to_dict(),
-            step=global_step,
+            opt_state=opt_state,
+            epoch=resume_epoch,
+            step=global_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict(),
         )
 
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
+        resume_epoch = epoch
         loader.set_epoch(epoch)
         for images in device_prefetch(loader, batch_sharding(distr.mesh)):
             params, opt_state, loss, recons = step_fn(
@@ -153,7 +241,7 @@ def main(argv=None):
             if global_step % 100 == 0:
                 # temperature anneal (reference: train_vae.py:218-221,269-271)
                 temp = max(
-                    args.starting_temp * math.exp(-args.anneal_rate * global_step),
+                    start_temp * math.exp(-args.anneal_rate * global_step),
                     args.temp_min,
                 )
                 lr = sched.step()
@@ -175,7 +263,7 @@ def main(argv=None):
                     )
                     run.log({"temperature": temp, "lr": lr}, step=global_step)
             if global_step % args.save_every_n_steps == 0:
-                save("vae")
+                save("vae", in_loop=True)
             if global_step % 10 == 0:
                 # collective: every process enters average_all (multi-host
                 # process_allgather); print/log stays root-gated below
@@ -191,6 +279,7 @@ def main(argv=None):
                 run.log({"loss": avg_loss, "epoch": epoch, "samples_per_sec": sps},
                         step=global_step)
             global_step += 1
+        resume_epoch = epoch + 1
     save("vae-final")
     if is_root:
         run.log_artifact(args.output_path + "/vae-final", name="trained-vae")
