@@ -136,6 +136,64 @@ def find_latest_checkpoint(parent, prefix: str):
     return str(best) if best else None
 
 
+def resolve_auto_resume(
+    explicit_path, auto: bool, output_path, prefix: str,
+    *, candidates=None, is_root: bool = True,
+):
+    """Shared --auto_resume resolution for the train CLIs.
+
+    Returns the checkpoint path to resume from, or None for a fresh start.
+    ``candidates``: optional explicit dir names (train_vae's fixed "vae" /
+    "vae-final" names don't fit the ``{prefix}-*`` glob); otherwise
+    :func:`find_latest_checkpoint` ranks ``{prefix}-*`` by saved step.
+    """
+    if explicit_path:
+        assert is_checkpoint(explicit_path), f"{explicit_path}: not a checkpoint"
+        return explicit_path
+    if not auto:
+        return None
+    if candidates is not None:
+        cands = [
+            str(Path(output_path) / n) for n in candidates
+        ]
+        cands = [c for c in cands if is_checkpoint(c)]
+        latest = (
+            max(cands, key=lambda c: load_meta(c).get("step", 0))
+            if cands else None
+        )
+    else:
+        latest = find_latest_checkpoint(output_path, prefix)
+    if is_root:
+        print(
+            f"--auto_resume: resuming from {latest}"
+            if latest
+            else "--auto_resume: no checkpoint found, starting fresh"
+        )
+    return latest
+
+
+def restore_train_state(path, meta, params, opt_state):
+    """Targeted params (+ optimizer state, when compatible) restore.
+
+    Structure/shape mismatches in the optimizer tree mean "different
+    optimizer config" → warn and keep the fresh optimizer; I/O and
+    corruption errors propagate.  Returns (params, opt_state).
+    """
+    params = load_subtree(path, "params", shape_dtype_of(params))
+    if "opt_state" in meta.get("subtrees", ()):
+        try:
+            opt_state = load_subtree(path, "opt_state", shape_dtype_of(opt_state))
+        except (ValueError, TypeError, KeyError) as e:
+            import warnings
+
+            warnings.warn(
+                "checkpoint optimizer state is incompatible with this run's "
+                f"optimizer config ({type(e).__name__}); resuming with a "
+                "FRESH optimizer (params still restored)"
+            )
+    return params, opt_state
+
+
 def prune_checkpoints(parent: Path, keep_n: int, pattern: str = "*"):
     """Delete oldest-by-mtime beyond keep_n (reference: train_dalle.py:523-526)."""
     parent = Path(parent)

@@ -573,3 +573,36 @@ def test_train_vae_resume(tiny_data, tmp_path, capsys):
     # resuming a COMPLETED run is a no-op (no extra epochs retrained)
     train_vae.main(common + ["--epochs", "2"])
     assert load_meta(out + "/vae-final")["step"] == meta2["step"]
+
+
+def test_train_clip_resume(tiny_data, tmp_path, capsys):
+    """train_clip --auto_resume: params/opt/step restore, completed runs
+    are a no-op on resume."""
+    import train_clip
+
+    out = str(tmp_path / "clip_ckpt")
+    common = [
+        "--image_text_folder", tiny_data, "--image_size", "16",
+        "--patch_size", "8", "--batch_size", "4", "--dim_text", "16",
+        "--dim_image", "16", "--dim_latent", "16", "--text_enc_depth", "1",
+        "--visual_enc_depth", "1", "--text_heads", "2", "--visual_heads", "2",
+        "--text_seq_len", "8", "--truncate_captions", "--no_wandb",
+        "--output_path", out, "--mesh_dp", "4", "--auto_resume",
+    ]
+    train_clip.main(common + ["--epochs", "1"])
+    from dalle_tpu.training.checkpoint import load_meta
+
+    meta1 = load_meta(out + "/clip-final")
+    assert "opt_state" in meta1["subtrees"]
+    capsys.readouterr()
+
+    train_clip.main(common + ["--epochs", "2"])
+    outp = capsys.readouterr().out
+    assert "--auto_resume: resuming from" in outp
+    meta2 = load_meta(out + "/clip-final")
+    assert meta2["step"] > meta1["step"]
+    assert meta2["epoch"] == 2
+
+    # completed run: no-op
+    train_clip.main(common + ["--epochs", "2"])
+    assert load_meta(out + "/clip-final")["step"] == meta2["step"]

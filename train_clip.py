@@ -75,6 +75,11 @@ def parse_args(argv=None):
     parser.add_argument("--config_json", type=str, default=None,
                         help="JSON file of {flag: value} overriding the "
                              "command line (file wins, warns per override)")
+    parser.add_argument("--clip_resume_path", type=str, default=None,
+                        help="resume from this CLIP checkpoint dir")
+    parser.add_argument("--auto_resume", action="store_true",
+                        help="resume from the newest checkpoint in "
+                             "--output_path if one exists")
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
 
@@ -93,6 +98,51 @@ def main(argv=None):
     tokenizer = get_tokenizer(
         bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese
     )
+
+    from dalle_tpu.training.checkpoint import (
+        load_meta,
+        resolve_auto_resume,
+        restore_train_state,
+    )
+
+    args.clip_resume_path = resolve_auto_resume(
+        args.clip_resume_path, args.auto_resume, args.output_path, "clip",
+        is_root=is_root,
+    )
+    resume_meta = None
+    if args.clip_resume_path:
+        resume_meta = load_meta(args.clip_resume_path)
+        cfg = CLIPConfig.from_dict(resume_meta["hparams"])
+        # the dataset and init dummies must match the checkpoint's model,
+        # not whatever flags the restart command line happened to carry
+        for flag, ckpt_val in (
+            ("text_seq_len", cfg.text_seq_len),
+            ("image_size", cfg.visual_image_size),
+        ):
+            if getattr(args, flag) != ckpt_val:
+                import warnings
+
+                warnings.warn(
+                    f"--{flag} {getattr(args, flag)} != checkpoint's "
+                    f"{ckpt_val}; using the checkpoint's"
+                )
+                setattr(args, flag, ckpt_val)
+    else:
+        cfg = CLIPConfig(
+            dim_text=args.dim_text,
+            dim_image=args.dim_image,
+            dim_latent=args.dim_latent,
+            num_text_tokens=args.num_text_tokens or tokenizer.vocab_size,
+            text_enc_depth=args.text_enc_depth,
+            text_seq_len=args.text_seq_len,
+            text_heads=args.text_heads,
+            visual_enc_depth=args.visual_enc_depth,
+            visual_heads=args.visual_heads,
+            visual_image_size=args.image_size,
+            visual_patch_size=args.patch_size,
+            scan_layers=args.scan_layers,
+        )
+
     ds = TextImageDataset(
         args.image_text_folder,
         text_len=args.text_seq_len,
@@ -107,20 +157,6 @@ def main(argv=None):
         ds, args.batch_size, shuffle=True, seed=args.seed, rank=rank, world=world
     )
 
-    cfg = CLIPConfig(
-        dim_text=args.dim_text,
-        dim_image=args.dim_image,
-        dim_latent=args.dim_latent,
-        num_text_tokens=args.num_text_tokens or tokenizer.vocab_size,
-        text_enc_depth=args.text_enc_depth,
-        text_seq_len=args.text_seq_len,
-        text_heads=args.text_heads,
-        visual_enc_depth=args.visual_enc_depth,
-        visual_heads=args.visual_heads,
-        visual_image_size=args.image_size,
-        visual_patch_size=args.patch_size,
-        scan_layers=args.scan_layers,
-    )
     clip = CLIP(cfg)
     rng = jax.random.PRNGKey(args.seed)
     text0 = np.zeros((args.batch_size // world, args.text_seq_len), np.int32)
@@ -131,6 +167,10 @@ def main(argv=None):
     params, opt_state = init_train_state(
         clip, tx, distr.mesh, {"params": rng}, text0, img0
     )
+    if resume_meta is not None:
+        params, opt_state = restore_train_state(
+            args.clip_resume_path, resume_meta, params, opt_state
+        )
     step_fn = make_clip_train_step(clip, tx, distr.mesh)
     if is_root:
         print(f"CLIP params: {count_params(params):,}; dataset: {len(ds)} pairs")
@@ -146,25 +186,34 @@ def main(argv=None):
         use_wandb=not args.no_wandb,
     ) if is_root else None
 
-    def save(name):
+    # epoch a restart resumes FROM (next epoch once one completes)
+    resume_epoch = 0
+    global_step = 0
+    if resume_meta is not None:
+        global_step = resume_meta.get("step", 0)
+        resume_epoch = resume_meta.get("epoch", 0)
+    start_epoch = resume_epoch
+
+    def save(name, *, in_loop=False):
         # every process calls: save_checkpoint is a collective under
         # multi-host (orbax sharded writes + cross-process barriers,
         # checkpoint.py); it gates directory ops on process 0 itself
         save_checkpoint(
             str(ckpt_dir / name), params=params, hparams=cfg.to_dict(),
-            step=global_step,
+            opt_state=opt_state, epoch=resume_epoch,
+            step=global_step + (1 if in_loop else 0),
         )
 
     from dalle_tpu.training.profiler import Meter
 
-    global_step = 0
     save("clip-init")  # fail-early (reference idiom: train_dalle.py:561-563)
     meter = Meter(
         flops_per_step=0.0,  # no analytic CLIP FLOP model; mfu not reported
         tokens_per_step=args.batch_size * args.text_seq_len,
         samples_per_step=args.batch_size,
     )
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
+        resume_epoch = epoch
         loader.set_epoch(epoch)
         for text, images in device_prefetch(loader, batch_sharding(distr.mesh)):
             params, opt_state, loss = step_fn(
@@ -184,8 +233,9 @@ def main(argv=None):
                         step=global_step,
                     )
             if global_step and global_step % args.save_every_n_steps == 0:
-                save(f"clip-step{global_step}")
+                save(f"clip-step{global_step}", in_loop=True)
             global_step += 1
+        resume_epoch = epoch + 1
         save(f"clip-epoch{epoch}")
     save("clip-final")
     if is_root:

@@ -226,18 +226,13 @@ def main(argv=None):
         bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese
     )
 
-    if args.auto_resume and not args.dalle_path:
-        from dalle_tpu.training.checkpoint import find_latest_checkpoint
+    from dalle_tpu.training.checkpoint import resolve_auto_resume
 
-        latest = find_latest_checkpoint(
-            args.output_path, args.dalle_output_file_name
+    if args.auto_resume:
+        args.dalle_path = resolve_auto_resume(
+            args.dalle_path, True, args.output_path,
+            args.dalle_output_file_name, is_root=is_root,
         )
-        if latest:
-            args.dalle_path = latest
-            if is_root:
-                print(f"--auto_resume: resuming from {latest}")
-        elif is_root:
-            print("--auto_resume: no checkpoint found, starting fresh")
 
     resume_meta = None
     start_epoch = 0
@@ -343,27 +338,14 @@ def main(argv=None):
         model, tx, distr.mesh, {"params": rng}, text0, codes0
     )
     if resume_meta is not None:
-        # targeted restores: typed containers + direct sharded placement
-        params = load_subtree(args.dalle_path, "params", shape_dtype_of(params))
-        if "opt_state" in resume_meta.get("subtrees", ()):
-            # optimizer state resumes too (reference: train_dalle.py:424);
-            # a changed optimizer config (e.g. different --ga_steps) makes
-            # the saved tree incompatible — warn and start fresh then
-            try:
-                opt_state = load_subtree(
-                    args.dalle_path, "opt_state", shape_dtype_of(opt_state)
-                )
-            # only STRUCTURE/shape mismatches mean "different optimizer
-            # config"; I/O or corruption errors must propagate, not be
-            # silently converted into a fresh-optimizer resume
-            except (ValueError, TypeError, KeyError) as e:
-                import warnings
+        # targeted restores: typed containers + direct sharded placement;
+        # optimizer state resumes too (reference: train_dalle.py:424) with
+        # the shared incompatible-optimizer fallback (checkpoint.py)
+        from dalle_tpu.training.checkpoint import restore_train_state
 
-                warnings.warn(
-                    "checkpoint optimizer state is incompatible with this "
-                    f"run's optimizer config ({type(e).__name__}); resuming "
-                    "with a FRESH optimizer (params still restored)"
-                )
+        params, opt_state = restore_train_state(
+            args.dalle_path, resume_meta, params, opt_state
+        )
     # EMA of the params (beyond-reference; saved as its own checkpoint
     # subtree, preferred by generate.py).  The tracking tree must be a REAL
     # copy: the train step donates params, and an aliasing tree would be

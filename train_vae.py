@@ -88,31 +88,19 @@ def main(argv=None):
     distr.check_batch_size(args.batch_size)
     is_root = distr.is_root_worker()
 
-    from dalle_tpu.training.checkpoint import is_checkpoint, load_meta
+    from dalle_tpu.training.checkpoint import (
+        load_meta,
+        resolve_auto_resume,
+        restore_train_state,
+    )
 
-    if args.auto_resume and not args.vae_resume_path:
-        # periodic saves are named "vae" (reference: vae.pt), final is
-        # "vae-final" — pick whichever carries the highest step
-        from pathlib import Path as _P
-
-        cands = [
-            str(_P(args.output_path) / n) for n in ("vae", "vae-final")
-        ]
-        cands = [c for c in cands if is_checkpoint(c)]
-        if cands:
-            args.vae_resume_path = max(
-                cands, key=lambda c: load_meta(c).get("step", 0)
-            )
-            if is_root:
-                print(f"--auto_resume: resuming from {args.vae_resume_path}")
-        elif is_root:
-            print("--auto_resume: no checkpoint found, starting fresh")
-
+    # periodic saves are named "vae" (reference: vae.pt), final "vae-final"
+    args.vae_resume_path = resolve_auto_resume(
+        args.vae_resume_path, args.auto_resume, args.output_path, "vae",
+        candidates=("vae", "vae-final"), is_root=is_root,
+    )
     resume_meta = None
     if args.vae_resume_path:
-        assert is_checkpoint(args.vae_resume_path), (
-            f"{args.vae_resume_path}: not a checkpoint"
-        )
         resume_meta = load_meta(args.vae_resume_path)
         cfg = DiscreteVAEConfig.from_dict(resume_meta["hparams"])
         if args.image_size != cfg.image_size:
