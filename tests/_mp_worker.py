@@ -95,6 +95,43 @@ def main():
     gathered = multihost_utils.process_allgather(restored, tiled=True)
     np.testing.assert_array_equal(np.asarray(gathered).reshape(data.shape), data)
 
+    # full model train step across processes: dp2 x tp2 over 2 procs x 2
+    # local devices — the TP activation psums and the dp gradient psum all
+    # cross the process boundary (the evidence the reference gets from
+    # running DeepSpeed DP under its launcher, and then some: the
+    # reference has no TP at all, SURVEY.md §2.10)
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    cfg = DALLEConfig(
+        num_text_tokens=64, text_seq_len=8, num_image_tokens=32,
+        image_fmap_size=2, dim=16, depth=1, heads=2, dim_head=8,
+        attn_types=("full",),
+    )
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    gb = 4  # global batch; each process feeds its own half via prefetch
+    text_local = np.full((gb // nproc, cfg.text_seq_len), 1 + proc_id, np.int32)
+    codes_local = np.full((gb // nproc, cfg.image_seq_len), proc_id, np.int32)
+    [(text_g, codes_g)] = list(
+        device_prefetch(iter([(text_local, codes_local)]), sh_c, depth=2)
+    )
+    tx = make_optimizer(1e-3)
+    params, opt = init_train_state(model, tx, mesh_c, {"params": rng}, text_g, codes_g)
+    step = make_dalle_train_step(model, tx, mesh_c)
+    params, opt, loss = step(params, opt, None, text_g, codes_g, rng)
+    loss_f = float(loss)
+    assert np.isfinite(loss_f), loss_f
+    # the loss is psum-reduced over the mesh: every process must agree
+    all_losses = np.asarray(
+        multihost_utils.process_allgather(np.float32(loss_f))
+    ).reshape(-1)
+    np.testing.assert_allclose(all_losses, loss_f, rtol=1e-6)
+
     backend.local_barrier()
     print(f"MP_WORKER_OK rank={proc_id}")
 
