@@ -180,7 +180,7 @@ class DALLE(nn.Module):
             self.text_pos_emb = nn.Embed(c.text_seq_len + 1, c.dim, embedding_init=init)
             self.image_pos_emb = AxialPositionalEmbedding(c.image_fmap_size, c.dim)
         self.transformer = Transformer(c.transformer_config(), name="transformer")
-        self.final_norm = nn.LayerNorm(dtype=c.dtype, name="final_norm")
+        self.final_norm = nn.LayerNorm(epsilon=1e-5, dtype=c.dtype, name="final_norm")  # torch-eps parity
         self.to_logits = nn.Dense(c.total_tokens, dtype=c.dtype, name="to_logits")
         if c.stable:
             self.norm_by_max = DivideMax(axis=-1)

@@ -134,7 +134,7 @@ class MoEFeedForward(nn.Module):
         )
         h = jnp.einsum("gecd,edf->gecf", expert_in, wi.astype(c.dtype))
         u, gate = jnp.split(h, 2, axis=-1)
-        h = u * jax.nn.gelu(gate)
+        h = u * jax.nn.gelu(gate, approximate=False)  # exact erf (torch F.gelu parity)
         h = nn.Dropout(c.ff_dropout)(h, deterministic=deterministic)
         expert_out = jnp.einsum("gecf,efd->gecd", h, wo.astype(c.dtype))
         y = jnp.einsum("gtec,gecd->gtd", combine.astype(c.dtype), expert_out)

@@ -273,24 +273,33 @@ def _static_mask(cfg: TransformerConfig, attn_type: str) -> np.ndarray:
 
 
 def shift_tokens_full(x: jnp.ndarray, t: int, f: int) -> jnp.ndarray:
-    """Token-shift over the full sequence (reference: transformer.py:92-129).
+    """Token-shift over the full sequence (reference: transformer.py:92-129),
+    with the REFERENCE's region geometry (pinned by the differential test
+    tests/test_golden_dalle.py): the text region spans ``t + 1`` positions
+    ([bos | text], reference text_len = seq_len - img_seq_len + 1,
+    transformer.py:103), and the image region is the remaining f²-1
+    positions — grid cell g sits at sequence position t+1+g, padded to the
+    full grid for the 2-D shifts and cropped back.
 
     Text region: first half of channels pulled from the previous position
-    (zeros shift in at the boundary).  Image region: reshaped to the grid,
-    one quarter of channels pulled from above, one from the left.
+    (zeros shift in at the boundary).  Image region: one quarter of
+    channels pulled from above, one from the left.
     """
     b, n, d = x.shape
-    xt, xi = x[:, :t], x[:, t:]
+    tl = min(t + 1, n)  # text region incl. <bos>
+    xt, xi = x[:, :tl], x[:, tl:]
     h = d // 2
     xt_shift = jnp.pad(xt[:, :-1, :h], ((0, 0), (1, 0), (0, 0)))
     xt = jnp.concatenate([xt_shift, xt[:, :, h:]], axis=-1)
-    if f > 0:
+    if f > 0 and xi.shape[1] > 0:
         q = d // 4
-        g = xi.reshape(b, f, f, d)
+        n_img = xi.shape[1]
+        pad = f * f - n_img
+        g = jnp.pad(xi, ((0, 0), (0, pad), (0, 0))).reshape(b, f, f, d)
         top = jnp.pad(g[:, :-1, :, :q], ((0, 0), (1, 0), (0, 0), (0, 0)))
         left = jnp.pad(g[:, :, :-1, q : 2 * q], ((0, 0), (0, 0), (1, 0), (0, 0)))
         g = jnp.concatenate([top, left, g[:, :, :, 2 * q :]], axis=-1)
-        xi = g.reshape(b, f * f, d)
+        xi = g.reshape(b, f * f, d)[:, :n_img]
     return jnp.concatenate([xt, xi], axis=1)
 
 
@@ -314,17 +323,19 @@ def shift_token_step(
     # text variant
     text_out = jnp.concatenate([prev[:, :h], x_t[:, h:]], axis=-1)
     if f == 0:
-        return jnp.where(idx < t, text_out, text_out)
+        return text_out
+    # reference geometry (shift_tokens_full): text region = t+1 positions
+    # ([bos | text]); grid cell of position idx is j = idx - (t+1).
     # image variant: above = idx - f (zero on grid row 0), left = idx - 1
     # (zero on grid col 0)
-    j = idx - t
+    j = idx - (t + 1)
     on_row0 = j < f
     on_col0 = (j % f) == 0
     above = gather(f)
     above = jnp.where(on_row0, jnp.zeros_like(above), above)
     left = jnp.where(on_col0, jnp.zeros_like(prev), prev)
     img_out = jnp.concatenate([above[:, :q], left[:, q : 2 * q], x_t[:, 2 * q :]], axis=-1)
-    return jnp.where(idx < t, text_out, img_out)
+    return jnp.where(idx < t + 1, text_out, img_out)
 
 
 class FeedForward(nn.Module):
@@ -338,7 +349,7 @@ class FeedForward(nn.Module):
         inner = c.dim * c.ff_mult
         y = nn.Dense(inner * 2, dtype=c.dtype, name="wi")(x)
         y, gate = jnp.split(y, 2, axis=-1)
-        y = y * jax.nn.gelu(gate)
+        y = y * jax.nn.gelu(gate, approximate=False)  # exact erf (torch F.gelu parity)
         y = nn.Dropout(c.ff_dropout)(y, deterministic=deterministic)
         return nn.Dense(c.dim, dtype=c.dtype, name="wo")(y)
 
@@ -502,7 +513,7 @@ class CausalSGU(nn.Module):
         self.inner = c.dim * c.ff_mult
         self.proj_in = nn.Dense(self.inner, dtype=c.dtype, name="proj_in")
         self.proj_out = nn.Dense(c.dim, dtype=c.dtype, name="proj_out")
-        self.sgu_norm = nn.LayerNorm(dtype=c.dtype, name="sgu_norm")
+        self.sgu_norm = nn.LayerNorm(epsilon=1e-5, dtype=c.dtype, name="sgu_norm")
         n = c.seq_len
         # near-zero init + unit bias so the gate starts as identity (gMLP paper)
         self.spatial_w = self.param(
@@ -516,7 +527,7 @@ class CausalSGU(nn.Module):
         return jnp.where(tri, self.spatial_w, 0.0).astype(self.cfg.dtype)
 
     def __call__(self, x, key_pad_mask=None, deterministic=True):
-        y = jax.nn.gelu(self.proj_in(x))
+        y = jax.nn.gelu(self.proj_in(x), approximate=False)
         u, v = jnp.split(y, 2, axis=-1)
         v = self.sgu_norm(v)
         w = self._gate_weight()
@@ -529,7 +540,7 @@ class CausalSGU(nn.Module):
 
     def prefill(self, x, cache):
         L = x.shape[1]
-        y = jax.nn.gelu(self.proj_in(x))
+        y = jax.nn.gelu(self.proj_in(x), approximate=False)
         u, v = jnp.split(y, 2, axis=-1)
         v = self.sgu_norm(v)
         cv = jax.lax.dynamic_update_slice_in_dim(
@@ -541,7 +552,7 @@ class CausalSGU(nn.Module):
         return self.proj_out(u * gated), {"v": cv}
 
     def decode_step(self, x_t, idx, cache, deterministic=True):
-        y = jax.nn.gelu(self.proj_in(x_t))
+        y = jax.nn.gelu(self.proj_in(x_t), approximate=False)
         u, v = jnp.split(y, 2, axis=-1)
         v = self.sgu_norm(v)
         cv = jax.lax.dynamic_update_slice_in_dim(
@@ -568,9 +579,9 @@ class SubLayer(nn.Module):
 
     def setup(self):
         c = self.cfg
-        self.norm = nn.LayerNorm(dtype=c.dtype, name="norm")
+        self.norm = nn.LayerNorm(epsilon=1e-5, dtype=c.dtype, name="norm")  # torch-eps parity
         if c.sandwich_norm:
-            self.norm_out = nn.LayerNorm(dtype=c.dtype, name="norm_out")
+            self.norm_out = nn.LayerNorm(epsilon=1e-5, dtype=c.dtype, name="norm_out")
         if self.kind.startswith("attn:"):
             atype = self.kind.split(":", 1)[1]
             if atype == "mlp":
