@@ -66,14 +66,20 @@ def axial_attention(q, k, v, text_seq_len, fmap_size, axis, key_pad_mask=None):
     Image queries attend along one image axis (causally) plus all text; text
     attends causally to text (reference: attention.py:211-321, re-derived as
     reshaped batched einsums instead of einops split/merge of a padded
-    sequence).
+    sequence).  Region geometry is the reference's: text region = t+1
+    positions ([bos | text], attention.py:236), the grid's final cell is
+    virtual — inputs are padded by one position and the output cropped
+    (attention.py:121-124 equivalent).
 
     q,k,v: [b, h, n, d] with n == text_seq_len + fmap_size**2; axis 0 = row
     attention, axis 1 = column attention.
     """
     b, h, n, d = q.shape
-    t, f = text_seq_len, fmap_size
-    assert n == t + f * f
+    f = fmap_size
+    t = text_seq_len + 1  # [bos | text]
+    assert n == text_seq_len + f * f
+    pad = ((0, 0), (0, 0), (0, 1), (0, 0))
+    q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
     qt, qi = q[:, :, :t], q[:, :, t:]
     kt, ki = k[:, :, :t], k[:, :, t:]
     vt, vi = v[:, :, :t], v[:, :, t:]
@@ -122,7 +128,7 @@ def axial_attention(q, k, v, text_seq_len, fmap_size, axis, key_pad_mask=None):
     if axis == 1:
         out_i = out_i.swapaxes(2, 3)
     out_i = out_i.reshape(b, h, f * f, d)
-    return jnp.concatenate([out_t, out_i], axis=2)
+    return jnp.concatenate([out_t, out_i], axis=2)[:, :, :n]  # crop pad
 
 
 def conv_like_attention(
@@ -134,12 +140,16 @@ def conv_like_attention(
     (causal by flat index) plus all text; text→text causal.  Replaces the
     reference's F.unfold gather (reference: attention.py:156-177) with a
     static neighbor-index table + jnp.take — a form XLA lowers to an
-    efficient gather on TPU.
+    efficient gather on TPU.  Region geometry is the reference's: text
+    region = t+1 positions, virtual final grid cell (attention.py:116-124).
     """
     b, h, n, d = q.shape
-    t, f = text_seq_len, fmap_size
+    f = fmap_size
+    t = text_seq_len + 1  # [bos | text]
     n_img = f * f
-    assert n == t + n_img
+    assert n == text_seq_len + n_img
+    pad = ((0, 0), (0, 0), (0, 1), (0, 0))
+    q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
     qt, qi = q[:, :, :t], q[:, :, t:]
     kt, ki = k[:, :, :t], k[:, :, t:]
     vt, vi = v[:, :, :t], v[:, :, t:]
@@ -149,14 +159,17 @@ def conv_like_attention(
     tmask = (i[None, :] <= i[:, None])[None, None]
     out_t = _sdpa(qt, kt, vt, tmask if tpad is None else tmask & tpad)
 
-    # static neighbor table: for each image pos, k² candidate key positions
+    # static neighbor table: for each image pos, the CENTERED k² dilated
+    # window (reference 'same'-padding unfold, attention.py:152-157),
+    # causal-clipped by flat index
+    assert kernel_size % 2 == 1, "kernel size must be odd (reference parity)"
     idx = np.arange(n_img)
     row, col = idx // f, idx % f
-    offs = np.arange(kernel_size) * dilation
-    nr = row[:, None, None] - offs[None, :, None]  # [n_img, k, 1]
-    nc = col[:, None, None] - offs[None, None, :]  # [n_img, 1, k]
+    offs = (np.arange(kernel_size) - (kernel_size - 1) // 2) * dilation
+    nr = row[:, None, None] + offs[None, :, None]  # [n_img, k, 1]
+    nc = col[:, None, None] + offs[None, None, :]  # [n_img, 1, k]
     nr, nc = np.broadcast_arrays(nr, nc)
-    valid = (nr >= 0) & (nc >= 0)
+    valid = (nr >= 0) & (nc >= 0) & (nr < f) & (nc < f)
     nidx = np.where(valid, nr * f + nc, 0).reshape(n_img, -1)
     nvalid = (valid.reshape(n_img, -1)) & (nidx <= idx[:, None])
     nidx_j = jnp.asarray(nidx)
@@ -184,4 +197,4 @@ def conv_like_attention(
     out_i = jnp.einsum("bhiw,bhiwd->bhid", p_win, vw) + jnp.einsum(
         "bhij,bhjd->bhid", p_txt, vt
     )
-    return jnp.concatenate([out_t, out_i], axis=2)
+    return jnp.concatenate([out_t, out_i], axis=2)[:, :, :n]  # crop pad

@@ -18,6 +18,14 @@ KV-cache decode for *any* variant.
 
 Masks are cached; sequence layout is ``[text_seq_len | fmap**2]`` matching
 DALLE's input (bos-prepended, last-dropped; reference: dalle_pytorch.py:528,556-558).
+
+Region geometry follows the REFERENCE convention exactly (pinned by the
+differential tests in tests/test_golden_dalle.py): the text region spans
+``text_seq_len + 1`` positions ([bos | text] — reference
+``text_len = seq_len + 1 - img_seq_len``, attention.py:116,236), and image
+grid cell ``g`` sits at sequence position ``text_seq_len + 1 + g``; the
+grid's final cell is virtual (the reference pads the sequence by one and
+crops, attention.py:121-124).
 """
 
 from __future__ import annotations
@@ -37,24 +45,26 @@ def causal_mask(seq_len: int) -> np.ndarray:
 def axial_mask(text_seq_len: int, fmap_size: int, axis: int) -> np.ndarray:
     """Axial attention mask (axis=0: same row; axis=1: same column).
 
-    Image position attends to: all text, plus causally-earlier image
-    positions sharing its row (axis 0) or column (axis 1), itself included.
-    Text attends causally to text only, mirroring the reference's split
-    text/image computation (reference: attention.py:273-296).
+    Image position attends to: all text (incl. <bos>), plus
+    causally-earlier image positions sharing its row (axis 0) or column
+    (axis 1), itself included.  Text attends causally to text only,
+    mirroring the reference's split text/image computation
+    (reference: attention.py:273-296) with its t+1 region boundary.
     """
     n_img = fmap_size * fmap_size
     n = text_seq_len + n_img
-    mask = np.zeros((n, n), dtype=bool)
-    t = text_seq_len
+    tl = text_seq_len + 1  # [bos | text]
+    ext = tl + n_img  # padded length incl. the virtual final grid cell
+    mask = np.zeros((ext, ext), dtype=bool)
     # text -> text causal
-    mask[:t, :t] = causal_mask(t)
+    mask[:tl, :tl] = causal_mask(tl)
     # image -> all text
-    mask[t:, :t] = True
+    mask[tl:, :tl] = True
     img = np.arange(n_img)
     row, col = img // fmap_size, img % fmap_size
     same = (row[:, None] == row[None, :]) if axis == 0 else (col[:, None] == col[None, :])
-    mask[t:, t:] = same & (img[None, :] <= img[:, None])
-    return mask
+    mask[tl:, tl:] = same & (img[None, :] <= img[:, None])
+    return mask[:n, :n]  # crop the virtual final cell
 
 
 @functools.lru_cache(maxsize=64)
@@ -63,32 +73,34 @@ def conv_like_mask(
 ) -> np.ndarray:
     """Causal local-window mask matching the reference's unfold construction.
 
-    Image query at (r, c) may attend to image positions inside the
-    ``kernel_size**2`` dilated window whose bottom-right corner is (r, c),
-    restricted to flat index <= the query's (reference: attention.py:156-177),
-    plus all text.  Text attends causally to text.
+    Image query at (r, c) may attend to image positions inside the CENTERED
+    ``kernel_size**2`` dilated window around (r, c) — the reference unfolds
+    with 'same' padding (attention.py:152-157) — restricted to flat index
+    <= the query's (attention.py:166-177), plus all text.  Text attends
+    causally to text.  ``kernel_size`` must be odd (reference:
+    attention.py:93).
     """
+    assert kernel_size % 2 == 1, "kernel size must be odd (reference parity)"
     n_img = fmap_size * fmap_size
     n = text_seq_len + n_img
-    mask = np.zeros((n, n), dtype=bool)
-    t = text_seq_len
-    mask[:t, :t] = causal_mask(t)
-    mask[t:, :t] = True
+    tl = text_seq_len + 1  # [bos | text] (reference region geometry)
+    ext = tl + n_img
+    mask = np.zeros((ext, ext), dtype=bool)
+    mask[:tl, :tl] = causal_mask(tl)
+    mask[tl:, :tl] = True
     img = np.arange(n_img)
     row, col = img // fmap_size, img % fmap_size
     dr = row[:, None] - row[None, :]  # query_row - key_row
     dc = col[:, None] - col[None, :]
-    span = (kernel_size - 1) * dilation
+    half = (kernel_size - 1) // 2 * dilation
     in_window = (
-        (dr >= 0)
-        & (dr <= span)
+        (np.abs(dr) <= half)
         & (dr % dilation == 0)
-        & (dc >= 0)
-        & (dc <= span)
+        & (np.abs(dc) <= half)
         & (dc % dilation == 0)
     )
-    mask[t:, t:] = in_window & (img[None, :] <= img[:, None])
-    return mask
+    mask[tl:, tl:] = in_window & (img[None, :] <= img[:, None])
+    return mask[:n, :n]  # crop the virtual final cell
 
 
 @functools.lru_cache(maxsize=64)
@@ -117,7 +129,9 @@ def block_sparse_mask(
     if num_random_blocks is None:
         num_random_blocks = max(nb // 4, 1)
     layout = np.zeros((nb, nb), dtype=bool)
-    n_text_blocks = max((text_seq_len + block - 1) // block, 1)
+    # global blocks cover the [bos | text] prefix (t+1 positions — the
+    # reference's text_len, attention.py:116)
+    n_text_blocks = max((text_seq_len + 1 + block - 1) // block, 1)
     rng = np.random.RandomState(seed)
     for qb in range(nb):
         layout[qb, max(0, qb - num_local_blocks + 1) : qb + 1] = True

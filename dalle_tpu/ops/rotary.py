@@ -43,17 +43,22 @@ def dalle_rotary_angles(
 ) -> np.ndarray:
     """Angle table ``[seq_len, R]`` where ``2R`` leading head channels rotate.
 
-    Sequence layout is the transformer's input layout: position ``p`` holds
-    <bos>/text for ``p < text_seq_len`` and image token ``p - text_seq_len``
-    otherwise (reference: dalle_pytorch/dalle_pytorch.py:528,556-558).
+    Region geometry matches the reference (transformer.py:206-227, pinned
+    for the rest of the stack by tests/test_golden_dalle.py): the text
+    region spans ``text_seq_len + 1`` positions ([bos | text] — reference
+    ``text_len = seq_len - img_seq_len + 1``), image grid cell ``g`` sits
+    at position ``text_seq_len + 1 + g``, and the virtual final cell is
+    cropped (reference ``pos_emb[:-1]``).
     """
     n_img = fmap_size * fmap_size
     seq_len = text_seq_len + n_img
+    tl = text_seq_len + 1  # [bos | text]
+    ext = tl + n_img  # incl. the virtual final grid cell
     dt = _even(dim_head // 3)  # 1-D text channels
     da = _even(dim_head // 3)  # per-axis 2-D image channels (row and col each)
 
-    pos = np.arange(seq_len, dtype=np.float64)
-    is_img = pos >= text_seq_len
+    pos = np.arange(ext, dtype=np.float64)
+    is_img = pos >= tl
 
     # --- text 1-D rotary ---------------------------------------------------
     inv_freq = theta ** (-np.arange(0, dt, 2, dtype=np.float64) / max(dt, 1))
@@ -61,7 +66,7 @@ def dalle_rotary_angles(
     text_angles = tpos[:, None] * inv_freq[None, :]  # [seq, dt/2]
 
     # --- image 2-D axial rotary (pixel-style freqs) ------------------------
-    img_idx = np.maximum(pos - text_seq_len, 0).astype(np.int64)
+    img_idx = np.maximum(pos - tl, 0).astype(np.int64)
     row = img_idx // fmap_size
     col = img_idx % fmap_size
     coords = (
@@ -75,7 +80,7 @@ def dalle_rotary_angles(
 
     angles = np.concatenate([text_angles, row_angles, col_angles], axis=-1)
     assert 2 * angles.shape[-1] <= dim_head
-    return angles.astype(np.float32)
+    return angles[:seq_len].astype(np.float32)  # crop the virtual cell
 
 
 def apply_rotary(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:

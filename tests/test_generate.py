@@ -129,7 +129,7 @@ def test_generate_texts(rng):
     [
         dict(attn_types=("full",)),
         dict(attn_types=("axial_row", "axial_col")),
-        dict(attn_types=("conv_like",), kernel_size=2),
+        dict(attn_types=("conv_like",), kernel_size=3),
         dict(attn_types=("sparse",), sparse_block=4),
         dict(attn_types=("full", "mlp")),
         dict(attn_types=("full",), shift_tokens=True),

@@ -89,7 +89,7 @@ def test_grads_flow(rng):
 CFG_VARIANTS = {
     "full": dict(attn_types=("full",)),
     "axial": dict(attn_types=("axial_row", "axial_col")),
-    "conv": dict(attn_types=("conv_like",), kernel_size=2),
+    "conv": dict(attn_types=("conv_like",), kernel_size=3),
     "sparse": dict(attn_types=("sparse",)),
     "mlp": dict(attn_types=("full", "mlp")),
     "rotary": dict(attn_types=("full",), rotary_emb=True),

@@ -30,24 +30,31 @@ def test_causal_mask_lower_triangular():
 
 
 def test_axial_mask_semantics():
+    # reference region geometry: grid cell g sits at position T + 1 + g
+    # (text region = [bos | text] = T+1 positions; masks.py docstring)
     m = M.axial_mask(T, F, 0)
-    # image pos (1,2) = flat T + 6 attends to (1,0) [same row, earlier]
-    assert m[T + 6, T + 4]
-    # ... not to (0,2) [different row] under row attention
-    assert not m[T + 6, T + 2]
+    # image cell (1,2) = flat 6 attends to (1,0) = flat 4 [same row, earlier]
+    assert m[T + 1 + 6, T + 1 + 4]
+    # ... not to (0,2) = flat 2 [different row] under row attention
+    assert not m[T + 1 + 6, T + 1 + 2]
     # column attention: (1,2) attends to (0,2), not (1,0)
     mc = M.axial_mask(T, F, 1)
-    assert mc[T + 6, T + 2] and not mc[T + 6, T + 4]
-    # image attends to all text; text never attends to image
-    assert m[T + 6, :T].all() and not m[:T, T:].any()
+    assert mc[T + 1 + 6, T + 1 + 2] and not mc[T + 1 + 6, T + 1 + 4]
+    # image attends to all text (incl. bos slot); text never attends image
+    assert m[T + 1 + 6, : T + 1].all() and not m[: T + 1, T + 1 :].any()
 
 
 def test_conv_like_mask_semantics():
-    m = M.conv_like_mask(T, F, kernel_size=2)
-    q = T + 5  # image (1,1)
-    assert m[q, q] and m[q, T + 4] and m[q, T + 0] and m[q, T + 1]
-    assert not m[q, T + 2]  # (0,2) outside window
-    assert m[q, :T].all()
+    # grid cell g at position T + 1 + g (reference region geometry); the
+    # window is CENTERED and causal-clipped (reference attention.py:152-177)
+    m = M.conv_like_mask(T, F, kernel_size=3)
+    q = T + 1 + 5  # image cell (1,1) on the F=4 grid
+    # centered 3x3 window around (1,1), flat index <= 5:
+    for cell in (0, 1, 2, 4, 5):
+        assert m[q, T + 1 + cell], cell
+    assert not m[q, T + 1 + 6]  # (1,2): in window but future
+    assert not m[q, T + 1 + 3]  # (0,3): past but outside the window
+    assert m[q, : T + 1].all()
 
 
 def test_block_sparse_mask_causal_and_text_global():
@@ -67,7 +74,7 @@ def test_axial_matches_masked_dense(rng, attn_type):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
-@pytest.mark.parametrize("kernel,dilation", [(2, 1), (3, 1), (2, 2)])
+@pytest.mark.parametrize("kernel,dilation", [(3, 1), (5, 1), (3, 2)])
 def test_conv_like_matches_masked_dense(rng, kernel, dilation):
     q, k, v = qkv(rng)
     mask = M.conv_like_mask(T, F, kernel, dilation)
