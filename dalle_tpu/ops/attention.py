@@ -60,6 +60,35 @@ def full_causal_attention(q, k, v, key_pad_mask=None):
     return _sdpa(q, k, v, mask)
 
 
+
+def _split_regions(q, k, v, text_seq_len, key_pad_mask):
+    """Shared region plumbing for the structured ops (reference geometry):
+    pad the joint sequence by one (virtual final grid cell), split at the
+    t+1 [bos | text] boundary, and run the text→text causal attention.
+
+    Deliberate deviation, documented: ``key_pad_mask`` masks padded TEXT
+    keys for text queries too.  The reference's axial/conv classes apply
+    the pad mask only on image→text attention (their dots_text gets causal
+    masking alone, reference attention.py:141-149) — unlike the
+    reference's own full Attention, which masks everywhere
+    (attention.py:66-69).  We follow the full-attention (strictly safer)
+    behavior for every variant; with no pad mask (DALLE training and every
+    differential test) the two are identical.
+
+    Returns (qt, qi, kt, ki, vt, vi, out_t, tpad)."""
+    pad = ((0, 0), (0, 0), (0, 1), (0, 0))
+    q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+    t = text_seq_len + 1
+    qt, qi = q[:, :, :t], q[:, :, t:]
+    kt, ki = k[:, :, :t], k[:, :, t:]
+    vt, vi = v[:, :, :t], v[:, :, t:]
+    tpad = key_pad_mask[:, None, None, :t] if key_pad_mask is not None else None
+    i = jnp.arange(t)
+    tmask = (i[None, :] <= i[:, None])[None, None]
+    out_t = _sdpa(qt, kt, vt, tmask if tpad is None else tmask & tpad)
+    return qt, qi, kt, ki, vt, vi, out_t, tpad
+
+
 def axial_attention(q, k, v, text_seq_len, fmap_size, axis, key_pad_mask=None):
     """Structured axial attention, O(n·(√n_img + n_text)).
 
@@ -78,17 +107,9 @@ def axial_attention(q, k, v, text_seq_len, fmap_size, axis, key_pad_mask=None):
     f = fmap_size
     t = text_seq_len + 1  # [bos | text]
     assert n == text_seq_len + f * f
-    pad = ((0, 0), (0, 0), (0, 1), (0, 0))
-    q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
-    qt, qi = q[:, :, :t], q[:, :, t:]
-    kt, ki = k[:, :, :t], k[:, :, t:]
-    vt, vi = v[:, :, :t], v[:, :, t:]
-
-    # text → text causal
-    tpad = key_pad_mask[:, None, None, :t] if key_pad_mask is not None else None
-    i = jnp.arange(t)
-    tmask = (i[None, :] <= i[:, None])[None, None]
-    out_t = _sdpa(qt, kt, vt, tmask if tpad is None else tmask & tpad)
+    qt, qi, kt, ki, vt, vi, out_t, tpad = _split_regions(
+        q, k, v, text_seq_len, key_pad_mask
+    )
 
     # image: reshape to expose the attended axis as the key dimension
     def grid(x):
@@ -148,16 +169,9 @@ def conv_like_attention(
     t = text_seq_len + 1  # [bos | text]
     n_img = f * f
     assert n == text_seq_len + n_img
-    pad = ((0, 0), (0, 0), (0, 1), (0, 0))
-    q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
-    qt, qi = q[:, :, :t], q[:, :, t:]
-    kt, ki = k[:, :, :t], k[:, :, t:]
-    vt, vi = v[:, :, :t], v[:, :, t:]
-
-    tpad = key_pad_mask[:, None, None, :t] if key_pad_mask is not None else None
-    i = jnp.arange(t)
-    tmask = (i[None, :] <= i[:, None])[None, None]
-    out_t = _sdpa(qt, kt, vt, tmask if tpad is None else tmask & tpad)
+    qt, qi, kt, ki, vt, vi, out_t, tpad = _split_regions(
+        q, k, v, text_seq_len, key_pad_mask
+    )
 
     # static neighbor table: for each image pos, the CENTERED k² dilated
     # window (reference 'same'-padding unfold, attention.py:152-157),

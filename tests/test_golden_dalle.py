@@ -113,6 +113,13 @@ def _ref_to_ours(ref, cfg):
             "bias": sd["to_logits.1.bias"],
         },
     }
+    P["transformer"] = _map_transformer_layers(sd, "transformer", cfg.depth)
+    return jax.tree_util.tree_map(jnp.asarray, P)
+
+
+def _map_transformer_layers(sd, prefix, depth):
+    """Reference Transformer layer params → our layer_{i}_{attn,ff} dict."""
+
     def get(*names):
         """First present key wins — shift_tokens adds a PreShiftToken
         wrapper level (.fn.fn.fn...) that is absent without it."""
@@ -122,9 +129,9 @@ def _ref_to_ours(ref, cfg):
         raise KeyError(names)
 
     tr = {}
-    for i in range(cfg.depth):
-        a = f"transformer.layers.layers.{i}.0"
-        g = f"transformer.layers.layers.{i}.1"
+    for i in range(depth):
+        a = f"{prefix}.layers.layers.{i}.0"
+        g = f"{prefix}.layers.layers.{i}.1"
         tr[f"layer_{i}_attn"] = {
             "layerscale": sd[f"{a}.scale"].reshape(-1),
             "norm": {
@@ -172,8 +179,7 @@ def _ref_to_ours(ref, cfg):
                 },
             },
         }
-    P["transformer"] = tr
-    return jax.tree_util.tree_map(jnp.asarray, P)
+    return tr
 
 
 @pytest.mark.parametrize("shift_tokens", [False, True])
@@ -306,3 +312,168 @@ def test_structured_attention_matches_reference(rng, attn_type, ref_kwargs):
     ja = JointAttention(cfg.transformer_config(), attn_type=attn_type)
     got = np.asarray(ja.apply({"params": params}, jnp.asarray(x)))
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
+
+
+def test_clip_forward_matches_reference(rng):
+    """Our CLIP vs the reference CLIP class (dalle_pytorch.py:229-305) with
+    identical weights: patch embedding order, non-causal encoders,
+    masked-mean pooling with a padded text batch, L2-normalized latents,
+    learned temperature, rerank similarity, and the symmetric InfoNCE."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.clip import CLIP, CLIPConfig
+
+    _install_reference()
+    from dalle_pytorch.dalle_pytorch import CLIP as RefCLIP
+
+    torch.manual_seed(0)
+    kw = dict(
+        dim_text=32, dim_image=32, dim_latent=24, num_text_tokens=60,
+        text_enc_depth=2, text_seq_len=8, text_heads=2,
+        visual_enc_depth=2, visual_heads=2, visual_image_size=16,
+        visual_patch_size=8,
+    )
+    ref = RefCLIP(**kw).eval()
+    cfg = CLIPConfig(**kw)
+    clip = CLIP(cfg)
+
+    sd = {n: p.detach().numpy() for n, p in ref.named_parameters()}
+    params = {
+        "text_emb": {"embedding": sd["text_emb.weight"]},
+        "text_pos_emb": {"embedding": sd["text_pos_emb.weight"]},
+        "text_transformer": _map_transformer_layers(
+            sd, "text_transformer", kw["text_enc_depth"]
+        ),
+        "to_text_latent": {"kernel": sd["to_text_latent.weight"].T},
+        "patch_emb": {
+            "kernel": sd["to_visual_embedding.weight"].T,
+            "bias": sd["to_visual_embedding.bias"],
+        },
+        "image_pos_emb": {"embedding": sd["visual_pos_emb.weight"]},
+        "visual_transformer": _map_transformer_layers(
+            sd, "visual_transformer", kw["visual_enc_depth"]
+        ),
+        "to_visual_latent": {"kernel": sd["to_visual_latent.weight"].T},
+        "temperature": sd["temperature"],
+    }
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+
+    rs = np.random.RandomState(2)
+    text = rs.randint(1, 60, (4, 8))
+    text[:, 6:] = 0  # padding: exercises masked-mean + key-pad masking
+    image = rs.rand(4, 16, 16, 3).astype(np.float32)
+
+    t_text = torch.from_numpy(text).long()
+    t_img = torch.from_numpy(image).permute(0, 3, 1, 2)  # NHWC -> NCHW
+    t_mask = t_text != 0
+    with torch.no_grad():
+        want_sim = ref(t_text, t_img, text_mask=t_mask).numpy()
+        want_loss = ref(t_text, t_img, text_mask=t_mask, return_loss=True).item()
+
+    got_sim = np.asarray(
+        clip.apply({"params": params}, jnp.asarray(text), jnp.asarray(image))
+    )
+    got_loss = float(
+        clip.apply(
+            {"params": params}, jnp.asarray(text), jnp.asarray(image),
+            return_loss=True,
+        )
+    )
+    np.testing.assert_allclose(got_sim, want_sim, atol=2e-4, rtol=1e-4)
+    assert abs(got_loss - want_loss) < 1e-4, (got_loss, want_loss)
+
+
+def test_discrete_vae_matches_reference(rng):
+    """Our in-tree DiscreteVAE vs the reference DiscreteVAE class
+    (dalle_pytorch.py:74-225), deterministic paths: encoder logits /
+    codebook indices (incl. the 0.5/0.5 channel normalization buffers) and
+    the decode stack (torch ConvTranspose2d kernels convert with a spatial
+    flip).  The Gumbel-sampled training forward is excluded — torch and
+    JAX draw different noise by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+
+    _, RefVAE = _install_reference()
+    torch.manual_seed(0)
+    rv = RefVAE(
+        image_size=16, num_layers=2, num_tokens=32, codebook_dim=16,
+        hidden_dim=8, num_resnet_blocks=1,
+    ).eval()
+    cfg = DiscreteVAEConfig(
+        image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
+        hidden_dim=8, num_resnet_blocks=1,
+        normalization=((0.5,) * 3, (0.5,) * 3),  # the reference's default
+    )
+    ours = DiscreteVAE(cfg)
+
+    sd = {n: p.detach().numpy() for n, p in rv.named_parameters()}
+
+    def conv(w):  # torch Conv2d OIHW -> flax HWIO
+        return np.ascontiguousarray(w.transpose(2, 3, 1, 0))
+
+    def convT(w):  # torch ConvTranspose2d IOHW -> flax HWIO, spatially flipped
+        return np.ascontiguousarray(w.transpose(2, 3, 0, 1)[::-1, ::-1])
+
+    def res(prefix):
+        return {
+            f"Conv_{j}": {
+                "kernel": conv(sd[f"{prefix}.net.{2 * j}.weight"]),
+                "bias": sd[f"{prefix}.net.{2 * j}.bias"],
+            }
+            for j in range(3)
+        }
+
+    params = {
+        "codebook": {"embedding": sd["codebook.weight"]},
+        "encoder": {
+            "Conv_0": {"kernel": conv(sd["encoder.0.0.weight"]),
+                       "bias": sd["encoder.0.0.bias"]},
+            "Conv_1": {"kernel": conv(sd["encoder.1.0.weight"]),
+                       "bias": sd["encoder.1.0.bias"]},
+            "ResBlock_0": res("encoder.2"),
+            "Conv_2": {"kernel": conv(sd["encoder.3.weight"]),
+                       "bias": sd["encoder.3.bias"]},
+        },
+        "decoder": {
+            "Conv_0": {"kernel": conv(sd["decoder.0.weight"]),
+                       "bias": sd["decoder.0.bias"]},
+            "ResBlock_0": res("decoder.1"),
+            "ConvTranspose_0": {"kernel": convT(sd["decoder.2.0.weight"]),
+                                "bias": sd["decoder.2.0.bias"]},
+            "ConvTranspose_1": {"kernel": convT(sd["decoder.3.0.weight"]),
+                                "bias": sd["decoder.3.0.bias"]},
+            "Conv_1": {"kernel": conv(sd["decoder.4.weight"]),
+                       "bias": sd["decoder.4.bias"]},
+        },
+    }
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+
+    rs = np.random.RandomState(0)
+    img = rs.rand(2, 16, 16, 3).astype(np.float32)
+    t_img = torch.from_numpy(img).permute(0, 3, 1, 2)
+    with torch.no_grad():
+        want_idx = rv.get_codebook_indices(t_img).numpy()
+        want_logits = rv(t_img, return_logits=True).permute(0, 2, 3, 1).numpy()
+    got_idx = np.asarray(
+        ours.apply({"params": params}, jnp.asarray(img),
+                   method=DiscreteVAE.get_codebook_indices)
+    )
+    np.testing.assert_array_equal(got_idx.reshape(-1), want_idx.reshape(-1))
+    # our no-loss forward returns the encoder logits (the reference's
+    # return_logits=True path, dalle_pytorch.py:198-199)
+    got_logits = np.asarray(ours.apply({"params": params}, jnp.asarray(img)))
+    np.testing.assert_allclose(
+        got_logits.reshape(want_logits.shape), want_logits, atol=2e-4, rtol=1e-4
+    )
+
+    codes = rs.randint(0, 32, (2, 16))
+    with torch.no_grad():
+        want_dec = rv.decode(torch.from_numpy(codes).long())
+        want_dec = want_dec.permute(0, 2, 3, 1).numpy()
+    got_dec = np.asarray(
+        ours.apply({"params": params}, jnp.asarray(codes), method=DiscreteVAE.decode)
+    )
+    np.testing.assert_allclose(got_dec, want_dec, atol=2e-4, rtol=1e-4)
