@@ -75,7 +75,7 @@ def _split_regions(q, k, v, text_seq_len, key_pad_mask):
     behavior for every variant; with no pad mask (DALLE training and every
     differential test) the two are identical.
 
-    Returns (qt, qi, kt, ki, vt, vi, out_t, tpad)."""
+    Returns (qi, kt, ki, vt, vi, out_t)."""
     pad = ((0, 0), (0, 0), (0, 1), (0, 0))
     q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
     t = text_seq_len + 1
@@ -86,7 +86,7 @@ def _split_regions(q, k, v, text_seq_len, key_pad_mask):
     i = jnp.arange(t)
     tmask = (i[None, :] <= i[:, None])[None, None]
     out_t = _sdpa(qt, kt, vt, tmask if tpad is None else tmask & tpad)
-    return qt, qi, kt, ki, vt, vi, out_t, tpad
+    return qi, kt, ki, vt, vi, out_t
 
 
 def axial_attention(q, k, v, text_seq_len, fmap_size, axis, key_pad_mask=None):
@@ -107,9 +107,7 @@ def axial_attention(q, k, v, text_seq_len, fmap_size, axis, key_pad_mask=None):
     f = fmap_size
     t = text_seq_len + 1  # [bos | text]
     assert n == text_seq_len + f * f
-    qt, qi, kt, ki, vt, vi, out_t, tpad = _split_regions(
-        q, k, v, text_seq_len, key_pad_mask
-    )
+    qi, kt, ki, vt, vi, out_t = _split_regions(q, k, v, text_seq_len, key_pad_mask)
 
     # image: reshape to expose the attended axis as the key dimension
     def grid(x):
@@ -169,9 +167,7 @@ def conv_like_attention(
     t = text_seq_len + 1  # [bos | text]
     n_img = f * f
     assert n == text_seq_len + n_img
-    qt, qi, kt, ki, vt, vi, out_t, tpad = _split_regions(
-        q, k, v, text_seq_len, key_pad_mask
-    )
+    qi, kt, ki, vt, vi, out_t = _split_regions(q, k, v, text_seq_len, key_pad_mask)
 
     # static neighbor table: for each image pos, the CENTERED k² dilated
     # window (reference 'same'-padding unfold, attention.py:152-157),

@@ -113,12 +113,19 @@ def _ref_to_ours(ref, cfg):
             "bias": sd["to_logits.1.bias"],
         },
     }
-    P["transformer"] = _map_transformer_layers(sd, "transformer", cfg.depth)
+    P["transformer"] = _map_transformer_layers(
+        sd, "transformer", cfg.depth, reversible=cfg.reversible
+    )
     return jax.tree_util.tree_map(jnp.asarray, P)
 
 
-def _map_transformer_layers(sd, prefix, depth):
-    """Reference Transformer layer params → our layer_{i}_{attn,ff} dict."""
+def _map_transformer_layers(sd, prefix, depth, reversible=False):
+    """Reference Transformer layer params → our layer_{i}_{attn,ff} dict.
+
+    Handles both execution engines' layouts: SequentialSequence
+    (``layers.layers.{i}.{0,1}``) and ReversibleSequence
+    (``layers.blocks.{i}.{f,g}.net`` — reversible.py:143-157), plus the
+    optional sandwich norm_out."""
 
     def get(*names):
         """First present key wins — shift_tokens adds a PreShiftToken
@@ -128,11 +135,23 @@ def _map_transformer_layers(sd, prefix, depth):
                 return sd[n]
         raise KeyError(names)
 
+    def maybe_norm_out(branch, d):
+        if f"{branch}.fn.norm_out.weight" in sd:
+            d["norm_out"] = {
+                "scale": sd[f"{branch}.fn.norm_out.weight"],
+                "bias": sd[f"{branch}.fn.norm_out.bias"],
+            }
+        return d
+
     tr = {}
     for i in range(depth):
-        a = f"{prefix}.layers.layers.{i}.0"
-        g = f"{prefix}.layers.layers.{i}.1"
-        tr[f"layer_{i}_attn"] = {
+        if reversible:
+            a = f"{prefix}.layers.blocks.{i}.f.net"
+            g = f"{prefix}.layers.blocks.{i}.g.net"
+        else:
+            a = f"{prefix}.layers.layers.{i}.0"
+            g = f"{prefix}.layers.layers.{i}.1"
+        tr[f"layer_{i}_attn"] = maybe_norm_out(a, {
             "layerscale": sd[f"{a}.scale"].reshape(-1),
             "norm": {
                 "scale": sd[f"{a}.fn.norm.weight"],
@@ -153,8 +172,8 @@ def _map_transformer_layers(sd, prefix, depth):
                     ),
                 },
             },
-        }
-        tr[f"layer_{i}_ff"] = {
+        })
+        tr[f"layer_{i}_ff"] = maybe_norm_out(g, {
             "layerscale": sd[f"{g}.scale"].reshape(-1),
             "norm": {
                 "scale": sd[f"{g}.fn.norm.weight"],
@@ -178,16 +197,26 @@ def _map_transformer_layers(sd, prefix, depth):
                     ),
                 },
             },
-        }
+        })
     return tr
 
 
-@pytest.mark.parametrize("shift_tokens", [False, True])
-def test_dalle_forward_matches_reference(rng, shift_tokens):
-    """NB the reference constructor DEFAULTS shift_tokens=True — both modes
-    are pinned here (our token-shift is a full-sequence re-derivation,
-    transformer.py shift_tokens_full, vs the reference's split-and-pad
-    PreShiftToken, transformer.py:92-129)."""
+@pytest.mark.parametrize(
+    "flags",
+    [
+        {},
+        {"shift_tokens": True},  # NB the reference DEFAULTS this on
+        {"reversible": True},  # ReversibleSequence vs our coupling chain
+        {"sandwich_norm": True, "stable": True},  # norm_out + DivideMax + 0.1/0.9
+    ],
+    ids=["plain", "shift", "reversible", "sandwich_stable"],
+)
+def test_dalle_forward_matches_reference(rng, flags):
+    """Pins our forward to the reference's across its execution flags (our
+    token-shift is a full-sequence re-derivation vs the reference's
+    split-and-pad PreShiftToken; our reversible is a whole-chain custom_vjp
+    vs the reference's autograd.Function — forward math must agree
+    exactly)."""
     import jax
     import jax.numpy as jnp
 
@@ -198,16 +227,18 @@ def test_dalle_forward_matches_reference(rng, shift_tokens):
     rvae = RefVAE(
         image_size=16, num_layers=2, num_tokens=32, codebook_dim=16, hidden_dim=8
     )
+    kw = dict(shift_tokens=False)
+    kw.update(flags)
     ref = RefDALLE(
         dim=32, vae=rvae, num_text_tokens=50, text_seq_len=8, depth=2,
         heads=2, dim_head=16, attn_types=("full",), loss_img_weight=7,
-        rotary_emb=False, shift_tokens=shift_tokens,
+        rotary_emb=False, **kw,
     ).eval()
 
     cfg = DALLEConfig(
         num_text_tokens=50, text_seq_len=8, num_image_tokens=32,
         image_fmap_size=4, dim=32, depth=2, heads=2, dim_head=16,
-        attn_types=("full",), loss_img_weight=7.0, shift_tokens=shift_tokens,
+        attn_types=("full",), loss_img_weight=7.0, **flags,
     )
     model = DALLE(cfg)
     params = _ref_to_ours(ref, cfg)
