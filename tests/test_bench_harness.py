@@ -104,3 +104,21 @@ def test_analytic_flops_matches_xla_cost_model(rng):
     assert xla_flops and xla_flops > 0
     ratio = dalle_train_flops(cfg, b) / xla_flops
     assert 0.85 < ratio < 1.15, f"analytic/xla flops ratio {ratio:.3f}"
+
+
+def test_reference_compare_quick():
+    """tools/reference_compare.py --quick runs end to end and reports both
+    phases with sane fields (keeps the head-to-head tool from bit-rotting)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["BENCH_PLATFORM"] = "cpu"
+    tool = os.path.join(os.path.dirname(BENCH), "tools", "reference_compare.py")
+    p = subprocess.run(
+        [sys.executable, tool, "--quick"],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [json.loads(l) for l in p.stdout.strip().splitlines()]
+    phases = {r["phase"]: r for r in lines}
+    assert set(phases) == {"train_step", "generate"}
+    for r in phases.values():
+        assert r["reference_s"] > 0 and r["ours_s"] > 0 and r["speedup"] > 0
