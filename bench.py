@@ -466,7 +466,15 @@ def _train_bench(tiny=False, use_flash=False):
             params, opt_state, None, text, codes, jax.random.fold_in(rng, i)
         )
         if i % 5 == 0:
-            _hb(f"timing iter {i}/{iters}")
+            # block so the heartbeat carries a REAL running step-time
+            # estimate — a phase killed at its budget still leaves a
+            # throughput number in its log (full-size CPU run lesson)
+            jax.block_until_ready(loss)
+            done = max(i, 1)
+            _hb(
+                f"timing iter {i}/{iters} "
+                f"(~{(time.perf_counter() - t0) / done:.2f}s/step so far)"
+            )
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / iters
     _hb(f"avg step time {dt:.4f}s")
