@@ -459,24 +459,24 @@ def _train_bench(tiny=False, use_flash=False):
                 )
             jax.block_until_ready(loss)
 
+    # timed in 5-iter chunks, blocking at each boundary: the heartbeat
+    # carries a real running step-time estimate (a phase killed at its
+    # budget still leaves a throughput number in its log — full-size CPU
+    # run lesson), and only in-chunk time counts toward dt so the
+    # heartbeat/sync overhead between chunks never biases the metric
     iters = 3 if smoke else (10 if tiny else 20)
+    t_work = 0.0
     t0 = time.perf_counter()
     for i in range(iters):
         params, opt_state, loss = step(
             params, opt_state, None, text, codes, jax.random.fold_in(rng, i)
         )
-        if i % 5 == 0:
-            # block so the heartbeat carries a REAL running step-time
-            # estimate — a phase killed at its budget still leaves a
-            # throughput number in its log (full-size CPU run lesson)
+        if (i + 1) % 5 == 0 or i + 1 == iters:
             jax.block_until_ready(loss)
-            done = max(i, 1)
-            _hb(
-                f"timing iter {i}/{iters} "
-                f"(~{(time.perf_counter() - t0) / done:.2f}s/step so far)"
-            )
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / iters
+            t_work += time.perf_counter() - t0
+            _hb(f"timing iter {i + 1}/{iters} (~{t_work / (i + 1):.2f}s/step)")
+            t0 = time.perf_counter()
+    dt = t_work / iters
     _hb(f"avg step time {dt:.4f}s")
 
     img_tokens_per_sec = batch * cfg.image_seq_len / dt / n_dev
