@@ -508,3 +508,26 @@ def test_discrete_vae_matches_reference(rng):
         ours.apply({"params": params}, jnp.asarray(codes), method=DiscreteVAE.decode)
     )
     np.testing.assert_allclose(got_dec, want_dec, atol=2e-4, rtol=1e-4)
+
+
+def test_layerscale_init_thresholds_match_reference():
+    """The depth-dependent LayerScale init tiers (0.1 / 1e-5 / 1e-6 with
+    boundaries after layers 18 and 24, reference transformer.py:40-54,
+    constructed with depth = ind + 1 at :186-190) — pinned by building a
+    depth-26 reference transformer and comparing every layer's actual
+    init value against our _layer_scale_init."""
+    from dalle_tpu.models.transformer import _layer_scale_init
+
+    _install_reference()
+    from dalle_pytorch.transformer import Transformer as RefTransformer
+
+    torch.manual_seed(0)
+    ref = RefTransformer(
+        dim=16, depth=26, seq_len=8, heads=2, dim_head=8, causal=True,
+        rotary_emb=False,
+    )
+    sd = {n: p.detach().numpy() for n, p in ref.named_parameters()}
+    for i in range(26):
+        for j in (0, 1):  # attn and ff branches share the layer's init
+            got = float(sd[f"layers.layers.{i}.{j}.scale"].reshape(-1)[0])
+            assert got == pytest.approx(_layer_scale_init(i), rel=1e-6), (i, j, got)
