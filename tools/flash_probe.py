@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Standalone Pallas flash-kernel compile probe (round-4 VERDICT ask #2).
+
+Compiles ONLY the flash attention kernel (fwd + bwd) — no 12-layer model
+graph — at flagship shapes, one case per killable subprocess, recording
+Mosaic compile time per case.  Purpose: the prime TPU-wedge suspect
+(ops/flash.py under Mosaic) must be isolatable in seconds, not found out
+45 minutes into a monolithic train phase.  Reference capability this
+kernel stands in for: DeepSpeed block-sparse attention,
+/root/reference/dalle_pytorch/attention.py:325-384.
+
+Usage:
+    python tools/flash_probe.py                 # all cases, JSON summary line
+    python tools/flash_probe.py --case causal_bf16_1280
+    python tools/flash_probe.py --list
+
+Per-case results append to ``--log`` (default bench_logs/flash_probe.jsonl)
+BEFORE the next case starts, so a wedge mid-probe still leaves evidence.
+Off-TPU the kernel runs in interpret mode — the probe still validates
+numerics and the harness itself.  Exit codes: 0 = all cases ok,
+2 = some case failed/timed out, 3 = no case even started (import hang).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_LOG = os.path.join(REPO, "bench_logs", "flash_probe.jsonl")
+
+# (name, n, d, dtype, sparse) — flagship shapes: n=1280 is the 12-layer
+# DALL-E joint sequence (256 text + 1024 image w/ bos drop), d=64 its head
+# dim; n=512 is the quick canary that compiles fastest.
+CASES = [
+    ("causal_fp32_512", 512, 64, "float32", False),
+    ("causal_bf16_512", 512, 64, "bfloat16", False),
+    ("causal_bf16_1280", 1280, 64, "bfloat16", False),
+    ("sparse_bf16_1280", 1280, 64, "bfloat16", True),
+    ("causal_bf16_4096", 4096, 64, "bfloat16", False),  # VQGAN-f8 scale
+]
+
+
+def run_case(name: str) -> dict:
+    """Child entry: compile+run fwd and bwd for one case, check numerics."""
+    n, d, dtype_name, sparse = next(
+        (n_, d_, dt, sp) for nm, n_, d_, dt, sp in CASES if nm == name
+    )
+    t_import = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops.flash import block_layout_from_mask, flash_attention
+    from dalle_tpu.ops.masks import block_sparse_mask, causal_mask
+
+    platform = jax.default_backend()
+    import_s = time.perf_counter() - t_import
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    b, h = 1, 2
+    blk = 128
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, h, n, d), dtype)
+    k = jax.random.normal(kk, (b, h, n, d), dtype)
+    v = jax.random.normal(kv, (b, h, n, d), dtype)
+    g = jax.random.normal(kg, (b, h, n, d), jnp.float32)
+
+    layout = None
+    mask = causal_mask(n)
+    if sparse:
+        mask = block_sparse_mask(n, n // 8, block=blk, num_local_blocks=2)
+        layout = block_layout_from_mask(mask, blk, blk)
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, layout=layout, causal=True,
+                               block_q=blk, block_k=blk)
+
+    def loss(q, k, v):
+        return jnp.sum(fwd(q, k, v).astype(jnp.float32) * g)
+
+    # fwd compile (the Mosaic moment of truth)
+    t0 = time.perf_counter()
+    o = jax.jit(fwd)(q, k, v)
+    jax.block_until_ready(o)
+    fwd_compile_s = time.perf_counter() - t0
+
+    # bwd compile (two more pallas_calls: dq, dkv)
+    grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    t0 = time.perf_counter()
+    grads = grad_fn(q, k, v)
+    jax.block_until_ready(grads)
+    bwd_compile_s = time.perf_counter() - t0
+
+    # steady-state timing (compiled; jit hoisted so only kernel dispatch
+    # is measured, not per-iteration wrapper retracing)
+    fwd_jit = jax.jit(fwd)
+    jax.block_until_ready(fwd_jit(q, k, v))
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        o = fwd_jit(q, k, v)
+    jax.block_until_ready(o)
+    fwd_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    # numerics vs the masked-dense oracle (skip at 4096: the dense [n,n]
+    # score matrix is the thing flash exists to avoid materializing)
+    rec = {
+        "case": name, "n": n, "d": d, "dtype": dtype_name,
+        "sparse": sparse, "platform": platform,
+        "interpret": platform != "tpu",
+        "import_s": round(import_s, 1),
+        "fwd_compile_s": round(fwd_compile_s, 2),
+        "bwd_compile_s": round(bwd_compile_s, 2),
+        "fwd_ms": round(fwd_ms, 3),
+    }
+    if n <= 2048:
+        dm = jnp.asarray(mask)
+        do_ = A.masked_attention(q, k, v, dm)
+        fwd_err = float(jnp.max(jnp.abs(
+            o.astype(jnp.float32) - do_.astype(jnp.float32))))
+
+        def dense_loss(q, k, v):
+            return jnp.sum(A.masked_attention(q, k, v, dm).astype(jnp.float32) * g)
+
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        bwd_err = max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32))))
+            for a, b_ in zip(grads, gd)
+        )
+        atol = 2e-3 if dtype_name == "float32" else 3e-2
+        rec.update(
+            fwd_max_err=round(fwd_err, 6),
+            bwd_max_err=round(bwd_err, 6),
+            numerics_ok=bool(fwd_err < atol and bwd_err < atol * 10),
+        )
+    else:
+        rec["numerics_ok"] = None  # finite-output check only at this scale
+        rec["finite"] = bool(jnp.all(jnp.isfinite(o.astype(jnp.float32))))
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--case", choices=[c[0] for c in CASES])
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--timeout", type=float, default=150.0,
+                    help="per-case subprocess timeout")
+    ap.add_argument("--log", default=DEFAULT_LOG)
+    ap.add_argument("--skip_4096", action="store_true",
+                    help="skip the long-context case (used as quick bench rung)")
+    args = ap.parse_args()
+
+    if args.list:
+        for c in CASES:
+            print(c[0])
+        return
+    if args.case:
+        print(json.dumps(run_case(args.case)))
+        return
+
+    os.makedirs(os.path.dirname(args.log), exist_ok=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    results, any_started = [], False
+    for name, n, *_ in CASES:
+        if args.skip_4096 and n >= 4096:
+            continue
+        t0 = time.time()
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--case", name],
+                capture_output=True, text=True, timeout=args.timeout, env=env,
+            )
+            if p.returncode == 0:
+                rec = json.loads(p.stdout.strip().splitlines()[-1])
+            else:
+                rec = {"case": name, "error": f"rc={p.returncode}: "
+                       + p.stderr.strip()[-800:]}
+        except subprocess.TimeoutExpired:
+            rec = {"case": name,
+                   "error": f"timed out after {args.timeout}s (Mosaic hang?)"}
+        except (ValueError, IndexError):
+            rec = {"case": name, "error": "no JSON from child"}
+        rec["t"] = round(time.time(), 1)
+        rec["case_s"] = round(time.time() - t0, 1)
+        # persist THIS case before starting the next (wedge-survivable)
+        with open(args.log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        results.append(rec)
+        # "started" = the case got as far as running jax (clean result OR
+        # a timeout after real compile work) — exit 3 is reserved for
+        # nothing-even-started (import hang), so bench keeps rc=2 evidence
+        any_started = any_started or ("error" not in rec
+                                      or "timed out" in rec.get("error", ""))
+        print(f"  {name}: "
+              + (f"ok fwd={rec.get('fwd_compile_s')}s bwd={rec.get('bwd_compile_s')}s"
+                 if "error" not in rec else rec["error"][:120]),
+              file=sys.stderr, flush=True)
+
+    n_ok = sum("error" not in r for r in results)
+    summary = {
+        "probe": "flash_kernel",
+        "cases_ok": n_ok,
+        "cases_total": len(results),
+        "platform": next((r.get("platform") for r in results
+                          if "platform" in r), None),
+        "on_tpu": any(r.get("platform") == "tpu" for r in results),
+        "results": results,
+    }
+    print(json.dumps(summary))
+    sys.exit(0 if n_ok == len(results) else (2 if any_started else 3))
+
+
+if __name__ == "__main__":
+    main()

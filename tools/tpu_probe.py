@@ -19,12 +19,35 @@ rounds 1-3), so the prober itself can never wedge.  Exit code (one-shot):
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402  (the hardened preflight lives there)
+
+
+def _busy_is_stale(path: str) -> bool:
+    """True when the busy-file's recorded ``pid=N`` is no longer alive
+    (bench.py writes one; bench died without its atexit cleanup)."""
+    try:
+        with open(path) as f:
+            content = f.read()
+        pid = int(content.split("pid=")[1].split()[0])
+    except (OSError, IndexError, ValueError):
+        # unparseable/foreign busy-file: fall back to age (>2h = stale)
+        try:
+            return time.time() - os.path.getmtime(path) > 7200
+        except OSError:
+            return False
+    try:
+        os.kill(pid, 0)
+        return False
+    except ProcessLookupError:
+        return True
+    except PermissionError:
+        return False
 
 
 def probe(timeout_s: float):
@@ -50,6 +73,16 @@ def main():
                     help="watch mode skips probing while this file exists "
                          "(the tunnel admits one client; probing during a "
                          "bench run could collide with it)")
+    ap.add_argument("--on_up", type=str, default=None,
+                    help="watch mode: shell command to run (synchronously, "
+                         "holding the tunnel) the moment a probe sees the "
+                         "chip up — wires availability windows straight "
+                         "into the bench escalation ladder")
+    ap.add_argument("--max_triggers", type=int, default=3,
+                    help="stop firing --on_up after this many attempts")
+    ap.add_argument("--trigger_log_dir", type=str, default=None,
+                    help="directory for --on_up stdout/stderr capture "
+                         "(default: dirname of --log, else /tmp)")
     args = ap.parse_args()
 
     def emit(rec):
@@ -64,11 +97,46 @@ def main():
         emit(rec)
         sys.exit(0 if rec["state"] == "up" else 3)
 
+    trigger_dir = args.trigger_log_dir or (
+        os.path.dirname(os.path.abspath(args.log)) if args.log else "/tmp"
+    )
+    triggers = 0
     while True:
         if os.path.exists(args.busy_file):
-            emit({"t": time.time(), "state": "skipped_busy"})
+            if _busy_is_stale(args.busy_file):
+                # a SIGKILLed bench never reaches its atexit cleanup; a
+                # busy-file whose recorded pid is dead must not disable
+                # the watcher forever
+                try:
+                    os.remove(args.busy_file)
+                except OSError:
+                    pass
+                emit({"t": time.time(), "state": "stale_busy_removed"})
+            else:
+                emit({"t": time.time(), "state": "skipped_busy"})
         else:
-            emit(probe(args.timeout))
+            rec = probe(args.timeout)
+            emit(rec)
+            if rec["state"] == "up" and args.on_up and triggers < args.max_triggers:
+                # fire the ladder NOW — availability windows are rare and
+                # short (see ROUND3_NOTES.md); the command runs to
+                # completion before the next probe (one tunnel client)
+                triggers += 1
+                tlog = os.path.join(trigger_dir, f"watch_trigger_{triggers}.log")
+                emit({"t": time.time(), "state": "trigger_start",
+                      "n": triggers, "cmd": args.on_up, "log": tlog})
+                t0 = time.time()
+                with open(tlog, "w") as tf:
+                    rc = subprocess.call(
+                        args.on_up, shell=True, stdout=tf, stderr=tf
+                    )
+                emit({"t": time.time(), "state": "trigger_done",
+                      "n": triggers, "rc": rc,
+                      "s": round(time.time() - t0, 1)})
+                if rc == 0:
+                    # a headline exists — stop burning windows; keep
+                    # logging availability for the round notes
+                    args.on_up = None
         time.sleep(args.interval)
 
 
