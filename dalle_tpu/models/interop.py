@@ -1,0 +1,395 @@
+"""Reference-format ``.pt`` checkpoint interop.
+
+The reference trainer saves ``{'hparams', 'vae_params', 'epoch',
+'weights' (state_dict), ...}`` pickles (reference: train_dalle.py:514-557)
+and the VAE trainer ``{'hparams', 'weights'}`` (train_vae.py:196-216);
+its generate CLI rebuilds models from them (generate.py:81-95).  This
+module loads those artifacts into our Flax models, so a user migrating
+from the reference can bring their trained checkpoints along — an
+interop path the reference cannot offer in reverse.
+
+torch (CPU) is needed only at load time, to unpickle; conversion is
+plain numpy transposes:
+
+  * Linear ``[out, in]`` → ``[in, out]``  (fused qkv / GEGLU orderings
+    match by construction — pinned differentially in
+    tests/test_golden_dalle.py, which maps through THIS module);
+  * Conv2d OIHW → HWIO; ConvTranspose2d IOHW → HWIO + spatial flip;
+  * axial image_pos_emb ``[f,1,d]``/``[1,f,d]`` tables → our rows/cols.
+
+Structural recovery beyond the saved hparams: the reference does NOT
+record ``sandwich_norm`` in its checkpoint hparams (its own reload
+breaks on such checkpoints); we detect the ``norm_out`` keys in the
+state dict and recover the flag.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "load_reference_pt",
+    "dalle_config_from_ref",
+    "vae_config_from_ref",
+    "convert_ref_dalle_state",
+    "convert_ref_vae_state",
+]
+
+
+# --------------------------------------------------------------------------
+# configs from saved hparams
+# --------------------------------------------------------------------------
+
+# what the reference records for the DALLE (train_dalle.py:291-306); all of
+# these have a direct field on our DALLEConfig
+_DALLE_HPARAM_KEYS = {
+    "num_text_tokens", "text_seq_len", "dim", "depth", "heads", "dim_head",
+    "reversible", "loss_img_weight", "attn_types", "ff_dropout",
+    "attn_dropout", "stable", "shift_tokens", "rotary_emb",
+}
+# and for the DiscreteVAE (train_vae.py:126-133)
+_VAE_HPARAM_KEYS = {
+    "image_size", "num_layers", "num_tokens", "codebook_dim", "hidden_dim",
+    "num_resnet_blocks",
+}
+
+
+def vae_config_from_ref(vae_params: Dict[str, Any]):
+    """Reference ``vae_params`` dict → DiscreteVAEConfig.
+
+    The reference's DiscreteVAE defaults ``normalization`` to 0.5/0.5
+    channel stats (dalle_pytorch.py:88) and does not save it — restore
+    that default here, or decoded images come out wrong."""
+    from .vae import DiscreteVAEConfig
+
+    unknown = set(vae_params) - _VAE_HPARAM_KEYS
+    if unknown:
+        warnings.warn(f"ignoring unknown reference vae hparams: {sorted(unknown)}")
+    kw = {k: v for k, v in vae_params.items() if k in _VAE_HPARAM_KEYS}
+    return DiscreteVAEConfig(normalization=((0.5,) * 3, (0.5,) * 3), **kw)
+
+
+def dalle_config_from_ref(
+    hparams: Dict[str, Any],
+    *,
+    num_image_tokens: int,
+    image_fmap_size: int,
+    sandwich_norm: bool = False,
+):
+    """Reference ``dalle_params`` dict → DALLEConfig.  The reference derives
+    codebook size / fmap from the attached VAE (dalle_pytorch.py:336-342);
+    callers pass them from the VAE they resolved."""
+    from .dalle import DALLEConfig
+
+    hp = dict(hparams)
+    hp.pop("vae", None)  # reference generate.py:84 does the same cleanup
+    unknown = set(hp) - _DALLE_HPARAM_KEYS
+    if unknown:
+        warnings.warn(f"ignoring unknown reference dalle hparams: {sorted(unknown)}")
+    kw = {k: v for k, v in hp.items() if k in _DALLE_HPARAM_KEYS}
+    if kw.get("attn_types"):
+        kw["attn_types"] = tuple(kw["attn_types"])
+    kw["loss_img_weight"] = float(kw.get("loss_img_weight", 7))
+    if kw.get("rotary_emb"):
+        warnings.warn(
+            "reference checkpoint uses rotary_emb: our rotary frequency "
+            "allocation deviates from rotary-embedding-torch (see "
+            "ops/rotary.py docstring) — converted outputs will differ"
+        )
+    return DALLEConfig(
+        num_image_tokens=num_image_tokens,
+        image_fmap_size=image_fmap_size,
+        sandwich_norm=sandwich_norm,
+        **kw,
+    )
+
+
+# --------------------------------------------------------------------------
+# state-dict conversion: DiscreteVAE
+# --------------------------------------------------------------------------
+
+
+def _conv(w):  # torch Conv2d OIHW → flax HWIO
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0)))
+
+
+def _convT(w):  # torch ConvTranspose2d IOHW → flax HWIO, spatially flipped
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 0, 1))[::-1, ::-1])
+
+
+def _res_block(sd, prefix):
+    # reference ResBlock: net = conv3, relu, conv3, relu, conv1
+    # (dalle_pytorch.py:60-72) → our ResBlock Conv_0..2 (models/vae.py)
+    return {
+        f"Conv_{j}": {
+            "kernel": _conv(sd[f"{prefix}.net.{2 * j}.weight"]),
+            "bias": sd[f"{prefix}.net.{2 * j}.bias"],
+        }
+        for j in range(3)
+    }
+
+
+def convert_ref_vae_state(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """Reference DiscreteVAE state_dict → our flax param tree, for any
+    (num_layers, num_resnet_blocks).  Sequential index layout per the
+    reference constructor (dalle_pytorch.py:100-133): encoder =
+    [conv+relu]*L, [ResBlock]*R, conv1x1; decoder = ([conv1x1,
+    [ResBlock]*R] if R else []), [convT+relu]*L, conv1x1."""
+    L, R = cfg.num_layers, cfg.num_resnet_blocks
+    enc: Dict[str, Any] = {}
+    for i in range(L):
+        enc[f"Conv_{i}"] = {
+            "kernel": _conv(sd[f"encoder.{i}.0.weight"]),
+            "bias": sd[f"encoder.{i}.0.bias"],
+        }
+    for r in range(R):
+        enc[f"ResBlock_{r}"] = _res_block(sd, f"encoder.{L + r}")
+    enc[f"Conv_{L}"] = {
+        "kernel": _conv(sd[f"encoder.{L + R}.weight"]),
+        "bias": sd[f"encoder.{L + R}.bias"],
+    }
+
+    dec: Dict[str, Any] = {}
+    off = 0
+    if R > 0:
+        dec["Conv_0"] = {
+            "kernel": _conv(sd["decoder.0.weight"]),
+            "bias": sd["decoder.0.bias"],
+        }
+        for r in range(R):
+            dec[f"ResBlock_{r}"] = _res_block(sd, f"decoder.{1 + r}")
+        off = 1 + R
+    for i in range(L):
+        dec[f"ConvTranspose_{i}"] = {
+            "kernel": _convT(sd[f"decoder.{off + i}.0.weight"]),
+            "bias": sd[f"decoder.{off + i}.0.bias"],
+        }
+    dec[f"Conv_{1 if R > 0 else 0}"] = {
+        "kernel": _conv(sd[f"decoder.{off + L}.weight"]),
+        "bias": sd[f"decoder.{off + L}.bias"],
+    }
+    return {
+        "codebook": {"embedding": np.asarray(sd["codebook.weight"])},
+        "encoder": enc,
+        "decoder": dec,
+    }
+
+
+# --------------------------------------------------------------------------
+# state-dict conversion: DALLE transformer stack
+# --------------------------------------------------------------------------
+
+
+def _map_transformer_layers(sd, prefix, depth, reversible=False):
+    """Reference Transformer layer params → our ``layer_{i}_{attn,ff}``
+    dict.  Handles both execution engines' layouts: SequentialSequence
+    (``layers.layers.{i}.{0,1}``) and ReversibleSequence
+    (``layers.blocks.{i}.{f,g}.net`` — reference reversible.py:143-157),
+    the optional PreShiftToken wrapper nesting, and the optional sandwich
+    ``norm_out``.  Every reference attention variant (full / sparse /
+    axial_row / axial_col / conv_like, attention.py) shares the
+    ``to_qkv`` / ``to_out.0`` naming, so one mapping serves all
+    attn_types."""
+
+    def get(*names):
+        # first present key wins — shift_tokens adds a PreShiftToken
+        # wrapper level (.fn.fn.fn...) that is absent without it
+        for n in names:
+            if n in sd:
+                return sd[n]
+        raise KeyError(names)
+
+    def maybe_norm_out(branch, d):
+        if f"{branch}.fn.norm_out.weight" in sd:
+            d["norm_out"] = {
+                "scale": sd[f"{branch}.fn.norm_out.weight"],
+                "bias": sd[f"{branch}.fn.norm_out.bias"],
+            }
+        return d
+
+    tr = {}
+    for i in range(depth):
+        if reversible:
+            a = f"{prefix}.layers.blocks.{i}.f.net"
+            g = f"{prefix}.layers.blocks.{i}.g.net"
+        else:
+            a = f"{prefix}.layers.layers.{i}.0"
+            g = f"{prefix}.layers.layers.{i}.1"
+        tr[f"layer_{i}_attn"] = maybe_norm_out(a, {
+            "layerscale": np.asarray(sd[f"{a}.scale"]).reshape(-1),
+            "norm": {
+                "scale": sd[f"{a}.fn.norm.weight"],
+                "bias": sd[f"{a}.fn.norm.bias"],
+            },
+            "fn": {
+                "qkv": {"kernel": np.asarray(get(
+                    f"{a}.fn.fn.fn.to_qkv.weight", f"{a}.fn.fn.to_qkv.weight"
+                )).T},
+                "out": {
+                    "kernel": np.asarray(get(
+                        f"{a}.fn.fn.fn.to_out.0.weight",
+                        f"{a}.fn.fn.to_out.0.weight",
+                    )).T,
+                    "bias": get(
+                        f"{a}.fn.fn.fn.to_out.0.bias",
+                        f"{a}.fn.fn.to_out.0.bias",
+                    ),
+                },
+            },
+        })
+        tr[f"layer_{i}_ff"] = maybe_norm_out(g, {
+            "layerscale": np.asarray(sd[f"{g}.scale"]).reshape(-1),
+            "norm": {
+                "scale": sd[f"{g}.fn.norm.weight"],
+                "bias": sd[f"{g}.fn.norm.bias"],
+            },
+            "fn": {
+                "wi": {
+                    "kernel": np.asarray(get(
+                        f"{g}.fn.fn.fn.net.0.weight", f"{g}.fn.fn.net.0.weight"
+                    )).T,
+                    "bias": get(
+                        f"{g}.fn.fn.fn.net.0.bias", f"{g}.fn.fn.net.0.bias"
+                    ),
+                },
+                "wo": {
+                    "kernel": np.asarray(get(
+                        f"{g}.fn.fn.fn.net.3.weight", f"{g}.fn.fn.net.3.weight"
+                    )).T,
+                    "bias": get(
+                        f"{g}.fn.fn.fn.net.3.bias", f"{g}.fn.fn.net.3.bias"
+                    ),
+                },
+            },
+        })
+    return tr
+
+
+def convert_ref_dalle_state(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """Reference DALLE state_dict (``vae.*`` keys already stripped) → our
+    flax param tree.  Param surface per dalle_pytorch.py:309-591."""
+    f = cfg.image_fmap_size
+    P: Dict[str, Any] = {
+        "text_emb": {"embedding": np.asarray(sd["text_emb.weight"])},
+        "image_emb": {"embedding": np.asarray(sd["image_emb.weight"])},
+        "final_norm": {
+            "scale": sd["to_logits.0.weight"],
+            "bias": sd["to_logits.0.bias"],
+        },
+        "to_logits": {
+            "kernel": np.asarray(sd["to_logits.1.weight"]).T,
+            "bias": sd["to_logits.1.bias"],
+        },
+    }
+    if not cfg.rotary_emb:
+        P["text_pos_emb"] = {"embedding": np.asarray(sd["text_pos_emb.weight"])}
+        P["image_pos_emb"] = {
+            "rows": np.asarray(sd["image_pos_emb.weights.0"]).reshape(f, -1),
+            "cols": np.asarray(sd["image_pos_emb.weights.1"]).reshape(f, -1),
+        }
+    P["transformer"] = _map_transformer_layers(
+        sd, "transformer", cfg.depth, reversible=cfg.reversible
+    )
+    return P
+
+
+# --------------------------------------------------------------------------
+# top-level loader
+# --------------------------------------------------------------------------
+
+
+def _torch_state_to_numpy(weights) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in weights.items():
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def load_reference_pt(
+    path: str,
+    *,
+    expect: Optional[str] = None,
+    fmap_hint: Optional[int] = None,
+):
+    """Load a reference-format ``.pt`` (DALLE or DiscreteVAE trainer
+    output).  Returns a dict:
+
+      kind='dalle': {kind, config, params, epoch, vae_config?, vae_params?}
+        (vae_config/params present when the checkpoint embeds a trained
+        DiscreteVAE; an OpenAI-dVAE / taming-trained checkpoint stores
+        ``vae_params=None`` — the caller resolves the VAE exactly like the
+        reference's generate.py:85-91 does, via --taming or the OpenAI
+        default)
+      kind='vae':   {kind, config, params}
+
+    ``expect``: 'dalle' | 'vae' asserts the artifact kind.
+    ``fmap_hint``: image_fmap_size for checkpoints where it cannot be
+    derived (no embedded VAE AND rotary_emb, i.e. no axial pos-emb
+    table) — the caller knows it from the VAE it resolved."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    assert isinstance(obj, dict) and "weights" in obj, (
+        f"{path}: not a reference checkpoint (no 'weights'); DeepSpeed "
+        "partitioned checkpoints must be consolidated first (the reference "
+        "has the same restriction, train_dalle.py:264-271)"
+    )
+    if isinstance(obj["weights"], str):
+        raise ValueError(
+            f"{path}: DeepSpeed aux checkpoint — {obj['weights']!r}"
+        )
+    sd = _torch_state_to_numpy(obj["weights"])
+    kind = "dalle" if "vae_params" in obj or any(
+        k.startswith("transformer.") for k in sd
+    ) else "vae"
+    if expect is not None:
+        assert kind == expect, f"{path}: {kind} checkpoint, expected {expect}"
+
+    if kind == "vae":
+        cfg = vae_config_from_ref(obj["hparams"])
+        return {
+            "kind": "vae",
+            "config": cfg,
+            "params": convert_ref_vae_state(sd, cfg),
+        }
+
+    vae_sd = {k[len("vae."):]: v for k, v in sd.items() if k.startswith("vae.")}
+    dalle_sd = {k: v for k, v in sd.items() if not k.startswith("vae.")}
+    out: Dict[str, Any] = {"kind": "dalle", "epoch": obj.get("epoch", 0)}
+    if obj.get("vae_params") is not None:
+        vcfg = vae_config_from_ref(obj["vae_params"])
+        out["vae_config"] = vcfg
+        out["vae_params"] = convert_ref_vae_state(vae_sd, vcfg)
+        num_image_tokens, fmap = vcfg.num_tokens, vcfg.fmap_size
+    else:
+        out["vae_config"] = out["vae_params"] = None
+        # reference generate.py:85-91: vae_params=None means the model was
+        # trained against OpenAI dVAE or taming; infer the geometry from
+        # the axial pos-emb table — absent only for rotary_emb models,
+        # where the caller must supply it from the VAE it resolved
+        num_image_tokens = int(sd["image_emb.weight"].shape[0])
+        if "image_pos_emb.weights.0" in sd:
+            fmap = int(sd["image_pos_emb.weights.0"].shape[0])
+        elif fmap_hint is not None:
+            fmap = int(fmap_hint)
+        else:
+            raise ValueError(
+                f"{path}: cannot infer image_fmap_size (no embedded VAE "
+                "and no axial pos-emb table — rotary-trained): pass "
+                "fmap_hint / resolve the VAE first"
+            )
+    sandwich = any(".norm_out.weight" in k for k in dalle_sd)
+    cfg = dalle_config_from_ref(
+        obj["hparams"],
+        num_image_tokens=num_image_tokens,
+        image_fmap_size=fmap,
+        sandwich_norm=sandwich,
+    )
+    out["config"] = cfg
+    out["params"] = convert_ref_dalle_state(dalle_sd, cfg)
+    return out

@@ -124,6 +124,11 @@ def find_latest_checkpoint(parent, prefix: str):
         return None
     best, best_key = None, None
     for d in parent.glob(f"{prefix}-*"):
+        # a crash between meta.json write and the atomic rename leaves a
+        # complete-looking {prefix}-stepN.tmp dir; resuming from it races
+        # with the next save of the same tag, which rmtree-deletes it
+        if d.name.endswith(".tmp"):
+            continue
         if not (d.is_dir() and (d / "meta.json").exists()):
             continue
         try:

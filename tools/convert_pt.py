@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Convert a reference-format torch ``.pt`` checkpoint into a native
+dalle_tpu checkpoint directory.
+
+    python tools/convert_pt.py dalle.pt out/dalle-converted
+    python tools/convert_pt.py vae.pt out/vae-converted
+
+The ``.pt`` layouts are the reference trainers' save formats
+(reference: train_dalle.py:514-557, train_vae.py:196-216); conversion
+rules live in dalle_tpu/models/interop.py.  The output directory is a
+standard self-describing checkpoint: ``generate.py --dalle_path OUT``
+and ``train_dalle.py --dalle_path OUT`` (resume) / ``--vae_path OUT``
+work on it directly.  (generate.py also accepts the ``.pt`` itself; this
+tool exists for the training-resume path and for one-time conversion.)
+"""
+
+import argparse
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("pt_path", help="reference-format .pt checkpoint")
+    ap.add_argument("out_path", help="output checkpoint directory")
+    args = ap.parse_args(argv)
+
+    import dalle_tpu
+
+    dalle_tpu.force_cpu_if_virtual()
+
+    import jax.numpy as jnp
+    import jax
+
+    from dalle_tpu.models.interop import load_reference_pt
+    from dalle_tpu.training.checkpoint import save_checkpoint
+
+    loaded = load_reference_pt(args.pt_path)
+    to_jnp = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
+    if loaded["kind"] == "vae":
+        # VAE-only checkpoints store their tree under "params" so
+        # train_dalle.py --vae_path consumes them unchanged
+        path = save_checkpoint(
+            args.out_path,
+            params=to_jnp(loaded["params"]),
+            hparams=loaded["config"].to_dict(),
+        )
+        print(f"converted reference VAE .pt -> {path}")
+        return
+
+    vae_hp = vae_tree = None
+    if loaded["vae_params"] is not None:
+        vae_hp = {"type": "discrete", **loaded["vae_config"].to_dict()}
+        vae_tree = to_jnp(loaded["vae_params"])
+    path = save_checkpoint(
+        args.out_path,
+        params=to_jnp(loaded["params"]),
+        hparams=loaded["config"].to_dict(),
+        vae_params=vae_tree,
+        vae_hparams=vae_hp,
+        epoch=loaded["epoch"],
+    )
+    note = "" if vae_hp else " (no embedded VAE: pair with --taming or the OpenAI default at load time)"
+    print(f"converted reference DALLE .pt -> {path}{note}")
+
+
+if __name__ == "__main__":
+    main()

@@ -189,6 +189,17 @@ def resolve_vae(args, resume_meta, mesh):
         target = shape_dtype_of(params_eval_shape(vae, cfg), sharding=repl)
         return vae, load_subtree(args.dalle_path, "vae_params", target), cfg
     if args.vae_path:
+        if args.vae_path.endswith(".pt"):
+            # reference train_vae.py-format torch checkpoint (reference:
+            # train_dalle.py:264-278) — converted via models/interop.py
+            from dalle_tpu.models.interop import load_reference_pt
+
+            loaded = load_reference_pt(args.vae_path, expect="vae")
+            cfg = loaded["config"]
+            params = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, loaded["params"]), repl
+            )
+            return DiscreteVAE(cfg), params, cfg
         assert is_checkpoint(args.vae_path), f"{args.vae_path} is not a checkpoint"
         cfg = DiscreteVAEConfig.from_dict(load_meta(args.vae_path)["hparams"])
         vae = DiscreteVAE(cfg)
