@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_tpu.ops import attention as attn_ops
+from dalle_tpu.ops import flash as flash_ops
 from dalle_tpu.ops import masks as mask_lib
 from dalle_tpu.ops.rotary import apply_rotary, dalle_rotary_angles
 
@@ -393,20 +394,84 @@ class JointAttention(nn.Module):
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
         t, f = c.text_seq_len, c.fmap_size
         if not c.causal:
-            pad = key_pad_mask[:, None, None, :] if key_pad_mask is not None else None
-            out = attn_ops._sdpa(q, k, v, pad)
-        elif self.attn_type == "axial_row":
-            out = attn_ops.axial_attention(q, k, v, t, f, 0, key_pad_mask)
-        elif self.attn_type == "axial_col":
-            out = attn_ops.axial_attention(q, k, v, t, f, 1, key_pad_mask)
-        elif self.attn_type == "conv_like":
-            out = attn_ops.conv_like_attention(
-                q, k, v, t, f, c.kernel_size, c.dilation, key_pad_mask
+            # bidirectional (CLIP encoders): flash handles the ragged
+            # key-pad mask in-kernel, so the masked text path stays fast
+            use_flash = (
+                c.use_flash
+                if c.use_flash is not None
+                else jax.default_backend() == "tpu"
             )
+            if use_flash and q.shape[-2] == k.shape[-2]:
+                out = flash_ops.flash_attention(
+                    q, k, v, causal=False, key_pad_mask=key_pad_mask
+                )
+            else:
+                pad = key_pad_mask[:, None, None, :] if key_pad_mask is not None else None
+                out = attn_ops._sdpa(q, k, v, pad)
+        elif self.attn_type in ("axial_row", "axial_col"):
+            axis = 0 if self.attn_type == "axial_row" else 1
+            if self._sp_mesh(f) is not None:
+                from dalle_tpu.parallel.structured_sp import axial_attention_sp
+
+                out = axial_attention_sp(
+                    q, k, v, t, f, axis, key_pad_mask, sp_axis=c.sp_axis
+                )
+            else:
+                out = attn_ops.axial_attention(q, k, v, t, f, axis, key_pad_mask)
+        elif self.attn_type == "conv_like":
+            mesh = self._sp_mesh(f)
+            halo = (c.kernel_size - 1) // 2 * c.dilation
+            if mesh is not None and halo > f // mesh.shape[c.sp_axis]:
+                import warnings
+
+                warnings.warn(
+                    f"conv_like halo {halo} exceeds the {f // mesh.shape[c.sp_axis]}"
+                    f"-row local shard (sp={mesh.shape[c.sp_axis]}) — this "
+                    "layer runs DENSE",
+                    stacklevel=2,
+                )
+                mesh = None
+            if mesh is not None:
+                from dalle_tpu.parallel.structured_sp import (
+                    conv_like_attention_sp,
+                )
+
+                out = conv_like_attention_sp(
+                    q, k, v, t, f, c.kernel_size, c.dilation, key_pad_mask,
+                    sp_axis=c.sp_axis,
+                )
+            else:
+                out = attn_ops.conv_like_attention(
+                    q, k, v, t, f, c.kernel_size, c.dilation, key_pad_mask
+                )
         elif self.attn_type in ("sparse", "full"):
             out = self._full_or_sparse(q, k, v, key_pad_mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
         return self.drop(self.to_out(out), deterministic=deterministic)
+
+    def _sp_mesh(self, f):
+        """The ambient mesh when this layer can run its structured attend
+        sequence-parallel (sp requested, mesh present, grid divisible);
+        None → dense fallback (with a loud warning, not silently)."""
+        c = self.cfg
+        if c.sp_axis is None:
+            return None
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        mesh = get_ambient_mesh()
+        if mesh is None or c.sp_axis not in mesh.shape:
+            return None
+        if f % mesh.shape[c.sp_axis] == 0:
+            return mesh
+        import warnings
+
+        warnings.warn(
+            f"sp_axis={c.sp_axis!r} requested but fmap_size {f} does not "
+            f"divide by sp={mesh.shape[c.sp_axis]} — this "
+            f"{self.attn_type!r} layer runs DENSE",
+            stacklevel=3,
+        )
+        return None
 
     def _full_or_sparse(self, q, k, v, key_pad_mask):
         """Pallas flash path when eligible; dense-masked XLA fallback."""
@@ -416,26 +481,28 @@ class JointAttention(nn.Module):
 
         c = self.cfg
         if c.sp_axis is not None:
-            if self.attn_type == "full" and key_pad_mask is None:
+            # both SP schemes thread the pad mask through (ring slices it
+            # per rotating chunk; ulysses hands it to the flash kernel)
+            if self.attn_type == "full":
                 if c.sp_mode == "ulysses":
                     from dalle_tpu.parallel.ulysses import (
                         ulysses_attention_sharded,
                     )
 
                     return ulysses_attention_sharded(
-                        q, k, v, sp_axis=c.sp_axis, causal=True
+                        q, k, v, key_pad_mask, sp_axis=c.sp_axis, causal=True
                     )
                 from dalle_tpu.parallel.ring import ring_attention_sharded
 
-                return ring_attention_sharded(q, k, v, sp_axis=c.sp_axis, causal=True)
+                return ring_attention_sharded(
+                    q, k, v, key_pad_mask, sp_axis=c.sp_axis, causal=True
+                )
             import warnings
 
             warnings.warn(
                 f"sequence parallelism requested (sp_axis={c.sp_axis!r}) but "
-                f"this layer runs DENSE: attn_type={self.attn_type!r}"
-                + (", key_pad_mask given" if key_pad_mask is not None else "")
-                + " — the ring path covers only full attention without a pad "
-                "mask.",
+                f"this 'sparse' layer runs DENSE (axial/conv layers have "
+                "their own sequence-sharded path)",
                 stacklevel=2,
             )
         use_flash = (
@@ -443,13 +510,18 @@ class JointAttention(nn.Module):
             if c.use_flash is not None
             else _jax.default_backend() == "tpu"
         )
-        if use_flash and key_pad_mask is None:
+        if use_flash:
+            # the kernel applies an optional key-pad mask in-block, so a
+            # ragged batch no longer forces the dense fallback
             if self.attn_type == "full":
-                return flash_attention(q, k, v)
+                return flash_attention(q, k, v, key_pad_mask=key_pad_mask)
             plan = flash_plan(_static_mask(c, "sparse"))
             if plan is not None:
                 layout, blk = plan
-                return flash_attention(q, k, v, layout=layout, block_q=blk, block_k=blk)
+                return flash_attention(
+                    q, k, v, layout=layout, block_q=blk, block_k=blk,
+                    key_pad_mask=key_pad_mask,
+                )
         mask = jnp.asarray(_static_mask(c, self.attn_type))
         if self.attn_type == "full":
             return attn_ops.full_causal_attention(q, k, v, key_pad_mask)

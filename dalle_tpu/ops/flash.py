@@ -9,20 +9,29 @@ One kernel family serves two members of the attention zoo:
     same online-softmax kernel with dead blocks predicated off.
 
 Design (SURVEY.md §7 "hard parts" #1):
-  * grid = (batch*heads, num_q_blocks); K/V stream block-by-block inside a
-    ``fori_loop`` with online softmax (m, l, acc) — the [n, n] score matrix
-    never touches HBM;
-  * the block layout rides in SMEM (tiny int32 table), so dead blocks cost
-    one predicated branch, not a DMA;
-  * within-block causality is reconstructed from ``broadcasted_iota`` —
-    the only elementwise mask ever needed (text-global and random blocks are
-    causal-clipped full blocks);
+  * grid = (batch*heads, num_q_blocks, num_k_blocks); K/V blocks STREAM
+    through VMEM via the grid's innermost dimension (the pallas pipeline
+    double-buffers the HBM→VMEM DMAs), so VMEM residency is O(block),
+    not O(n) — long-context (VQGAN-f8 joint sequences, n≥4096) fits;
+  * online softmax state (m, l, acc) lives in VMEM scratch that persists
+    across the innermost grid steps (init at k-block 0, emit output at
+    the last k-block);
+  * the block layout rides in SMEM (tiny int32 table), so dead blocks
+    cost one predicated branch — their FLOPs are skipped (the streamed
+    DMA still runs; acceptable: bandwidth ~n·d per dead block vs the
+    n·d·bk FLOPs saved);
+  * within-block causality is reconstructed from ``broadcasted_iota``;
+  * an optional key-padding mask [b, n] (1=valid, 0=pad) is streamed
+    alongside K and applied to the score block — CLIP's masked text
+    attention stays on the fast path (reference pad-mask surface:
+    dalle_pytorch/attention.py:66-69);
   * backward = two kernels (dkv over key blocks, dq over query blocks)
     recomputing p from the saved logsumexp — standard flash backward,
     wrapped in ``jax.custom_vjp``.
 
 Falls back to interpreter mode off-TPU so the same tests pin it to the
-masked-dense oracle on CPU.
+masked-dense oracle on CPU.  On-TPU Mosaic compile evidence:
+tools/flash_probe.py (bench ladder rung 1).
 """
 
 from __future__ import annotations
@@ -37,6 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LANES = 128  # f32 scratch lane width for the (m, l) running stats
 
 
 def _interpret() -> bool:
@@ -50,11 +60,23 @@ def pick_block(n: int, target: int = 128) -> int:
     return max(b, 1)
 
 
-def _layout_or_causal(layout, nqb, nkb):
+def _layout_or_causal(layout, nqb, nkb, causal):
     if layout is None:
-        layout = np.tril(np.ones((nqb, nkb), dtype=bool))
+        layout = (
+            np.tril(np.ones((nqb, nkb), dtype=bool))
+            if causal
+            else np.ones((nqb, nkb), dtype=bool)
+        )
     assert layout.shape == (nqb, nkb)
     return np.asarray(layout, dtype=np.bool_)
+
+
+def _compiler_params():
+    # batch*heads and q-blocks are independent; the k-block dim carries
+    # the online-softmax recurrence and must run in order
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
 
 
 # --------------------------------------------------------------------------
@@ -62,74 +84,112 @@ def _layout_or_causal(layout, nqb, nkb):
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(lay_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, nkb, bq, bk, scale, causal):
-    qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+def _fwd_kernel(
+    lay_ref, q_ref, k_ref, v_ref, kpm_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, nkb, bq, bk, scale, causal, has_mask,
+):
+    qb, kb = pl.program_id(1), pl.program_id(2)
 
-    def body(kb, carry):
-        m, l, acc = carry
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-        def attend(m, l, acc):
-            k_blk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
-            v_blk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [bq, bk]
-            if causal:
-                qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-                ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-                s = jnp.where(qi >= ki, s, NEG_INF)
-            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            corr = jnp.exp(m - m_new)
-            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
-            acc_new = acc * corr + jax.lax.dot_general(
-                p, v_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return m_new, l_new, acc_new
-
-        return jax.lax.cond(
-            lay_ref[qb, kb] != 0, attend, lambda m, l, a: (m, l, a), m, l, acc
+    @pl.when(lay_ref[qb, kb] != 0)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+        k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qi >= ki, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(kpm_ref[0][None, :] > 0, s, NEG_INF)
+        m_prev = m_scr[...]  # [bq, LANES] (lane-replicated)
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new[:, :1])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr[:, :1] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
+        m_scr[...] = m_new
+        l_scr[...] = l_new
 
-    d = q_ref.shape[-1]
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    a0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, a0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    @pl.when(kb == nkb - 1)
+    def _emit():
+        l = l_scr[...][:, :1]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[...][:, :1] + jnp.log(l_safe))[:, 0]
 
 
-def _flash_fwd(q, k, v, layout, bq, bk, scale, causal):
+def _mask_arg(kernel, kpm, h, bk, index_map=None):
+    """Adapt a kernel that takes ``kpm_ref`` to the no-mask case: the mask
+    operand, its BlockSpec, and its per-grid-step DMA are omitted entirely
+    when no pad mask is given (the common, all-causal-training case).
+    ``index_map`` overrides the mask block index (the dkv kernel's k-block
+    slot is grid dim 1, not 2)."""
+    if kpm is not None:
+        spec = [pl.BlockSpec(
+            (1, bk), index_map or (lambda b, i, j: (b // h, j)),
+            memory_space=pltpu.VMEM,
+        )]
+        return kernel, spec, (kpm,)
+
+    def no_mask_kernel(*refs, **kw):
+        # inputs run [..., kpm_ref-slot, ...]: re-insert None at the slot
+        return kernel(*refs[:_KPM_SLOT], None, *refs[_KPM_SLOT:], **kw)
+
+    return no_mask_kernel, [], ()
+
+
+_KPM_SLOT = 4  # kpm_ref position in the kernels' ref lists (after lay/q/k/v)
+
+
+def _flash_fwd(q, k, v, kpm, layout, bq, bk, scale, causal, h):
     bh, n, d = q.shape
     nqb, nkb = n // bq, n // bk
-    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb), jnp.int32)
+    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb, causal), jnp.int32)
     kernel = functools.partial(
-        _fwd_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal
+        _fwd_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal,
+        has_mask=kpm is not None,
     )
+    kernel, mask_spec, mask_args = _mask_arg(kernel, kpm, h, bk)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nqb),
+        grid=(bh, nqb, nkb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-        ],
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ] + mask_spec,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, n, d), q.dtype),
             jax.ShapeDtypeStruct((bh, n), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(lay, q, k, v)
+    )(lay, q, k, v, *mask_args)
     return out, lse
 
 
@@ -139,146 +199,171 @@ def _flash_fwd(q, k, v, layout, bq, bk, scale, causal):
 
 
 def _bwd_dq_kernel(
-    lay_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, nkb, bq, bk, scale, causal,
+    lay_ref, q_ref, k_ref, v_ref, kpm_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    dq_scr,
+    *, nkb, bq, bk, scale, causal, has_mask,
 ):
-    qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    qb, kb = pl.program_id(1), pl.program_id(2)
 
-    def body(kb, dq):
-        def attend(dq):
-            k_blk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
-            v_blk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            if causal:
-                qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-                ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-                s = jnp.where(qi >= ki, s, NEG_INF)
-            p = jnp.exp(s - lse)
-            dp = jax.lax.dot_general(
-                do, v_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - delta)
-            return dq + jax.lax.dot_general(
-                ds, k_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-        return jax.lax.cond(lay_ref[qb, kb] != 0, attend, lambda x: x, dq)
+    @pl.when(lay_ref[qb, kb] != 0)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qi >= ki, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(kpm_ref[0][None, :] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    d = q_ref.shape[-1]
-    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kb == nkb - 1)
+    def _emit():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
-    lay_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, nqb, bq, bk, scale, causal,
+    lay_ref, q_ref, k_ref, v_ref, kpm_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr,
+    *, nqb, bq, bk, scale, causal, has_mask,
 ):
-    kb = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
-    v_blk = v_ref[0].astype(jnp.float32)
+    kb, qb = pl.program_id(1), pl.program_id(2)
 
-    def body(qb, carry):
-        dk, dv = carry
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-        def attend(dk, dv):
-            q = q_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32) * scale
-            do = do_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32)
-            lse = lse_ref[0, pl.ds(qb * bq, bq)][:, None]
-            delta = delta_ref[0, pl.ds(qb * bq, bq)][:, None]
-            s = jax.lax.dot_general(
-                q, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            if causal:
-                qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-                ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-                s = jnp.where(qi >= ki, s, NEG_INF)
-            p = jnp.exp(s - lse)  # [bq, bk]
-            dv_new = dv + jax.lax.dot_general(
-                p, do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            dp = jax.lax.dot_general(
-                do, v_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - delta)
-            dk_new = dk + jax.lax.dot_general(
-                ds, q, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return dk_new, dv_new
+    @pl.when(lay_ref[qb, kb] != 0)
+    def _attend():
+        k_blk = k_ref[0].astype(jnp.float32)  # [bk, d] (resident)
+        v_blk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d] (streamed)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qi >= ki, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(kpm_ref[0][None, :] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-        return jax.lax.cond(lay_ref[qb, kb] != 0, attend, lambda a, b: (a, b), dk, dv)
-
-    d = k_ref.shape[-1]
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qb == nqb - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, layout, bq, bk, scale, causal):
+def _flash_bwd(q, k, v, o, lse, do, kpm, layout, bq, bk, scale, causal, h):
     bh, n, d = q.shape
     nqb, nkb = n // bq, n // bk
-    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb), jnp.int32)
+    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb, causal), jnp.int32)
+    has_mask = kpm is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh, n]
 
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal,
+        has_mask=has_mask,
+    )
+    dq_kernel, mask_spec, mask_args = _mask_arg(dq_kernel, kpm, h, bk)
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal
-        ),
-        grid=(bh, nqb),
+        dq_kernel,
+        grid=(bh, nqb, nkb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ] + mask_spec + [
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+            (1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(lay, q, k, v, do, lse, delta)
+    )(lay, q, k, v, *mask_args, do, lse, delta)
 
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, nqb=nqb, bq=bq, bk=bk, scale=scale, causal=causal,
+        has_mask=has_mask,
+    )
+    # NB mask block indexes j (the kb slot) which is grid dim 1 here
+    dkv_kernel, mask_spec, mask_args = _mask_arg(
+        dkv_kernel, kpm, h, bk, index_map=lambda b, j, i: (b // h, j)
+    )
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, nqb=nqb, bq=bq, bk=bk, scale=scale, causal=causal
-        ),
-        grid=(bh, nkb),
+        dkv_kernel,
+        grid=(bh, nkb, nqb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, n, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n), lambda b, j: (b, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n), lambda b, j: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+        ] + mask_spec + [
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, n, d), k.dtype),
             jax.ShapeDtypeStruct((bh, n, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(lay, q, k, v, do, lse, delta)
+    )(lay, q, k, v, *mask_args, do, lse, delta)
     return dq, dk, dv
 
 
@@ -288,27 +373,32 @@ def _flash_bwd(q, k, v, o, lse, do, layout, bq, bk, scale, causal):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
 )
-def _flash_core(q, k, v, layout_key, bq, bk, causal):
-    out, _ = _flash_fwd(q, k, v, _LAYOUTS.get(layout_key), bq, bk, q.shape[-1] ** -0.5, causal)
+def _flash_core(q, k, v, kpm, layout_key, bq, bk, causal, h):
+    out, _ = _flash_fwd(
+        q, k, v, kpm, _LAYOUTS.get(layout_key), bq, bk,
+        q.shape[-1] ** -0.5, causal, h,
+    )
     return out
 
 
-def _flash_core_fwd(q, k, v, layout_key, bq, bk, causal):
+def _flash_core_fwd(q, k, v, kpm, layout_key, bq, bk, causal, h):
     out, lse = _flash_fwd(
-        q, k, v, _LAYOUTS.get(layout_key), bq, bk, q.shape[-1] ** -0.5, causal
+        q, k, v, kpm, _LAYOUTS.get(layout_key), bq, bk,
+        q.shape[-1] ** -0.5, causal, h,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, kpm, out, lse)
 
 
-def _flash_core_bwd(layout_key, bq, bk, causal, res, g):
-    q, k, v, out, lse = res
+def _flash_core_bwd(layout_key, bq, bk, causal, h, res, g):
+    q, k, v, kpm, out, lse = res
     dq, dk, dv = _flash_bwd(
-        q, k, v, out, lse, g, _LAYOUTS.get(layout_key), bq, bk,
-        q.shape[-1] ** -0.5, causal,
+        q, k, v, out, lse, g, kpm, _LAYOUTS.get(layout_key), bq, bk,
+        q.shape[-1] ** -0.5, causal, h,
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dkpm = None if kpm is None else jnp.zeros_like(kpm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dkpm
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -334,12 +424,19 @@ def flash_attention(
     causal: bool = True,
     block_q: int = 128,
     block_k: int = 128,
+    key_pad_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """q, k, v: [b, h, n, d] → [b, h, n, d].
 
     ``layout``: optional static numpy bool [n/block_q, n/block_k]; True
     blocks participate (elementwise causality is applied on top).  None =
-    plain causal flash attention.
+    plain causal flash attention (or all-blocks-live when causal=False).
+
+    ``key_pad_mask``: optional [b, n], nonzero where the KEY position is
+    valid (reference pad-mask semantics: attention.py:66-69).  Rows whose
+    every visible key is padded produce a uniform average over the visible
+    keys (matching the dense oracle's max-subtracted softmax up to block
+    coverage) — callers should not rely on such rows.
     """
     b, h, n, d = q.shape
     bq = pick_block(n, block_q)
@@ -349,8 +446,12 @@ def flash_attention(
             f"layout {layout.shape} != {(n // bq, n // bk)}"
         )
     key = _register_layout(layout)
+    kpm = None
+    if key_pad_mask is not None:
+        assert key_pad_mask.shape == (b, n), (key_pad_mask.shape, (b, n))
+        kpm = key_pad_mask.astype(jnp.float32)
     fold = lambda x: x.reshape(b * h, n, d)
-    out = _flash_core(fold(q), fold(k), fold(v), key, bq, bk, causal)
+    out = _flash_core(fold(q), fold(k), fold(v), kpm, key, bq, bk, causal, h)
     return out.reshape(b, h, n, d)
 
 

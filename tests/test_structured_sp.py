@@ -1,0 +1,159 @@
+"""Sequence-parallel structured attention (axial row/col, conv-like) vs
+the dense single-device oracles, on a real multi-device CPU mesh — actual
+all_to_all / ppermute collectives (round-4 VERDICT ask #4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.ops import attention as A
+from dalle_tpu.parallel import make_mesh
+from dalle_tpu.parallel.structured_sp import (
+    axial_attention_sp,
+    conv_like_attention_sp,
+)
+
+B, H, D = 2, 2, 16
+T, F = 8, 8  # text_seq_len, fmap_size (n = 72: divisible by sp=2,4 for ring)
+N = T + F * F
+
+
+def qkv(key):
+    ks = jax.random.split(key, 3)
+    return [jax.random.normal(k, (B, H, N, D)) for k in ks]
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("axis", [0, 1], ids=["row", "col"])
+def test_axial_sp_matches_dense(rng, devices, axis, sp):
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=sp)
+    q, k, v = qkv(rng)
+    want = A.axial_attention(q, k, v, T, F, axis)
+    got = jax.jit(
+        lambda q, k, v: axial_attention_sp(
+            q, k, v, T, F, axis, mesh=mesh
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_axial_sp_pad_mask(rng, devices):
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+    kpm = np.ones((B, N), bool)
+    kpm[0, 3:T] = False  # ragged text
+    kpmj = jnp.asarray(kpm)
+    want = A.axial_attention(q, k, v, T, F, 0, kpmj)
+    got = jax.jit(
+        lambda q, k, v: axial_attention_sp(
+            q, k, v, T, F, 0, kpmj, mesh=mesh
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_axial_sp_gradients(rng, devices):
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(axial_attention_sp(q, k, v, T, F, 1, mesh=mesh) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.axial_attention(q, k, v, T, F, 1) ** 2)
+
+    gs = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("ksize,dil", [(3, 1), (5, 1), (3, 2)])
+def test_conv_sp_matches_dense(rng, devices, ksize, dil, sp):
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=sp)
+    q, k, v = qkv(rng)
+    want = A.conv_like_attention(q, k, v, T, F, ksize, dil)
+    got = jax.jit(
+        lambda q, k, v: conv_like_attention_sp(
+            q, k, v, T, F, ksize, dil, mesh=mesh
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_conv_sp_pad_mask_and_grads(rng, devices):
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+    kpm = np.ones((B, N), bool)
+    kpm[1, 4:T] = False
+    kpmj = jnp.asarray(kpm)
+    want = A.conv_like_attention(q, k, v, T, F, 3, 1, kpmj)
+    got = jax.jit(
+        lambda q, k, v: conv_like_attention_sp(
+            q, k, v, T, F, 3, 1, kpmj, mesh=mesh
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(
+            conv_like_attention_sp(q, k, v, T, F, 3, 1, kpmj, mesh=mesh) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.conv_like_attention(q, k, v, T, F, 3, 1, kpmj) ** 2)
+
+    gs = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_conv_sp_composes_with_dp_tp(rng, devices):
+    mesh = make_mesh(dp=2, fsdp=1, tp=2, sp=2)
+    q, k, v = qkv(rng)
+    want = A.conv_like_attention(q, k, v, T, F, 5, 1)
+    got = jax.jit(
+        lambda q, k, v: conv_like_attention_sp(
+            q, k, v, T, F, 5, 1, mesh=mesh
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_full_flagship_cycle_sequence_parallel(rng, devices):
+    """The whole flagship attention cycle (full, axial_row, axial_col,
+    conv_like) runs under --sp_axis with EVERY layer sequence-parallel —
+    forward parity against the no-SP model with identical weights, and no
+    'runs DENSE' warning fired."""
+    import warnings
+
+    from dalle_tpu.models.transformer import Transformer, TransformerConfig
+    from dalle_tpu.parallel.mesh import ambient
+
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+
+    def cfg(sp_axis):
+        return TransformerConfig(
+            dim=32, depth=4, heads=2, dim_head=16, text_seq_len=T,
+            fmap_size=F, attn_types=("full", "axial_row", "axial_col", "conv_like"),
+            causal=True, kernel_size=3, sp_axis=sp_axis, use_flash=False,
+        )
+
+    x = jax.random.normal(rng, (B, N, 32))
+    m_dense = Transformer(cfg(None))
+    params = m_dense.init({"params": rng}, x)["params"]
+    want = m_dense.apply({"params": params}, x)
+    with ambient(mesh):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any DENSE-fallback warning fails
+            got = jax.jit(
+                lambda x: Transformer(cfg("sp")).apply({"params": params}, x)
+            )(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)

@@ -33,22 +33,24 @@ sys.path.insert(0, REPO)
 
 DEFAULT_LOG = os.path.join(REPO, "bench_logs", "flash_probe.jsonl")
 
-# (name, n, d, dtype, sparse) — flagship shapes: n=1280 is the 12-layer
-# DALL-E joint sequence (256 text + 1024 image w/ bos drop), d=64 its head
-# dim; n=512 is the quick canary that compiles fastest.
+# (name, n, d, dtype, sparse, masked) — flagship shapes: n=1280 is the
+# 12-layer DALL-E joint sequence (256 text + 1024 image w/ bos drop), d=64
+# its head dim; n=512 is the quick canary that compiles fastest; the
+# masked case covers the in-kernel key-pad-mask path (CLIP's ragged text).
 CASES = [
-    ("causal_fp32_512", 512, 64, "float32", False),
-    ("causal_bf16_512", 512, 64, "bfloat16", False),
-    ("causal_bf16_1280", 1280, 64, "bfloat16", False),
-    ("sparse_bf16_1280", 1280, 64, "bfloat16", True),
-    ("causal_bf16_4096", 4096, 64, "bfloat16", False),  # VQGAN-f8 scale
+    ("causal_fp32_512", 512, 64, "float32", False, False),
+    ("causal_bf16_512", 512, 64, "bfloat16", False, False),
+    ("causal_bf16_1280", 1280, 64, "bfloat16", False, False),
+    ("sparse_bf16_1280", 1280, 64, "bfloat16", True, False),
+    ("padmask_bf16_512", 512, 64, "bfloat16", False, True),
+    ("causal_bf16_4096", 4096, 64, "bfloat16", False, False),  # VQGAN-f8 scale
 ]
 
 
 def run_case(name: str) -> dict:
     """Child entry: compile+run fwd and bwd for one case, check numerics."""
-    n, d, dtype_name, sparse = next(
-        (n_, d_, dt, sp) for nm, n_, d_, dt, sp in CASES if nm == name
+    n, d, dtype_name, sparse, masked = next(
+        (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
     )
     t_import = time.perf_counter()
     import jax
@@ -78,10 +80,20 @@ def run_case(name: str) -> dict:
     if sparse:
         mask = block_sparse_mask(n, n // 8, block=blk, num_local_blocks=2)
         layout = block_layout_from_mask(mask, blk, blk)
+    kpm = kpm_np = None
+    if masked:
+        import numpy as np
+
+        kpm_np = np.ones((b, n), bool)
+        kpm_np[0, int(n * 0.6):] = False
+        kpm = jnp.asarray(kpm_np)
+        # grad/fwd comparisons exclude padded QUERY rows (divergent by
+        # design), so the loss weighting must mask them for BOTH paths
+        g = g * kpm[:, None, :, None]
 
     def fwd(q, k, v):
         return flash_attention(q, k, v, layout=layout, causal=True,
-                               block_q=blk, block_k=blk)
+                               block_q=blk, block_k=blk, key_pad_mask=kpm)
 
     def loss(q, k, v):
         return jnp.sum(fwd(q, k, v).astype(jnp.float32) * g)
@@ -123,12 +135,19 @@ def run_case(name: str) -> dict:
     }
     if n <= 2048:
         dm = jnp.asarray(mask)
-        do_ = A.masked_attention(q, k, v, dm)
+        valid = (
+            jnp.asarray(kpm_np)[:, None, :, None] if masked
+            else jnp.ones((), jnp.float32)
+        )
+        do_ = A.masked_attention(q, k, v, dm, key_pad_mask=kpm)
         fwd_err = float(jnp.max(jnp.abs(
-            o.astype(jnp.float32) - do_.astype(jnp.float32))))
+            (o.astype(jnp.float32) - do_.astype(jnp.float32)) * valid)))
 
         def dense_loss(q, k, v):
-            return jnp.sum(A.masked_attention(q, k, v, dm).astype(jnp.float32) * g)
+            return jnp.sum(
+                A.masked_attention(q, k, v, dm, key_pad_mask=kpm)
+                .astype(jnp.float32) * g
+            )
 
         gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
         bwd_err = max(
