@@ -43,10 +43,19 @@ def dalle_rotary_angles(
 ) -> np.ndarray:
     """Angle table ``[seq_len, R]`` where ``2R`` leading head channels rotate.
 
-    Region geometry matches the reference (transformer.py:206-227, pinned
-    for the rest of the stack by tests/test_golden_dalle.py): the text
-    region spans ``text_seq_len + 1`` positions ([bos | text] — reference
-    ``text_len = seq_len - img_seq_len + 1``), image grid cell ``g`` sits
+    Parity scope (advisor round-3): the POSITION GEOMETRY matches the
+    reference (transformer.py:206-227) and is what the differential tests
+    pin; the frequency details deviate deliberately — channel allocation
+    is ``_even(dim_head // 3)`` per band (the reference's
+    rotary-embedding-torch allows odd ``rot_dim``), and the image axial
+    band's pixel-style linspace tops out at ``fmap_size / 2`` cycles
+    rather than the external lib's fixed ``max_freq=10``.  Checkpoints
+    trained with our rotary are self-consistent; converted reference
+    rotary checkpoints will NOT reproduce (models/interop.py warns).
+
+    Geometry: the text region spans ``text_seq_len + 1`` positions
+    ([bos | text] — reference ``text_len = seq_len - img_seq_len + 1``),
+    image grid cell ``g`` sits
     at position ``text_seq_len + 1 + g``, and the virtual final cell is
     cropped (reference ``pos_emb[:-1]``).
     """

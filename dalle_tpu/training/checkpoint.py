@@ -84,7 +84,10 @@ def save_checkpoint(
     _mp_barrier("saved")
     if _is_primary():
         meta = {
-            "format": "dalle_tpu/v1",
+            # v2: ops/masks.py t -> t+1 region-geometry fix (round 3)
+            # changed shift/axial/conv/rotary numerics — v1 checkpoints
+            # load but decode differently (load_meta warns)
+            "format": "dalle_tpu/v2",
             "hparams": hparams,
             "vae_hparams": vae_hparams,
             "epoch": epoch,
@@ -211,7 +214,22 @@ def prune_checkpoints(parent: Path, keep_n: int, pattern: str = "*"):
 
 
 def load_meta(path: str) -> dict:
-    return json.loads((Path(path) / "meta.json").read_text())
+    meta = json.loads((Path(path) / "meta.json").read_text())
+    # the geometry fix only touches the DALLE joint-sequence ops — a v1
+    # VAE/CLIP checkpoint is unaffected, so gate on DALLE-shaped hparams
+    if meta.get("format") == "dalle_tpu/v1" and "text_seq_len" in (
+        meta.get("hparams") or {}
+    ) and "image_fmap_size" in (meta.get("hparams") or {}):
+        import warnings
+
+        warnings.warn(
+            f"{path}: dalle_tpu/v1 checkpoint — trained before the "
+            "text-region geometry fix (ops/masks.py t -> t+1); it loads, "
+            "but shift/axial/conv/rotary models decode differently than "
+            "they trained",
+            stacklevel=2,
+        )
+    return meta
 
 
 def load_checkpoint(

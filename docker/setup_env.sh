@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-command environment build (no container needed) — the same steps the
+# Dockerfile runs, for an existing Python >= 3.10 env on a TPU VM or CPU box.
+#
+#   bash docker/setup_env.sh            # build native libs + install + smoke
+#   TPU_SETUP=1 bash docker/setup_env.sh # also install the jax[tpu] wheel
+#   SKIP_PIP=1 bash docker/setup_env.sh # deps already present (this image)
+#
+# Reference parity: docker/Dockerfile + install_deepspeed.sh there; here the
+# native build step compiles the first-party C++ engines instead of CUDA ops.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native engines (C++: data ingest, BPE tokenizer) =="
+make -C dalle_tpu/data/native
+make -C dalle_tpu/tokenizers/native
+
+if [ -z "${SKIP_PIP:-}" ]; then
+    echo "== python deps =="
+    # TPU wheel only on request — device-node sniffing false-positives on
+    # vfio/other-accelerator hosts and a stray libtpu wedges jax init
+    if [ -n "${TPU_SETUP:-}" ]; then
+        pip install "jax[tpu]>=0.4.30" \
+            -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+    fi
+    pip install -e ".[test]"
+fi
+
+echo "== smoke: virtual 8-device mesh =="
+# jax.config.update (not just the env var) so the smoke stays on CPU even
+# under site hooks that re-export JAX_PLATFORMS to an accelerator plugin
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+import dalle_tpu; print('ok, devices:', jax.device_count())"
+echo "environment ready — run: python -m pytest tests/ -q"

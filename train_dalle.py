@@ -363,6 +363,17 @@ def main(argv=None):
     # invalidated with the donated buffers.
     ema_params = None
     ema_step = None
+    if args.ema_decay == 0.0 and is_root and resume_meta is not None and (
+        "ema_params" in resume_meta.get("subtrees", ())
+    ):
+        # without this, the EMA subtree silently vanishes from the next
+        # save and generate.py falls back to raw params (advisor round-3)
+        print(
+            "WARNING: resumed checkpoint carries ema_params but --ema_decay "
+            "was not passed — EMA tracking stops here and subsequent "
+            "checkpoints will DROP the EMA subtree; repeat --ema_decay to "
+            "keep it"
+        )
     if args.ema_decay > 0.0:
         d = float(args.ema_decay)
         if resume_meta is not None and "ema_params" in resume_meta.get(
