@@ -69,6 +69,7 @@ class DALLEConfig:
     use_flash: Optional[bool] = None  # None = auto (Pallas kernel on TPU)
     sp_axis: Optional[str] = None  # sequence parallelism over this mesh axis
     sp_mode: str = "ring"  # "ring" (ppermute) | "ulysses" (all_to_all)
+    sp_schedule: str = "contiguous"  # ring only: | "zigzag" (balanced)
     pp_stages: int = 1  # GPipe pipeline parallelism over the 'pp' mesh axis
     pp_microbatches: int = 4
     moe_experts: int = 0  # >0: every moe_every-th FF is a routed MoE ('ep' axis)
@@ -125,6 +126,7 @@ class DALLEConfig:
             use_flash=self.use_flash,
             sp_axis=self.sp_axis,
             sp_mode=self.sp_mode,
+            sp_schedule=self.sp_schedule,
             pp_stages=self.pp_stages,
             pp_microbatches=self.pp_microbatches,
             moe_experts=self.moe_experts,

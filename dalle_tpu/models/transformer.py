@@ -89,6 +89,9 @@ class TransformerConfig:
     # = all_to_all head<->sequence re-shard (parallel/ulysses.py; needs
     # local heads % sp == 0).  The reference has neither (SURVEY.md §5.7).
     sp_mode: str = "ring"
+    # ring schedule: "contiguous" (cond-skip) or "zigzag" (load-balanced
+    # chunk layout — per-step wall-clock halves; parallel/ring.py)
+    sp_schedule: str = "contiguous"
     # pipeline parallelism: >1 partitions the depth into contiguous stages
     # executed with a GPipe microbatch schedule over the 'pp' mesh axis
     # (parallel/pipeline.py).  Requires depth % pp_stages == 0 and the
@@ -495,7 +498,8 @@ class JointAttention(nn.Module):
                 from dalle_tpu.parallel.ring import ring_attention_sharded
 
                 return ring_attention_sharded(
-                    q, k, v, key_pad_mask, sp_axis=c.sp_axis, causal=True
+                    q, k, v, key_pad_mask, sp_axis=c.sp_axis, causal=True,
+                    schedule=c.sp_schedule,
                 )
             import warnings
 

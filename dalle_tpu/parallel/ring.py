@@ -37,6 +37,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
@@ -122,6 +123,128 @@ def ring_attention(
     return (out, n_done) if return_stats else out
 
 
+def zigzag_ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    key_pad_mask: Optional[jnp.ndarray] = None,
+    *,
+    axis_name: str,
+    return_stats: bool = False,
+):
+    """Load-BALANCED causal ring attention (zigzag chunk layout).
+
+    The contiguous layout's cond-skip halves total FLOPs but not lockstep
+    wall-clock: at every step some device still computes a full local
+    block.  Zigzag fixes the balance: the sequence is cut into 2P chunks
+    and device i holds chunks (i, 2P-1-i) — its local block is the
+    concatenation [A|B].  Under causality exactly the quadrants
+
+        (qA,kA) iff src <= i   (diagonal at s=0)
+        (qB,kA) always         (qB is late, kA is early)
+        (qB,kB) iff src >= i   (diagonal at s=0)
+        (qA,kB) never          (qA is early, kB is late)
+
+    are live, so EVERY device at EVERY step computes ~2 of 4 c×c
+    quadrants — max-load equals mean-load and wall-clock halves vs the
+    contiguous schedule.  Callers must pass chunks in zigzag order
+    (``zigzag_permutation``); ``ring_attention_sharded(schedule="zigzag")``
+    does the (de)permutation.
+
+    ``return_stats``: also return the number of computed quadrants
+    (asserted balanced in tests/test_ring.py)."""
+    p_size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, nl, d = q.shape
+    assert nl % 2 == 0, "zigzag needs an even local chunk (n % 2P == 0)"
+    c = nl // 2
+    scale = d**-0.5
+    qf = q.astype(jnp.float32) * scale
+    ar = jnp.arange(c)
+    qpos = {"A": idx * c + ar, "B": (2 * p_size - 1 - idx) * c + ar}
+    qh = {"A": qf[:, :, :c], "B": qf[:, :, c:]}
+
+    def quadrant(qk, kpos_half, k_cur, v_cur, state, n_done):
+        """Masked online-softmax update of one c×c quadrant."""
+        (m, l, acc), (qhalf, khalf) = state, qk
+        kpos = kpos_half[khalf]
+        kc = k_cur[:, :, :c] if khalf == "A" else k_cur[:, :, c:]
+        vc = v_cur[:, :, :c] if khalf == "A" else v_cur[:, :, c:]
+        s_blk = jnp.einsum(
+            "bhid,bhjd->bhij", qh[qhalf], kc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        mask = qpos[qhalf][:, None] >= kpos[None, :]
+        s_blk = jnp.where(mask[None, None], s_blk, NEG_INF)
+        if key_pad_mask is not None:
+            kpm_blk = jnp.take(key_pad_mask, kpos, axis=1)  # [b, c] (gather:
+            # zigzag key positions are not contiguous in the global mask)
+            s_blk = jnp.where(kpm_blk[:, None, None, :] > 0, s_blk, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1, keepdims=True))
+        p_blk = jnp.exp(s_blk - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p_blk, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhij,bhjd->bhid", p_blk, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc_new), n_done + 1
+
+    def step(carry, s):
+        k_cur, v_cur, st_a, st_b, n_done = carry
+        src = (idx - s) % p_size
+        kpos_half = {"A": src * c + ar, "B": (2 * p_size - 1 - src) * c + ar}
+
+        # (qA,kA): live iff src <= idx
+        st_a, n_done = jax.lax.cond(
+            src <= idx,
+            lambda st, n: quadrant(("A", "A"), kpos_half, k_cur, v_cur, st, n),
+            lambda st, n: (st, n), st_a, n_done,
+        )
+        # (qB,kA): always live
+        st_b, n_done = quadrant(("B", "A"), kpos_half, k_cur, v_cur, st_b, n_done)
+        # (qB,kB): live iff src >= idx
+        st_b, n_done = jax.lax.cond(
+            src >= idx,
+            lambda st, n: quadrant(("B", "B"), kpos_half, k_cur, v_cur, st, n),
+            lambda st, n: (st, n), st_b, n_done,
+        )
+        # (qA,kB): qA precedes every kB globally — never live, never built
+
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, st_a, st_b, n_done), None
+
+    def init_state():
+        return (
+            jnp.full((b, h, c, 1), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, c, 1), jnp.float32),
+            jnp.zeros((b, h, c, d), jnp.float32),
+        )
+
+    (k, v, st_a, st_b, n_done), _ = jax.lax.scan(
+        step, (k, v, init_state(), init_state(), jnp.zeros((), jnp.int32)),
+        jnp.arange(p_size),
+    )
+    halves = []
+    for m, l, acc in (st_a, st_b):
+        halves.append((acc / jnp.maximum(l, 1e-30)).astype(q.dtype))
+    out = jnp.concatenate(halves, axis=2)
+    return (out, n_done) if return_stats else out
+
+
+def zigzag_permutation(n: int, p: int) -> np.ndarray:
+    """Global index order placing chunks (i, 2P-1-i) on device i."""
+    assert n % (2 * p) == 0, f"zigzag needs n % 2P == 0, got n={n}, P={p}"
+    c = n // (2 * p)
+    chunks = np.arange(n).reshape(2 * p, c)
+    order = []
+    for i in range(p):
+        order += [chunks[i], chunks[2 * p - 1 - i]]
+    return np.concatenate(order)
+
+
 def ring_attention_sharded(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -131,6 +254,7 @@ def ring_attention_sharded(
     sp_axis: str = "sp",
     causal: bool = True,
     mesh=None,
+    schedule: str = "contiguous",
 ):
     """Global view: q, k, v [b, h, n, d] under jit with an (ambient) mesh.
 
@@ -138,6 +262,10 @@ def ring_attention_sharded(
     tp, sequence over ``sp_axis``; the pad mask (if any) is batch-sharded
     and sequence-REPLICATED (each device masks whichever chunk it holds).
     Call within ``jax.set_mesh`` or pass ``mesh`` explicitly.
+
+    ``schedule``: "contiguous" (cond-skip; FLOPs halved, lockstep
+    wall-clock not) or "zigzag" (causal only; balanced chunk layout —
+    wall-clock halves too; costs one static gather each way).
     """
     if mesh is None:
         from dalle_tpu.parallel.mesh import get_ambient_mesh
@@ -147,7 +275,42 @@ def ring_attention_sharded(
         "ring attention needs a mesh: pass mesh= or run the step under "
         "dalle_tpu.parallel.mesh.ambient(mesh) (train_lib does this)"
     )
+    assert schedule in ("contiguous", "zigzag"), (
+        f"unknown ring schedule {schedule!r} (contiguous | zigzag)"
+    )
+    if schedule == "zigzag" and not causal:
+        import warnings
+
+        warnings.warn(
+            "sp_schedule='zigzag' is a causal load-balancing layout; "
+            "non-causal ring attention is already balanced — running the "
+            "contiguous schedule",
+            stacklevel=2,
+        )
     spec = P(("dp", "fsdp"), "tp", sp_axis, None)
+    mspec = P(("dp", "fsdp"), None)
+
+    if schedule == "zigzag" and causal:
+        p_size = mesh.shape[sp_axis]
+        zz = zigzag_permutation(q.shape[2], p_size)
+        inv = np.argsort(zz)
+        zzj = jnp.asarray(zz)
+        fn = functools.partial(zigzag_ring_attention, axis_name=sp_axis)
+        if key_pad_mask is None:
+            out = jax.shard_map(
+                lambda q, k, v: fn(q, k, v),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )(q[:, :, zzj], k[:, :, zzj], v[:, :, zzj])
+        else:
+            # mask stays in GLOBAL order — the kernel gathers by global
+            # key position, so only q/k/v need the zigzag layout
+            out = jax.shard_map(
+                fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
+                out_specs=spec, check_vma=False,
+            )(q[:, :, zzj], k[:, :, zzj], v[:, :, zzj], key_pad_mask)
+        return out[:, :, jnp.asarray(inv)]
+
     fn = functools.partial(ring_attention, axis_name=sp_axis, causal=causal)
     if key_pad_mask is None:
         return jax.shard_map(
@@ -155,7 +318,6 @@ def ring_attention_sharded(
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
-    mspec = P(("dp", "fsdp"), None)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=False,

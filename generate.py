@@ -112,6 +112,15 @@ def main(argv=None):
         from dalle_tpu.models.scan_params import unrolled_eval_setup
 
         cfg, convert = unrolled_eval_setup(cfg)
+    elif cfg.pp_stages > 1:
+        # decode is latency-bound — flatten the staged checkpoint to the
+        # plain layout and use dp/tp across ALL devices instead of one
+        # pipeline stage's at a time (models/pp_params.py)
+        from dalle_tpu.models.pp_params import plain_eval_setup
+
+        cfg, convert = plain_eval_setup(cfg)
+        print(f"pp-trained checkpoint: flattened {trained_cfg.pp_stages} "
+              "stages to the plain layout for decode")
     model = DALLE(cfg)
     text0 = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
     codes0 = jnp.zeros((1, cfg.image_seq_len), jnp.int32)

@@ -151,6 +151,11 @@ def parse_args(argv=None):
                              "K/V rotation; ulysses = all_to_all head<->seq "
                              "re-shard (tp-local heads, i.e. heads/mesh_tp, "
                              "must divide by mesh_sp)")
+    parser.add_argument("--sp_schedule", type=str, default="contiguous",
+                        choices=("contiguous", "zigzag"),
+                        help="ring schedule: contiguous skips fully-masked "
+                             "steps; zigzag balances load per step "
+                             "(parallel/ring.py; needs seq_len % 2*sp == 0)")
     parser.add_argument("--moe_experts", type=int, default=0,
                         help=">0: every moe_every-th FF is a routed MoE "
                              "(expert weights shard over --mesh_ep)")
@@ -289,6 +294,7 @@ def main(argv=None):
             # asking for sequence parallelism
             sp_axis="sp" if (args.sp_ring or args.sp_mode) else None,
             sp_mode=args.sp_mode or "ring",
+            sp_schedule=args.sp_schedule,
             moe_experts=args.moe_experts,
             moe_every=args.moe_every,
             moe_top_k=args.moe_top_k,
