@@ -118,6 +118,76 @@ def _smoke() -> bool:
     return bool(os.environ.get("BENCH_SMOKE"))
 
 
+# --------------------------------------------------------------------------
+# busy-file: the tunnel's one-client mutual exclusion
+# --------------------------------------------------------------------------
+
+
+def busy_state(path):
+    """One shared truth for busy-file holders (used here and by
+    tools/tpu_probe.py): ("live", pid) | ("dead", pid) |
+    ("unparseable", None) | ("missing", None)."""
+    try:
+        text = open(path).read()
+    except OSError:
+        return ("missing", None)
+    try:
+        pid = int(text.split("pid=")[1].split()[0])
+    except (IndexError, ValueError):
+        return ("unparseable", None)
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return ("dead", pid)
+    except PermissionError:
+        pass  # alive under another uid — still alive
+    except OSError:
+        return ("dead", pid)
+    return ("live", pid)
+
+
+def _claim_busy(path, run_id, wait_s):
+    """Atomically claim the busy-file (O_CREAT|O_EXCL — no check-then-write
+    race with a concurrently-starting bench).  Waits up to ``wait_s`` for a
+    LIVE holder; returns True when claimed, False on wait timeout (the
+    caller must NOT touch the tunnel — a collision reads as a wedged chip
+    and can actually wedge it)."""
+    deadline = time.time() + wait_s
+    while True:
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                f.write(f"bench {run_id} pid={os.getpid()}\n")
+            return True
+        except FileExistsError:
+            state, pid = busy_state(path)
+            if state != "live":
+                # stale/dead/unparseable: remove and retry the atomic claim
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            if time.time() > deadline:
+                return False
+            print(f"busy-file held by live pid {pid}; waiting...",
+                  file=sys.stderr, flush=True)
+            time.sleep(30)
+        except OSError:
+            return True  # unwritable location: proceed unprotected
+
+
+def _release_busy(path):
+    """Remove the busy-file only if WE still own it — a holder that timed
+    out must never delete a successor's claim."""
+    try:
+        with open(path) as f:
+            if f"pid={os.getpid()}" in f.read():
+                os.remove(path)
+    except OSError:
+        pass
+
+
 def _hb(msg):
     """Heartbeat: phase progress line on stderr (streamed to the phase log
     so the parent can report how far a timed-out phase got)."""
@@ -269,17 +339,24 @@ def _persist_rung(run_id, name, res):
 def main():
     t_start = time.time()
     run_id = time.strftime("%Y%m%d_%H%M%S")
-    # the tunnel admits ONE client: the busy-file tells the availability
-    # watcher (tools/tpu_probe.py --watch) not to probe mid-run
+    # the tunnel admits ONE client: the busy-file is the mutual exclusion
+    # between the watcher-triggered ladder, the driver's end-of-round run,
+    # and the availability watcher's probes.  Claim it atomically; if a
+    # LIVE bench holds it past the wait budget, ABORT rather than collide
+    # (a collision reads as — and can cause — a wedged chip).
     busy_file = os.environ.get("TPU_BUSY_FILE", "/tmp/tpu_busy")
-    try:
-        with open(busy_file, "w") as f:
-            f.write(f"bench {run_id} pid={os.getpid()}\n")
-        import atexit
+    wait_s = float(os.environ.get("BENCH_BUSY_WAIT_S", "2400"))
+    if not _claim_busy(busy_file, run_id, wait_s):
+        _diagnostic(
+            "busy_wait",
+            f"another live bench held {busy_file} for >{wait_s:.0f}s — "
+            "not touching the one-client tunnel (its results land in "
+            "bench_history.jsonl / bench_logs/rungs.jsonl)",
+            "tunnel_busy",
+        )
+    import atexit
 
-        atexit.register(lambda: os.path.exists(busy_file) and os.remove(busy_file))
-    except OSError:
-        busy_file = None
+    atexit.register(_release_busy, busy_file)
     # default covers the sum of phase budgets (5200s incl. the flash_probe
     # rung) plus slack; a worst-case preflight (2x300s) or repeated
     # reprobes can still eat into the tail phases' budgets — the deadline

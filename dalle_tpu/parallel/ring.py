@@ -43,6 +43,22 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
+def _online_update(s_blk, v_blk, m, l, acc):
+    """One online-softmax block update (shared by BOTH ring schedules so
+    numerics can never drift between them): masked scores ``s_blk``
+    [b,h,i,j] + values ``v_blk`` [b,h,j,d] fold into the running
+    (m, l, acc)."""
+    m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1, keepdims=True))
+    p_blk = jnp.exp(s_blk - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p_blk, axis=-1, keepdims=True)
+    acc_new = acc * corr + jnp.einsum(
+        "bhij,bhjd->bhid", p_blk, v_blk.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -85,14 +101,7 @@ def ring_attention(
                 sblk = jnp.where(
                     kpm_blk[:, None, None, :] > 0, sblk, NEG_INF
                 )
-            m_new = jnp.maximum(m, jnp.max(sblk, axis=-1, keepdims=True))
-            pblk = jnp.exp(sblk - m_new)
-            corr = jnp.exp(m - m_new)
-            l_new = l * corr + jnp.sum(pblk, axis=-1, keepdims=True)
-            acc_new = acc * corr + jnp.einsum(
-                "bhij,bhjd->bhid", pblk, v_cur.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )
+            m_new, l_new, acc_new = _online_update(sblk, v_cur, m, l, acc)
             return m_new, l_new, acc_new, n_done + 1
 
         if causal:
@@ -180,15 +189,7 @@ def zigzag_ring_attention(
             kpm_blk = jnp.take(key_pad_mask, kpos, axis=1)  # [b, c] (gather:
             # zigzag key positions are not contiguous in the global mask)
             s_blk = jnp.where(kpm_blk[:, None, None, :] > 0, s_blk, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1, keepdims=True))
-        p_blk = jnp.exp(s_blk - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p_blk, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.einsum(
-            "bhij,bhjd->bhid", p_blk, vc.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        return (m_new, l_new, acc_new), n_done + 1
+        return _online_update(s_blk, vc, m, l, acc), n_done + 1
 
     def step(carry, s):
         k_cur, v_cur, st_a, st_b, n_done = carry

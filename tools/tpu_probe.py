@@ -29,25 +29,17 @@ import bench  # noqa: E402  (the hardened preflight lives there)
 
 
 def _busy_is_stale(path: str) -> bool:
-    """True when the busy-file's recorded ``pid=N`` is no longer alive
-    (bench.py writes one; bench died without its atexit cleanup)."""
-    try:
-        with open(path) as f:
-            content = f.read()
-        pid = int(content.split("pid=")[1].split()[0])
-    except (OSError, IndexError, ValueError):
-        # unparseable/foreign busy-file: fall back to age (>2h = stale)
+    """True when the busy-file's holder is dead (bench.py writes one;
+    a SIGKILLed bench never reaches its pid-checked release).  Liveness
+    semantics live in ONE place: bench.busy_state."""
+    state, _ = bench.busy_state(path)
+    if state == "unparseable":
+        # foreign busy-file: fall back to age (>2h = stale)
         try:
             return time.time() - os.path.getmtime(path) > 7200
         except OSError:
             return False
-    try:
-        os.kill(pid, 0)
-        return False
-    except ProcessLookupError:
-        return True
-    except PermissionError:
-        return False
+    return state == "dead"
 
 
 def probe(timeout_s: float):
