@@ -146,6 +146,38 @@ def busy_state(path):
     return ("live", pid)
 
 
+def reap_stale_busy(path):
+    """Remove a non-live busy-file, guarded against the check-then-remove
+    race: the removal happens under an exclusive flock on a side lock-file,
+    and the state is RE-verified after the lock is held — so a racing
+    claimer's fresh LIVE file can never be deleted between our check and
+    our unlink.  Returns True when ``path`` is (now) clear for an atomic
+    claim attempt, False when a live holder exists or removal failed."""
+    import fcntl
+
+    try:
+        lf = open(path + ".reap", "w")
+    except OSError:
+        return False
+    try:
+        try:
+            fcntl.flock(lf, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return False  # another process is reaping; let it finish
+        state, _ = busy_state(path)
+        if state == "missing":
+            return True
+        if state == "live":
+            return False
+        try:
+            os.remove(path)
+            return True
+        except OSError:
+            return False  # e.g. foreign-uid file in sticky /tmp
+    finally:
+        lf.close()  # releases the flock
+
+
 def _claim_busy(path, run_id, wait_s):
     """Atomically claim the busy-file (O_CREAT|O_EXCL — no check-then-write
     race with a concurrently-starting bench).  Waits up to ``wait_s`` for a
@@ -161,16 +193,14 @@ def _claim_busy(path, run_id, wait_s):
             return True
         except FileExistsError:
             state, pid = busy_state(path)
-            if state != "live":
-                # stale/dead/unparseable: remove and retry the atomic claim
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
-                continue
+            if state != "live" and reap_stale_busy(path):
+                continue  # cleared (or already gone): retry the atomic claim
+            # live holder, or a stale file we cannot clear: wait it out —
+            # the deadline is checked on EVERY iteration so an unremovable
+            # stale file times out instead of spinning forever
             if time.time() > deadline:
                 return False
-            print(f"busy-file held by live pid {pid}; waiting...",
+            print(f"busy-file held by pid {pid} ({state}); waiting...",
                   file=sys.stderr, flush=True)
             time.sleep(30)
         except OSError:
@@ -179,13 +209,15 @@ def _claim_busy(path, run_id, wait_s):
 
 def _release_busy(path):
     """Remove the busy-file only if WE still own it — a holder that timed
-    out must never delete a successor's claim."""
-    try:
-        with open(path) as f:
-            if f"pid={os.getpid()}" in f.read():
-                os.remove(path)
-    except OSError:
-        pass
+    out must never delete a successor's claim.  The pid is parsed exactly
+    (via busy_state), not substring-matched, so pid 123 can never match a
+    successor's pid 1234."""
+    _, pid = busy_state(path)
+    if pid == os.getpid():
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
 
 def _hb(msg):

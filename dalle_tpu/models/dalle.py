@@ -34,8 +34,33 @@ import jax
 import jax.numpy as jnp
 
 from dalle_tpu.models.transformer import DivideMax, Transformer, TransformerConfig
+from dalle_tpu.ops.fused_ce import range_ce
 
 NEG_INF = -1e30
+
+
+class VocabHead(nn.Module):
+    """Drop-in for ``nn.Dense`` as the logits head, with ``kernel``/``bias``
+    exposed as attributes so the fused loss path (``ops/fused_ce.py``) can
+    slice the text/image vocab ranges.  Param names and init match
+    ``nn.Dense`` exactly (kernel: lecun_normal, bias: zeros), so checkpoints
+    and the reference-interop mapping are unchanged."""
+
+    dim: int
+    features: int
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (self.dim, self.features)
+        )
+        self.bias = self.param("bias", nn.initializers.zeros, (self.features,))
+
+    def __call__(self, x):
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, self.kernel, self.bias, dtype=self.dtype
+        )
+        return x @ kernel + bias
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +102,7 @@ class DALLEConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    loss_chunk: Optional[int] = None  # fused range-split CE (ops/fused_ce.py)
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -183,7 +209,9 @@ class DALLE(nn.Module):
             self.image_pos_emb = AxialPositionalEmbedding(c.image_fmap_size, c.dim)
         self.transformer = Transformer(c.transformer_config(), name="transformer")
         self.final_norm = nn.LayerNorm(epsilon=1e-5, dtype=c.dtype, name="final_norm")  # torch-eps parity
-        self.to_logits = nn.Dense(c.total_tokens, dtype=c.dtype, name="to_logits")
+        self.to_logits = VocabHead(
+            c.dim, c.total_tokens, dtype=c.dtype, name="to_logits"
+        )
         if c.stable:
             self.norm_by_max = DivideMax(axis=-1)
 
@@ -236,12 +264,18 @@ class DALLE(nn.Module):
             )
         return jnp.where((pos <= c.text_seq_len)[..., None], text_e, img_e)
 
+    def _pre_head(self, x):
+        """Pre-projection normalization (DivideMax when stable, then the
+        final LayerNorm) — ONE definition shared by ``head`` and the fused
+        loss path so the two can never drift."""
+        if self.cfg.stable:
+            x = self.norm_by_max(x)
+        return self.final_norm(x)
+
     def head(self, x, pos=None):
         """final norm + projection + logits mask."""
         c = self.cfg
-        if c.stable:
-            x = self.norm_by_max(x)
-        logits = self.to_logits(self.final_norm(x)).astype(jnp.float32)
+        logits = self.to_logits(self._pre_head(x)).astype(jnp.float32)
         if pos is None:
             pos = jnp.arange(logits.shape[-2])
         allowed = self.logits_mask_row(pos)
@@ -268,19 +302,38 @@ class DALLE(nn.Module):
         x = self.transformer(
             x, key_pad_mask=key_pad_mask, deterministic=deterministic
         )
-        logits = self.head(x)
         if not return_loss:
-            return logits
+            return self.head(x)
 
         labels_text = self.remap_pad_tokens(text)  # toks[1..t]
-        labels_img = image_codes + c.total_text_tokens  # offset (reference: :582)
-        labels = jnp.concatenate([labels_text, labels_img], axis=1)  # [b, n]
-
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         t = c.text_seq_len
-        loss_text = jnp.mean(nll[:, :t])
-        loss_img = jnp.mean(nll[:, t:])
+        if c.loss_chunk:
+            # Fused range-split CE (ops/fused_ce.py): softmax over the
+            # allowed vocab slice == softmax over the -inf-masked full row
+            # (reference: dalle_pytorch.py:573-590), so text rows only
+            # multiply W[:, :Vt] and image rows W[:, Vt:], chunk-scanned so
+            # the [b, n, V] logits tensor never materializes.
+            xn = self._pre_head(x)
+            vt = c.total_text_tokens
+            kernel, bias = self.to_logits.kernel, self.to_logits.bias
+            nll_text = range_ce(
+                xn[:, :t], kernel[:, :vt], bias[:vt], labels_text,
+                chunk=c.loss_chunk, compute_dtype=c.dtype,
+            )
+            nll_img = range_ce(
+                xn[:, t:], kernel[:, vt:], bias[vt:], image_codes,
+                chunk=c.loss_chunk, compute_dtype=c.dtype,
+            )
+            loss_text = jnp.mean(nll_text)
+            loss_img = jnp.mean(nll_img)
+        else:
+            logits = self.head(x)
+            labels_img = image_codes + c.total_text_tokens  # offset (reference: :582)
+            labels = jnp.concatenate([labels_text, labels_img], axis=1)  # [b, n]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+            loss_text = jnp.mean(nll[:, :t])
+            loss_img = jnp.mean(nll[:, t:])
         return (loss_text + c.loss_img_weight * loss_img) / (c.loss_img_weight + 1)
 
     # --- decode-mode pieces (used by models/generate.py) -------------------

@@ -1,0 +1,96 @@
+"""Chunked fused projection + cross-entropy over a contiguous vocab slice.
+
+The reference computes the full ``[b, n, total_tokens]`` logits tensor, masks
+disallowed positions to -inf, and takes ``log_softmax`` + gather
+(reference: dalle_pytorch/dalle_pytorch.py:573-590).  On TPU that tensor is
+the single largest HBM resident in the train step — for the flagship
+(b=8, n=1280, V≈18.7k) it is ~760 MB in fp32 — and, because the logits mask
+is a *contiguous range* per position type (text positions may only emit text
+tokens, image positions only image tokens, reference: :390-401), most of the
+head matmul FLOPs are spent computing logits the mask immediately discards.
+
+TPU-first redesign, exploiting both structural facts:
+
+  * **Range split**: softmax over range-masked logits is exactly softmax over
+    the allowed slice, so text rows multiply only ``W[:, :Vt]`` and image
+    rows only ``W[:, Vt:]`` — ~2.2× fewer head FLOPs at flagship shapes, and
+    bit-identical losses (the -inf mask contributes exp(-inf)=0 terms).
+  * **Token chunking + remat**: a ``lax.scan`` over sequence chunks computes
+    each ``[b, chunk, Vslice]`` logits block, reduces it to per-token NLL,
+    and drops it; ``jax.checkpoint`` recomputes blocks in the backward pass.
+    Peak residency falls from O(n·V) to O(chunk·V) while each chunk matmul
+    stays MXU-sized.  The batch axis is untouched, so dp/fsdp shardings pass
+    through unchanged; under tp the vocab slice keeps its ('tp',) sharding
+    and XLA inserts the psum for the logsumexp, exactly as for the dense
+    path.
+
+Used by :meth:`dalle_tpu.models.dalle.DALLE.__call__` when
+``DALLEConfig.loss_chunk`` is set; the dense masked path remains the default
+and the parity oracle (``tests/test_fused_ce.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def range_ce(
+    h: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    labels: jnp.ndarray,
+    *,
+    chunk: int = 256,
+    compute_dtype=None,
+) -> jnp.ndarray:
+    """Per-token NLL of ``softmax(h @ kernel + bias)`` without materializing
+    the full logits tensor.
+
+    Args:
+      h: ``[b, T, d]`` activations (already final-normed).
+      kernel: ``[d, Vs]`` head weight slice for this row type.
+      bias: ``[Vs]`` head bias slice, or None.
+      labels: ``[b, T]`` int targets in ``[0, Vs)``.
+      chunk: sequence-chunk length; peak logits residency is
+        ``[b, chunk, Vs]``.
+      compute_dtype: matmul dtype (e.g. bf16); the reduction is fp32, matching
+        the dense head's ``astype(float32)`` before softmax.
+
+    Returns:
+      ``[b, T]`` fp32 negative log-likelihoods.
+    """
+    b, T, d = h.shape
+    chunk = min(chunk, T)
+    pad = (-T) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+    nc = (T + pad) // chunk
+    # [nc, b, chunk, ...]: scan over sequence chunks, batch axis intact so
+    # dp/fsdp shardings of the activations are preserved verbatim.
+    hc = jnp.swapaxes(h.reshape(b, nc, chunk, d), 0, 1)
+    lc = jnp.swapaxes(labels.reshape(b, nc, chunk), 0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(hb, lb):
+        x, k = (hb, kernel) if compute_dtype is None else (
+            hb.astype(compute_dtype), kernel.astype(compute_dtype))
+        logits = x @ k
+        if bias is not None:
+            logits = logits + (bias if compute_dtype is None
+                               else bias.astype(compute_dtype))
+        logits = logits.astype(jnp.float32)  # fp32 reduction (head parity)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lb[..., None], axis=-1)[..., 0]
+        return lse - picked
+
+    def body(_, inp):
+        hb, lb = inp
+        return None, chunk_nll(hb, lb)
+
+    _, nll = jax.lax.scan(body, None, (hc, lc))
+    nll = jnp.swapaxes(nll, 0, 1).reshape(b, T + pad)
+    return nll[:, :T]

@@ -49,12 +49,24 @@ def dalle_train_flops(cfg, batch: int) -> float:
     per_layer += 2 * d * (d * cfg.ff_mult * 2) + 2 * (d * cfg.ff_mult) * d  # GEGLU
     matmul = cfg.depth * per_layer * tokens
     attn = cfg.depth * 4 * inner * n * tokens  # qk^T + pv
-    head = 2 * d * cfg.total_tokens * tokens
-    fwd = matmul + attn + head
     mult = 3.0  # fwd + 2x bwd
     if getattr(cfg, "reversible", False):
         mult += 1.0  # recompute in the inverted backward
-    return mult * fwd
+    if getattr(cfg, "loss_chunk", None):
+        # fused range-split CE (ops/fused_ce.py): text rows only multiply
+        # the text vocab slice, image rows the image slice; the chunk remat
+        # recomputes the head matmul once in bwd (4x fwd instead of 3x)
+        t = cfg.text_seq_len
+        head = 2 * d * batch * (
+            t * cfg.total_text_tokens + (n - t) * cfg.num_image_tokens
+        )
+        head_mult = 4.0
+    else:
+        head = 2 * d * cfg.total_tokens * tokens
+        # the head sits OUTSIDE the reversible stack, so it is never part
+        # of the inverted-backward recompute: always fwd + 2x bwd
+        head_mult = 3.0
+    return mult * (matmul + attn) + head_mult * head
 
 
 def xla_cost_analysis(jitted_fn, *args) -> dict:

@@ -1,0 +1,129 @@
+"""Fused range-split chunked CE (ops/fused_ce.py) vs the dense masked-logits
+oracle (the reference's loss formulation, dalle_pytorch.py:573-590)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.ops.fused_ce import range_ce
+
+
+def _dense_nll(h, kernel, bias, labels):
+    logits = (h @ kernel + bias).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+@pytest.mark.parametrize("chunk", [4, 7, 32])
+def test_range_ce_matches_dense(chunk):
+    k = jax.random.PRNGKey(0)
+    b, T, d, V = 3, 17, 16, 29
+    h = jax.random.normal(jax.random.fold_in(k, 1), (b, T, d))
+    w = jax.random.normal(jax.random.fold_in(k, 2), (d, V)) * 0.1
+    bias = jax.random.normal(jax.random.fold_in(k, 3), (V,)) * 0.1
+    labels = jax.random.randint(jax.random.fold_in(k, 4), (b, T), 0, V)
+    got = range_ce(h, w, bias, labels, chunk=chunk)
+    want = _dense_nll(h, w, bias, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_range_ce_grads_match_dense():
+    k = jax.random.PRNGKey(1)
+    b, T, d, V = 2, 12, 8, 19
+    h = jax.random.normal(jax.random.fold_in(k, 1), (b, T, d))
+    w = jax.random.normal(jax.random.fold_in(k, 2), (d, V)) * 0.1
+    bias = jnp.zeros((V,))
+    labels = jax.random.randint(jax.random.fold_in(k, 3), (b, T), 0, V)
+
+    def loss_fused(h, w, bias):
+        return range_ce(h, w, bias, labels, chunk=5).mean()
+
+    def loss_dense(h, w, bias):
+        return _dense_nll(h, w, bias, labels).mean()
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(h, w, bias)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(h, w, bias)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5)
+
+
+def _tiny_cfg(**kw):
+    return DALLEConfig(
+        num_text_tokens=50,
+        text_seq_len=8,
+        num_image_tokens=32,
+        image_fmap_size=4,
+        dim=32,
+        depth=2,
+        heads=2,
+        dim_head=16,
+        attn_types=("full", "axial_row"),
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("stable", [False, True])
+def test_dalle_loss_fused_matches_dense(stable):
+    cfg = _tiny_cfg(stable=stable)
+    model = DALLE(cfg)
+    k = jax.random.PRNGKey(2)
+    text = jax.random.randint(jax.random.fold_in(k, 1), (2, cfg.text_seq_len), 0, 50)
+    text = text.at[:, -2:].set(0)  # exercise pad remap
+    codes = jax.random.randint(
+        jax.random.fold_in(k, 2), (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = model.init(jax.random.fold_in(k, 3), text, codes)["params"]
+
+    dense = model.apply({"params": params}, text, codes, return_loss=True)
+    fused_model = DALLE(dataclasses.replace(cfg, loss_chunk=4))
+    fused = fused_model.apply({"params": params}, text, codes, return_loss=True)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(dense), atol=1e-5)
+
+
+def test_dalle_loss_fused_grads_match_dense():
+    cfg = _tiny_cfg()
+    model = DALLE(cfg)
+    fused_model = DALLE(dataclasses.replace(cfg, loss_chunk=6))
+    k = jax.random.PRNGKey(3)
+    text = jax.random.randint(jax.random.fold_in(k, 1), (2, cfg.text_seq_len), 1, 50)
+    codes = jax.random.randint(
+        jax.random.fold_in(k, 2), (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = model.init(jax.random.fold_in(k, 3), text, codes)["params"]
+
+    gd = jax.grad(
+        lambda p: model.apply({"params": p}, text, codes, return_loss=True)
+    )(params)
+    gf = jax.grad(
+        lambda p: fused_model.apply({"params": p}, text, codes, return_loss=True)
+    )(params)
+    flat_d = jax.tree_util.tree_leaves_with_path(gd)
+    flat_f = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_leaves_with_path(gf)
+    )
+    for path, vd in flat_d:
+        vf = flat_f[jax.tree_util.keystr(path)]
+        np.testing.assert_allclose(
+            np.asarray(vf), np.asarray(vd), atol=2e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_vocab_head_param_layout_unchanged():
+    """VocabHead must keep nn.Dense's param names/shapes so checkpoints and
+    the reference-interop mapping keep working."""
+    cfg = _tiny_cfg()
+    model = DALLE(cfg)
+    k = jax.random.PRNGKey(4)
+    text = jnp.ones((1, cfg.text_seq_len), jnp.int32)
+    codes = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    params = model.init(k, text, codes)["params"]
+    head = params["to_logits"]
+    assert set(head) == {"kernel", "bias"}
+    assert head["kernel"].shape == (cfg.dim, cfg.total_tokens)
+    assert head["bias"].shape == (cfg.total_tokens,)

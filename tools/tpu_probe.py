@@ -98,12 +98,13 @@ def main():
             if _busy_is_stale(args.busy_file):
                 # a SIGKILLed bench never reaches its atexit cleanup; a
                 # busy-file whose recorded pid is dead must not disable
-                # the watcher forever
-                try:
-                    os.remove(args.busy_file)
-                except OSError:
-                    pass
-                emit({"t": time.time(), "state": "stale_busy_removed"})
+                # the watcher forever.  Guarded removal (bench.reap_stale_busy
+                # re-verifies under a flock) so a bench that claimed between
+                # our staleness check and the unlink keeps its claim.
+                if bench.reap_stale_busy(args.busy_file):
+                    emit({"t": time.time(), "state": "stale_busy_removed"})
+                else:
+                    emit({"t": time.time(), "state": "skipped_busy"})
             else:
                 emit({"t": time.time(), "state": "skipped_busy"})
         else:

@@ -126,6 +126,11 @@ def parse_args(argv=None):
                              "keep (full=save nothing; dots=save matmuls; "
                              "dots_no_batch=save batch-free matmuls only)")
     parser.add_argument("--loss_img_weight", type=int, default=7)
+    parser.add_argument("--loss_chunk", type=int, default=None,
+                        help="fused range-split CE: chunk-scan the head so "
+                             "the [b,n,V] logits tensor never materializes "
+                             "and text/image rows only multiply their vocab "
+                             "slice (~2x fewer head FLOPs; ops/fused_ce.py)")
     parser.add_argument("--attn_types", type=str, default="full",
                         help="comma-sep cycle: full,axial_row,axial_col,conv_like,sparse,mlp")
     parser.add_argument("--shift_tokens", action="store_true")
@@ -280,6 +285,7 @@ def main(argv=None):
             ff_dropout=args.ff_dropout,
             attn_types=tuple(args.attn_types.split(",")),
             loss_img_weight=args.loss_img_weight,
+            loss_chunk=args.loss_chunk,
             stable=args.stable,
             sandwich_norm=args.sandwich_norm,
             shift_tokens=args.shift_tokens,
