@@ -287,3 +287,56 @@ def shape_dtype_of(tree: Any, sharding: Any = None) -> Any:
 
 def is_checkpoint(path: str) -> bool:
     return (Path(path) / "meta.json").exists()
+
+
+def load_dalle_for_eval(path: str, *, prefer_ema: bool = True):
+    """Decode-ready (model, params, meta, notes) from a DALLE checkpoint.
+
+    One shared implementation of the eval-load dance used by generate.py
+    and tools/export_stablehlo.py: rebuild the config from meta, convert
+    scan-trained (stacked) or pp-trained (staged) layouts to the plain
+    unrolled layout decode wants, prefer the EMA subtree when the trainer
+    kept one, and restore onto a single device.  ``notes`` is a list of
+    human-readable decisions (EMA use, layout flattening) for CLIs to
+    print."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    meta = load_meta(path)
+    cfg = DALLEConfig.from_dict(meta["hparams"])
+    notes = []
+    trained_cfg, convert = cfg, None
+    if cfg.scan_layers:
+        from dalle_tpu.models.scan_params import unrolled_eval_setup
+
+        cfg, convert = unrolled_eval_setup(cfg)
+        notes.append("scan-trained checkpoint: unrolled stacked params for decode")
+    elif cfg.pp_stages > 1:
+        from dalle_tpu.models.pp_params import plain_eval_setup
+
+        cfg, convert = plain_eval_setup(cfg)
+        notes.append(
+            f"pp-trained checkpoint: flattened {trained_cfg.pp_stages} "
+            "stages to the plain layout for decode"
+        )
+    model = DALLE(cfg)
+    text0 = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
+    codes0 = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    load_model = DALLE(trained_cfg) if convert else model
+    p_shapes = jax.eval_shape(
+        lambda: load_model.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
+    )["params"]
+    subtree = (
+        "ema_params"
+        if ("ema_params" in meta.get("subtrees", ()) and prefer_ema)
+        else "params"
+    )
+    if subtree == "ema_params":
+        notes.append("using EMA params (--no_ema selects the raw weights)")
+    params = load_subtree(path, subtree, shape_dtype_of(p_shapes, sharding=single))
+    if convert is not None:
+        params = convert(params)
+    return model, params, meta, notes

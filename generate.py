@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_tpu.models.clip import CLIP, CLIPConfig
-from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.dalle import DALLE
 from dalle_tpu.models.generate import generate_images, generate_texts
 from dalle_tpu.training.checkpoint import is_checkpoint
 from dalle_tpu.tokenizers import get_tokenizer
@@ -99,49 +99,22 @@ def main(argv=None):
     # (b) target-less restores are 'generally UNSAFE' per orbax.  The
     # --mesh_* branch below re-shards for sharded inference.  Only the
     # needed subtrees load (generation never reads opt_state).
-    from dalle_tpu.training.checkpoint import load_meta, load_subtree, shape_dtype_of
+    from dalle_tpu.training.checkpoint import (
+        load_dalle_for_eval, load_meta, load_subtree, shape_dtype_of,
+    )
 
     single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
-    meta = load_meta(args.dalle_path)
-    cfg = DALLEConfig.from_dict(meta["hparams"])
-    # scanned-trained checkpoints (--scan_layers) store stacked params;
-    # decode runs unrolled — load in the stored layout, then convert
-    trained_cfg, convert = cfg, None
-    if cfg.scan_layers:
-        from dalle_tpu.models.scan_params import unrolled_eval_setup
-
-        cfg, convert = unrolled_eval_setup(cfg)
-    elif cfg.pp_stages > 1:
-        # decode is latency-bound — flatten the staged checkpoint to the
-        # plain layout and use dp/tp across ALL devices instead of one
-        # pipeline stage's at a time (models/pp_params.py)
-        from dalle_tpu.models.pp_params import plain_eval_setup
-
-        cfg, convert = plain_eval_setup(cfg)
-        print(f"pp-trained checkpoint: flattened {trained_cfg.pp_stages} "
-              "stages to the plain layout for decode")
-    model = DALLE(cfg)
-    text0 = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
-    codes0 = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
-    load_model = DALLE(trained_cfg) if convert else model
-    p_shapes = jax.eval_shape(
-        lambda: load_model.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
-    )["params"]
-    # prefer the EMA weights when the trainer kept them (--ema_decay);
-    # --no_ema forces the raw training params
-    subtree = (
-        "ema_params"
-        if ("ema_params" in meta.get("subtrees", ()) and not args.no_ema)
-        else "params"
+    # scan-trained (stacked) / pp-trained (staged) layouts flatten to the
+    # plain unrolled layout decode wants; EMA weights win when the trainer
+    # kept them (--ema_decay) unless --no_ema (shared eval-load dance:
+    # training/checkpoint.py:load_dalle_for_eval)
+    model, params, meta, notes = load_dalle_for_eval(
+        args.dalle_path, prefer_ema=not args.no_ema
     )
-    if subtree == "ema_params":
-        print("using EMA params (pass --no_ema for the raw weights)")
-    params = load_subtree(
-        args.dalle_path, subtree, shape_dtype_of(p_shapes, sharding=single)
-    )
-    if convert is not None:
-        params = convert(params)
+    for note in notes:
+        print(note)
+    cfg = model.cfg
     if args.taming or args.vqgan_model_path or args.vqgan_config_path:
         from dalle_tpu.models.pretrained import load_vqgan
 

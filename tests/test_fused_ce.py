@@ -114,6 +114,38 @@ def test_dalle_loss_fused_grads_match_dense():
         )
 
 
+def test_fused_loss_under_tp_sharded_mesh():
+    """loss_chunk must compose with GSPMD: a (dp=2,fsdp=2,tp=2) sharded
+    train step — to_logits/kernel sharded (None, 'tp') on the vocab axis —
+    computes the same loss as the dense path on the same mesh."""
+    from dalle_tpu.parallel import make_mesh
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    k = jax.random.PRNGKey(5)
+    losses = {}
+    for name, chunk in (("dense", None), ("fused", 8)):
+        cfg = _tiny_cfg(loss_chunk=chunk)
+        model = DALLE(cfg)
+        tx = make_optimizer(1e-3)
+        text = jax.random.randint(jax.random.fold_in(k, 1), (8, cfg.text_seq_len), 1, 50)
+        codes = jax.random.randint(
+            jax.random.fold_in(k, 2), (8, cfg.image_seq_len), 0, cfg.num_image_tokens
+        )
+        mesh = make_mesh(dp=2, fsdp=2, tp=2)
+        params, opt_state = init_train_state(
+            model, tx, mesh, {"params": jax.random.fold_in(k, 3)}, text, codes
+        )
+        step = make_dalle_train_step(model, tx, mesh)
+        _, _, loss = step(params, opt_state, None, text, codes, jax.random.fold_in(k, 4))
+        losses[name] = float(loss)
+    assert np.isfinite(losses["fused"])
+    np.testing.assert_allclose(losses["fused"], losses["dense"], rtol=1e-5)
+
+
 def test_vocab_head_param_layout_unchanged():
     """VocabHead must keep nn.Dense's param names/shapes so checkpoints and
     the reference-interop mapping keep working."""

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Export DALLE inference functions to portable StableHLO artifacts.
+
+The reference has no deployment story beyond "load the .pt in Python"
+(reference: generate.py:1-120 — inference is the training stack re-driven
+from a CLI).  On TPU the natural serving artifact is a serialized StableHLO
+module: ``jax.export`` lowers a jitted function once, the artifact is
+loadable from pure C++ (PJRT) or Python without any of this repo's code, and
+the compile cache is warm from the first call.
+
+Exports (all shapes static, chosen at export time):
+
+  * ``forward``    — the training-shape forward returning logits
+                     (scoring / perplexity serving);
+  * ``decode``     — the full KV-cache ``scan_decode`` image sampler:
+                     text ids + PRNG key -> image codes (the generation
+                     hot path, one call per batch of prompts).
+
+Artifacts are written as ``<out>/<name>.stablehlo`` (serialized bytes,
+``jax.export.deserialize``-loadable) plus a ``meta.json`` with shapes,
+dtypes, and the config — enough for a serving host to validate inputs.
+
+Usage::
+
+    python tools/export_stablehlo.py --dalle_path CKPT --out exported/
+    python tools/export_stablehlo.py --selftest   # tiny roundtrip, CPU
+
+Round-trip correctness of the artifacts is pinned by
+``tests/test_export.py`` (deserialize -> call -> compare against the live
+model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+
+
+def export_dalle(model, params, out_dir, *, batch: int, temperature: float = 1.0,
+                 filter_thres: float = 0.9):
+    """Serialize forward + decode for ``model`` at the given batch size.
+
+    Returns the meta dict (also written to ``<out_dir>/meta.json``)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from dalle_tpu.models.generate import generate_image_codes
+
+    c = model.cfg
+    os.makedirs(out_dir, exist_ok=True)
+    text = jnp.zeros((batch, c.text_seq_len), jnp.int32)
+    codes = jnp.zeros((batch, c.image_seq_len), jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    def forward(params, text, codes):
+        return model.apply({"params": params}, text, codes)
+
+    def decode(params, text, key):
+        return generate_image_codes(
+            model, params, text, key,
+            temperature=temperature, filter_thres=filter_thres,
+        )
+
+    arts = {}
+    for name, fn, args in (
+        ("forward", forward, (params, text, codes)),
+        ("decode", decode, (params, text, key)),
+    ):
+        exp = jexport.export(jax.jit(fn))(*args)
+        data = exp.serialize()
+        path = os.path.join(out_dir, f"{name}.stablehlo")
+        with open(path, "wb") as f:
+            f.write(data)
+        arts[name] = {
+            "path": os.path.basename(path),
+            "bytes": len(data),
+            "in_avals": [str(a) for a in exp.in_avals],
+            "out_avals": [str(a) for a in exp.out_avals],
+        }
+
+    meta = {
+        "format": "jax.export/stablehlo",
+        "jax_version": jax.__version__,
+        "batch": batch,
+        "temperature": temperature,
+        "filter_thres": filter_thres,
+        "config": {
+            k: (v if isinstance(v, (int, float, str, bool, type(None))) else str(v))
+            for k, v in vars(c).items()
+        },
+        "artifacts": arts,
+    }
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    return meta
+
+
+def load_exported(path):
+    """Deserialize one artifact; returns a callable (the .call method)."""
+    from jax import export as jexport
+
+    with open(path, "rb") as f:
+        return jexport.deserialize(f.read()).call
+
+
+def _selftest():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    cfg = DALLEConfig(
+        num_text_tokens=40, text_seq_len=6, num_image_tokens=16,
+        image_fmap_size=3, dim=16, depth=1, heads=2, dim_head=8,
+    )
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (2, cfg.text_seq_len), 1, 40)
+    codes = jax.random.randint(rng, (2, cfg.image_seq_len), 0, 16)
+    params = model.init(rng, text, codes)["params"]
+
+    out = "/tmp/export_selftest"
+    meta = export_dalle(model, params, out, batch=2)
+    fwd = load_exported(os.path.join(out, "forward.stablehlo"))
+    live = model.apply({"params": params}, text, codes)
+    np.testing.assert_allclose(
+        np.asarray(fwd(params, text, codes)), np.asarray(live), atol=1e-5
+    )
+    dec = load_exported(os.path.join(out, "decode.stablehlo"))
+    got = np.asarray(dec(params, text, jax.random.PRNGKey(7)))
+    assert got.shape == (2, cfg.image_seq_len)
+    assert (got >= 0).all() and (got < cfg.num_image_tokens).all()
+    print(json.dumps({"selftest": "ok", **{k: v["bytes"] for k, v in
+                                           meta["artifacts"].items()}}))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dalle_path", type=str, default=None,
+                    help="checkpoint dir (training/checkpoint.py layout)")
+    ap.add_argument("--out", type=str, default="exported")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--filter_thres", type=float, default=0.9)
+    ap.add_argument("--no_ema", action="store_true",
+                    help="export the raw training params even when the "
+                         "checkpoint carries an ema_params subtree")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args()
+    import dalle_tpu
+
+    dalle_tpu.force_cpu_if_virtual()
+    if args.selftest:
+        _selftest()
+        return
+    if not args.dalle_path:
+        ap.error("--dalle_path is required (or pass --selftest)")
+
+    from dalle_tpu.training.checkpoint import load_dalle_for_eval
+
+    model, params, _, notes = load_dalle_for_eval(
+        args.dalle_path, prefer_ema=not args.no_ema
+    )
+    for n in notes:
+        print(n, file=sys.stderr)
+    meta = export_dalle(
+        model, params, args.out, batch=args.batch,
+        temperature=args.temperature, filter_thres=args.filter_thres,
+    )
+    print(json.dumps({k: v["bytes"] for k, v in meta["artifacts"].items()}))
+
+
+if __name__ == "__main__":
+    main()
