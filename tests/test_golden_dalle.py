@@ -178,6 +178,18 @@ def test_dalle_forward_matches_reference(rng, flags):
     )
 
     assert abs(our_loss - ref_loss) < 1e-4, (our_loss, ref_loss)
+    # the fused range-split CE path (ops/fused_ce.py) must hit the SAME
+    # reference number — differential proof it is the identical loss, not
+    # merely self-consistent with our dense path
+    import dataclasses
+
+    fused_loss = float(
+        DALLE(dataclasses.replace(cfg, loss_chunk=4)).apply(
+            {"params": params}, jnp.asarray(text), jnp.asarray(codes),
+            return_loss=True,
+        )
+    )
+    assert abs(fused_loss - ref_loss) < 1e-4, (fused_loss, ref_loss)
     # masked positions use different fill constants (reference -finfo.max,
     # ours -1e30) — compare where the logits mask allows
     allowed = our_logits > -1e29
