@@ -857,7 +857,7 @@ def _generate_bench():
     jax.block_until_ready(images)
     dt = (time.perf_counter() - t0) / iters
     assert images.shape == (batch, img_size, img_size, 3)
-    return {
+    result = {
         "imgs_per_sec": round(batch / dt, 3),
         "image_size": img_size,
         "image_seq_len": cfg.image_seq_len,
@@ -866,6 +866,40 @@ def _generate_bench():
         "clip_score_mean": round(float(jnp.mean(scores)), 4),
         "note": "random weights — measures pipeline speed; CLIP score is harness evidence only",
     }
+
+    # int8 decode variant (ops/quant.py): same pipeline with quantized
+    # projections + head — halved per-token weight traffic, s8xs8 MXU dots.
+    # Best-effort: a failure here never sinks the fp result above.
+    try:
+        from dalle_tpu.models.quantize import (
+            quant_model_config, quantize_decode_params,
+        )
+
+        qmodel = DALLE(quant_model_config(cfg))
+        qparams = quantize_decode_params(params)
+
+        def gen_q(text, key):
+            return generate_images(
+                qmodel, qparams, vae, vparams, text, key,
+                clip=clip, clip_params=cparams,
+            )
+
+        _hb("generate_bench: compiling int8 decode...")
+        t0 = time.perf_counter()
+        images, _ = gen_q(text, rng)
+        jax.block_until_ready(images)
+        q_compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(iters):
+            images, _ = gen_q(text, jax.random.fold_in(rng, i))
+        jax.block_until_ready(images)
+        q_dt = (time.perf_counter() - t0) / iters
+        result["imgs_per_sec_int8"] = round(batch / q_dt, 3)
+        result["int8_speedup"] = round(dt / q_dt, 2)
+        result["int8_compile_s"] = round(q_compile_s, 1)
+    except Exception as e:  # pragma: no cover - diagnostic path
+        result["int8_error"] = f"{type(e).__name__}: {e}"
+    return result
 
 
 def _mfu_history(platform: str, smoke: bool, tiny: bool = False):

@@ -103,6 +103,9 @@ class DALLEConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     loss_chunk: Optional[int] = None  # fused range-split CE (ops/fused_ce.py)
+    # decode-only int8 projections + head (ops/quant.py); params from
+    # models/quantize.py:quantize_decode_params, never from training
+    quant_int8: bool = False
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -160,6 +163,7 @@ class DALLEConfig:
             moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_aux_weight=self.moe_aux_weight,
+            quant_int8=self.quant_int8,
             dtype=self.dtype,
         )
 
@@ -209,9 +213,14 @@ class DALLE(nn.Module):
             self.image_pos_emb = AxialPositionalEmbedding(c.image_fmap_size, c.dim)
         self.transformer = Transformer(c.transformer_config(), name="transformer")
         self.final_norm = nn.LayerNorm(epsilon=1e-5, dtype=c.dtype, name="final_norm")  # torch-eps parity
-        self.to_logits = VocabHead(
-            c.dim, c.total_tokens, dtype=c.dtype, name="to_logits"
-        )
+        if c.quant_int8:
+            from dalle_tpu.ops.quant import QDense
+
+            self.to_logits = QDense(c.total_tokens, dtype=c.dtype, name="to_logits")
+        else:
+            self.to_logits = VocabHead(
+                c.dim, c.total_tokens, dtype=c.dtype, name="to_logits"
+            )
         if c.stable:
             self.norm_by_max = DivideMax(axis=-1)
 
@@ -305,6 +314,10 @@ class DALLE(nn.Module):
         if not return_loss:
             return self.head(x)
 
+        assert not c.quant_int8, (
+            "quant_int8 is a decode-only configuration (models/quantize.py); "
+            "train with the fp model"
+        )
         labels_text = self.remap_pad_tokens(text)  # toks[1..t]
         t = c.text_seq_len
         if c.loss_chunk:

@@ -1,0 +1,62 @@
+"""Offline int8 quantization of a trained DALLE param tree for decode.
+
+Pairs with ``DALLEConfig(quant_int8=True)`` model builds: every projection
+the quant model declares as a ``QDense`` (attention qkv/out, FF wi/wo, gMLP
+proj_in/proj_out, the logits head) gets its fp ``kernel`` replaced by
+``kernel_q`` (int8) + ``scale`` (fp32 per-output-channel); biases,
+embeddings, norms, and gate tables stay fp.  The transform is layout-driven
+— it walks the tree and converts exactly the module names the quant model
+expects, so a mismatch fails loudly at ``apply`` time rather than silently
+skewing numerics.
+
+The reference has no quantized-inference analog (its generate.py re-drives
+the fp torch stack); on TPU v5e the s8xs8 MXU path doubles matmul rate and
+halves the per-token HBM weight traffic that bounds autoregressive decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Mapping
+
+from dalle_tpu.ops.quant import quantize_kernel
+
+# module names whose "kernel" becomes int8 under quant_int8 (must mirror
+# the _proj/QDense sites in models/transformer.py + the DALLE head)
+QUANT_MODULE_NAMES = frozenset(
+    {"qkv", "out", "wi", "wo", "proj_in", "proj_out", "to_logits"}
+)
+
+
+def quantize_decode_params(params):
+    """fp param tree -> tree matching the ``quant_int8=True`` model.
+
+    Returns a new tree; the input is not mutated."""
+
+    def walk(tree, name=None):
+        if isinstance(tree, Mapping):
+            if name in QUANT_MODULE_NAMES and "kernel" in tree:
+                if tree["kernel"].ndim != 2:
+                    raise ValueError(
+                        f"{name}/kernel has shape {tree['kernel'].shape}: "
+                        "stacked (scan-over-layers / pp-staged) checkpoints "
+                        "must be flattened to the plain layout first — load "
+                        "via checkpoint.load_dalle_for_eval, or apply "
+                        "models/scan_params.py / models/pp_params.py "
+                        "converters before quantizing"
+                    )
+                q, scale = quantize_kernel(tree["kernel"])
+                out = {"kernel_q": q, "scale": scale}
+                if "bias" in tree:
+                    out["bias"] = tree["bias"]
+                return out
+            return {k: walk(v, k) for k, v in tree.items()}
+        return tree
+
+    return walk(params)
+
+
+def quant_model_config(cfg):
+    """The decode-time config for a trained ``DALLEConfig``: int8
+    projections on, training-only features untouched."""
+    return dataclasses.replace(cfg, quant_int8=True)

@@ -116,6 +116,9 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # decode-only int8 projections (ops/quant.py QDense): params come from
+    # models/quantize.py, never from training
+    quant_int8: bool = False
     dtype: Any = jnp.float32
 
     @property
@@ -342,6 +345,17 @@ def shift_token_step(
     return jnp.where(idx < t + 1, text_out, img_out)
 
 
+def _proj(cfg, features, name, use_bias=True):
+    """Projection factory: ``nn.Dense``, or its int8 stand-in (ops/quant.py
+    QDense, same module name so param paths stay parallel) under the
+    decode-only ``quant_int8`` config."""
+    if cfg.quant_int8:
+        from dalle_tpu.ops.quant import QDense
+
+        return QDense(features, use_bias=use_bias, dtype=cfg.dtype, name=name)
+    return nn.Dense(features, use_bias=use_bias, dtype=cfg.dtype, name=name)
+
+
 class FeedForward(nn.Module):
     """GEGLU MLP (reference: transformer.py:72-88)."""
 
@@ -351,11 +365,11 @@ class FeedForward(nn.Module):
     def __call__(self, x, deterministic=True):
         c = self.cfg
         inner = c.dim * c.ff_mult
-        y = nn.Dense(inner * 2, dtype=c.dtype, name="wi")(x)
+        y = _proj(c, inner * 2, "wi")(x)
         y, gate = jnp.split(y, 2, axis=-1)
         y = y * jax.nn.gelu(gate, approximate=False)  # exact erf (torch F.gelu parity)
         y = nn.Dropout(c.ff_dropout)(y, deterministic=deterministic)
-        return nn.Dense(c.dim, dtype=c.dtype, name="wo")(y)
+        return _proj(c, c.dim, "wo")(y)
 
 
 class JointAttention(nn.Module):
@@ -372,8 +386,8 @@ class JointAttention(nn.Module):
     def setup(self):
         c = self.cfg
         inner = c.heads * c.dim_head
-        self.to_qkv = nn.Dense(inner * 3, use_bias=False, dtype=c.dtype, name="qkv")
-        self.to_out = nn.Dense(c.dim, dtype=c.dtype, name="out")
+        self.to_qkv = _proj(c, inner * 3, "qkv", use_bias=False)
+        self.to_out = _proj(c, c.dim, "out")
         self.drop = nn.Dropout(c.attn_dropout)
         if c.rotary:
             self._angles = dalle_rotary_angles(
@@ -587,8 +601,8 @@ class CausalSGU(nn.Module):
     def setup(self):
         c = self.cfg
         self.inner = c.dim * c.ff_mult
-        self.proj_in = nn.Dense(self.inner, dtype=c.dtype, name="proj_in")
-        self.proj_out = nn.Dense(c.dim, dtype=c.dtype, name="proj_out")
+        self.proj_in = _proj(c, self.inner, "proj_in")
+        self.proj_out = _proj(c, c.dim, "proj_out")
         self.sgu_norm = nn.LayerNorm(epsilon=1e-5, dtype=c.dtype, name="sgu_norm")
         n = c.seq_len
         # near-zero init + unit bias so the gate starts as identity (gMLP paper)

@@ -1,0 +1,99 @@
+"""Int8 weight quantization for decode: dynamic-activation s8xs8 MXU dots.
+
+The reference's inference story is the fp16/fp32 training stack re-driven
+from a CLI (reference: generate.py:24-130); it has no quantized serving
+path.  On TPU v5e the MXU does s8xs8->s32 at 2x the bf16 rate, and — more
+importantly for autoregressive decode, which is memory-bandwidth-bound —
+int8 weights halve the HBM traffic of streaming every projection matrix
+per generated token.
+
+Scheme (decode-only, never used in training):
+
+  * **weights**: per-output-channel symmetric int8 — ``scale[f] =
+    absmax(W[:, f]) / 127``, ``W_q = round(W / scale)``; applied offline by
+    :func:`quantize_kernel` / ``models/quantize.py`` to a loaded fp
+    checkpoint.
+  * **activations**: dynamic per-token symmetric int8 computed inside the
+    jitted step (one absmax reduce per row — fused by XLA into the
+    surrounding elementwise work).
+  * **dot**: ``lax.dot_general(x_q, W_q, preferred_element_type=int32)``
+    so XLA lowers to the int8 systolic array, then one fp rescale by
+    ``x_scale * w_scale``.
+
+``QDense`` is the drop-in for ``nn.Dense`` under ``quant_int8`` model
+configs: same module *name* (param paths stay recognizable), params
+``kernel_q``/``scale``(/``bias``) instead of ``kernel``(/``bias``).
+Accuracy and structure are pinned by ``tests/test_quant.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-8
+
+
+def quantize_kernel(kernel: jnp.ndarray):
+    """fp [d, f] -> (int8 [d, f], fp32 scale [f]) per-output-channel
+    symmetric."""
+    kernel = jnp.asarray(kernel, jnp.float32)
+    # the EPS-clamped scale is BOTH the divisor and the returned dequant
+    # factor, so all-tiny columns round-trip consistently (to ~0) instead of
+    # being quantized with one scale and dequantized with another
+    scale = jnp.maximum(jnp.max(jnp.abs(kernel), axis=0) / 127.0, EPS)
+    q = jnp.round(kernel / scale)
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
+                dtype=jnp.float32) -> jnp.ndarray:
+    """``x @ dequant(w_q)`` via a true s8xs8->s32 dot.
+
+    x: [..., d] float; w_q: int8 [d, f]; w_scale: fp32 [f].  The activation
+    quantization is dynamic per row (absmax / 127), so no calibration data
+    is needed."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    x_scale = jnp.maximum(absmax / 127.0, EPS)
+    x_q = jnp.clip(jnp.round(xf / x_scale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * x_scale * w_scale.astype(jnp.float32)
+    return out.astype(dtype)
+
+
+class QDense(nn.Module):
+    """``nn.Dense`` stand-in holding an int8 kernel + per-channel scale.
+
+    Used only for decode-time model builds (``quant_int8=True``); params are
+    produced by ``models/quantize.py:quantize_decode_params`` from a trained
+    fp checkpoint, never trained directly (the zero/one inits below exist
+    only so ``init``/``eval_shape`` can describe the tree)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        kernel_q = self.param(
+            "kernel_q", nn.initializers.zeros, (d, self.features), jnp.int8
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        y = int8_matmul(x, kernel_q, scale, dtype=self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias.astype(y.dtype)
+        return y

@@ -33,6 +33,21 @@ _TP_RULES = (
     ("to_logits/kernel", PartitionSpec(None, "tp")),
     ("proj_in/kernel", PartitionSpec(None, "tp")),  # gMLP
     ("proj_out/kernel", PartitionSpec("tp", None)),
+    # int8 decode params (ops/quant.py QDense): kernel_q shards exactly like
+    # the fp kernel it replaces; per-output-channel scales shard with the
+    # output axis of column-parallel projections and replicate for
+    # row-parallel ones (their output axis is unsharded)
+    ("qkv/kernel_q", PartitionSpec(None, "tp")),
+    ("qkv/scale", PartitionSpec("tp")),
+    ("out/kernel_q", PartitionSpec("tp", None)),
+    ("wi/kernel_q", PartitionSpec(None, "tp")),
+    ("wi/scale", PartitionSpec("tp")),
+    ("wo/kernel_q", PartitionSpec("tp", None)),
+    ("to_logits/kernel_q", PartitionSpec(None, "tp")),
+    ("to_logits/scale", PartitionSpec("tp")),
+    ("proj_in/kernel_q", PartitionSpec(None, "tp")),
+    ("proj_in/scale", PartitionSpec("tp")),
+    ("proj_out/kernel_q", PartitionSpec("tp", None)),
 )
 
 # MoE expert weights [E, d, f]: experts over ep, inner dim over tp

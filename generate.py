@@ -63,6 +63,11 @@ def parse_args(argv=None):
     parser.add_argument("--no_ema", action="store_true",
                         help="use raw training params even when the "
                              "checkpoint carries an ema_params subtree")
+    parser.add_argument("--int8", action="store_true",
+                        help="int8-quantize the transformer projections + "
+                             "logits head for decode (s8xs8 MXU dots, "
+                             "halved per-token weight traffic; "
+                             "models/quantize.py)")
     # sharded inference (beyond-reference: the reference generates on one
     # GPU only, generate.py:93-95): shard params over a device mesh and run
     # the scan decode under it — needed for models too big for one chip
@@ -87,6 +92,7 @@ def main(argv=None):
             "CLIP checkpoint separately"
         )
         model, params, vae, vae_params, cfg = _load_reference_pt(args)
+        model, params = _maybe_int8(args, model, params)
         _generate_loop(args, tokenizer, model, params, vae, vae_params,
                        cfg, clip=None, clip_params=None)
         return
@@ -159,8 +165,24 @@ def main(argv=None):
             f"{cfg.text_seq_len}; rerank scores need matching tokenization"
         )
 
+    model, params = _maybe_int8(args, model, params)
     _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                    clip, clip_params)
+
+
+def _maybe_int8(args, model, params):
+    """--int8: rebuild the model with QDense projections and quantize the
+    loaded fp params (models/quantize.py).  VAE and CLIP stay fp — the VAE
+    decoder is conv-dominated and runs once per image, and rerank scores
+    feed a comparison, not a sample."""
+    if not args.int8:
+        return model, params
+    from dalle_tpu.models.quantize import quant_model_config, quantize_decode_params
+
+    model = DALLE(quant_model_config(model.cfg))
+    params = quantize_decode_params(params)
+    print("int8 decode: projections + logits head quantized (s8xs8 MXU dots)")
+    return model, params
 
 
 def _load_reference_pt(args):

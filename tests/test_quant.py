@@ -1,0 +1,200 @@
+"""Int8 decode quantization (ops/quant.py + models/quantize.py): numerics
+bounds, tree transform structure, full-model closeness, and the
+training-guard.  Beyond-reference capability: the reference's generate path
+is fp-only (reference: generate.py:24-130)."""
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.generate import generate_image_codes
+from dalle_tpu.models.quantize import (
+    QUANT_MODULE_NAMES,
+    quant_model_config,
+    quantize_decode_params,
+)
+from dalle_tpu.ops.quant import int8_matmul, quantize_kernel
+
+
+def test_quantize_kernel_error_bound():
+    k = jax.random.normal(jax.random.PRNGKey(0), (32, 48)) * 0.2
+    q, scale = quantize_kernel(k)
+    assert q.dtype == jnp.int8 and scale.shape == (48,)
+    dequant = q.astype(jnp.float32) * scale
+    # symmetric rounding: per-element error <= half a quantization step
+    err = np.abs(np.asarray(dequant - k))
+    assert (err <= np.asarray(scale) / 2 + 1e-7).all()
+
+
+def test_int8_matmul_close_to_fp():
+    kx, kw = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(kx, (4, 10, 64))
+    w = jax.random.normal(kw, (64, 128)) * 0.1
+    q, scale = quantize_kernel(w)
+    got = np.asarray(int8_matmul(x, q, scale))
+    want = np.asarray(x @ w)
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 0.02, rel
+
+
+def _tiny_cfg(**kw):
+    base = dict(
+        num_text_tokens=50, text_seq_len=8, num_image_tokens=32,
+        image_fmap_size=4, dim=32, depth=2, heads=2, dim_head=16,
+        attn_types=("full", "axial_row"),
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def _fp_model_and_params(cfg=None):
+    cfg = cfg or _tiny_cfg()
+    model = DALLE(cfg)
+    k = jax.random.PRNGKey(2)
+    text = jax.random.randint(jax.random.fold_in(k, 1), (2, cfg.text_seq_len), 1, 50)
+    codes = jax.random.randint(
+        jax.random.fold_in(k, 2), (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = model.init(jax.random.fold_in(k, 3), text, codes)["params"]
+    return model, params, text, codes
+
+
+def test_quantize_decode_params_structure():
+    model, params, _, _ = _fp_model_and_params()
+    qparams = quantize_decode_params(params)
+    # head converted, biases kept, non-projection leaves untouched
+    assert qparams["to_logits"]["kernel_q"].dtype == jnp.int8
+    assert "kernel" not in qparams["to_logits"]
+    assert qparams["to_logits"]["bias"].shape == params["to_logits"]["bias"].shape
+    np.testing.assert_array_equal(
+        np.asarray(qparams["text_emb"]["embedding"]),
+        np.asarray(params["text_emb"]["embedding"]),
+    )
+    attn = qparams["transformer"]["layer_0_attn"]["fn"]
+    assert attn["qkv"]["kernel_q"].dtype == jnp.int8
+    assert "bias" not in attn["qkv"]  # qkv is bias-free in fp too
+    assert attn["out"]["scale"].dtype == jnp.float32
+    # the quant tree matches what the quant model expects, leaf for leaf
+    qmodel = DALLE(quant_model_config(model.cfg))
+    text0 = jnp.ones((1, model.cfg.text_seq_len), jnp.int32)
+    codes0 = jnp.zeros((1, model.cfg.image_seq_len), jnp.int32)
+    expect = jax.eval_shape(
+        lambda: qmodel.init({"params": jax.random.PRNGKey(0)}, text0, codes0)
+    )["params"]
+    got_paths = {p for p, _ in jax.tree_util.tree_leaves_with_path(qparams)}
+    want_paths = {p for p, _ in jax.tree_util.tree_leaves_with_path(expect)}
+    assert got_paths == want_paths
+
+
+def test_quant_model_logits_close_to_fp():
+    model, params, text, codes = _fp_model_and_params()
+    fp_logits = np.asarray(model.apply({"params": params}, text, codes))
+    qmodel = DALLE(quant_model_config(model.cfg))
+    q_logits = np.asarray(
+        qmodel.apply({"params": quantize_decode_params(params)}, text, codes)
+    )
+    allowed = fp_logits > -1e29  # compare inside the logits mask only
+    a, b = fp_logits[allowed], q_logits[allowed]
+    rel = np.linalg.norm(a - b) / np.linalg.norm(a)
+    assert rel < 0.05, rel
+    cos = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.995, cos
+
+
+def test_quant_decode_runs_and_is_deterministic():
+    model, params, text, _ = _fp_model_and_params()
+    qmodel = DALLE(quant_model_config(model.cfg))
+    qparams = quantize_decode_params(params)
+    key = jax.random.PRNGKey(5)
+    a = np.asarray(generate_image_codes(qmodel, qparams, text, key))
+    b = np.asarray(generate_image_codes(qmodel, qparams, text, key))
+    assert a.shape == (2, model.cfg.image_seq_len)
+    assert (a >= 0).all() and (a < model.cfg.num_image_tokens).all()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_quant_model_rejects_training():
+    model, params, text, codes = _fp_model_and_params()
+    qmodel = DALLE(quant_model_config(model.cfg))
+    with pytest.raises(AssertionError, match="decode-only"):
+        qmodel.apply(
+            {"params": quantize_decode_params(params)}, text, codes,
+            return_loss=True,
+        )
+
+
+def test_gmlp_projections_quantize():
+    cfg = _tiny_cfg(attn_types=("full", "mlp"))
+    model, params, text, codes = _fp_model_and_params(cfg)
+    qparams = quantize_decode_params(params)
+    sgu = qparams["transformer"]["layer_1_attn"]["fn"]
+    assert sgu["proj_in"]["kernel_q"].dtype == jnp.int8
+    assert sgu["proj_out"]["kernel_q"].dtype == jnp.int8
+    # spatial gate table stays fp
+    assert sgu["spatial_w"].dtype == params[
+        "transformer"]["layer_1_attn"]["fn"]["spatial_w"].dtype
+    qmodel = DALLE(quant_model_config(cfg))
+    fp_logits = np.asarray(model.apply({"params": params}, text, codes))
+    q_logits = np.asarray(qmodel.apply({"params": qparams}, text, codes))
+    allowed = fp_logits > -1e29
+    rel = np.linalg.norm(
+        fp_logits[allowed] - q_logits[allowed]
+    ) / np.linalg.norm(fp_logits[allowed])
+    assert rel < 0.05, rel
+
+
+def test_quantize_kernel_tiny_columns_consistent():
+    # all-tiny column: quantize and dequant must use the SAME (clamped)
+    # scale, so the round-trip stays within half a step of the original
+    k = jnp.concatenate(
+        [jnp.full((8, 1), 1e-9), jnp.ones((8, 1))], axis=1
+    )
+    q, scale = quantize_kernel(k)
+    dequant = np.asarray(q.astype(jnp.float32) * scale)
+    err = np.abs(dequant - np.asarray(k))
+    assert (err <= np.asarray(scale) / 2 + 1e-12).all()
+
+
+def test_quantize_rejects_stacked_kernels():
+    cfg = _tiny_cfg(attn_types=("full",), scan_layers=True)
+    model, params, _, _ = _fp_model_and_params(cfg)
+    with pytest.raises(ValueError, match="flattened to the plain layout"):
+        quantize_decode_params(params)
+    # the documented route works: unroll first, then quantize
+    from dalle_tpu.models.scan_params import unrolled_eval_setup
+
+    plain_cfg, convert = unrolled_eval_setup(cfg)
+    qparams = quantize_decode_params(convert(params))
+    assert qparams["transformer"]["layer_0_attn"]["fn"]["qkv"][
+        "kernel_q"].dtype == jnp.int8
+
+
+def test_int8_params_get_tp_partition_specs():
+    """--int8 --mesh_tp must shard kernel_q/scale like the fp kernels they
+    replace (parallel/partition.py rules), not silently replicate."""
+    from dalle_tpu.parallel import make_mesh, param_specs
+
+    model, params, _, _ = _fp_model_and_params()
+    qparams = quantize_decode_params(params)
+    mesh = make_mesh(dp=2, tp=2)
+    specs = param_specs(qparams, mesh)
+    attn = specs["transformer"]["layer_0_attn"]["fn"]
+    assert tuple(attn["qkv"]["kernel_q"]) == (None, "tp")
+    assert tuple(attn["qkv"]["scale"]) == ("tp",)
+    assert tuple(attn["out"]["kernel_q"])[0] == "tp"
+    assert tuple(specs["to_logits"]["kernel_q"]) == (None, "tp")
+
+
+def test_no_fp_kernel_survives_under_quant_names():
+    """After the transform, no ``kernel`` leaf remains under any module the
+    quant model builds as QDense — a silent skip would crash (or worse,
+    skew) at apply time."""
+    _, params, _, _ = _fp_model_and_params()
+    qparams = quantize_decode_params(params)
+    for path, _ in jax.tree_util.tree_leaves_with_path(qparams):
+        keys = [str(getattr(p, "key", p)) for p in path]
+        if len(keys) >= 2 and keys[-2] in QUANT_MODULE_NAMES:
+            assert keys[-1] != "kernel", keys
