@@ -125,23 +125,39 @@ def main():
     tx = make_optimizer(3e-4, clip_grad_norm=None)
     jt = jnp.asarray(text)
     jc = jnp.asarray(codes)
-    _, opt_state = init_train_state(model, tx, mesh, {"params": jax.random.PRNGKey(0)}, jt, jc)
-    step = make_dalle_train_step(model, tx, mesh)
-    # the step DONATES params/opt_state: train on a mesh-placed copy and
-    # keep the original for the generation phase
-    p = shard_params(jax.tree_util.tree_map(jnp.copy, params), mesh)
     key = jax.random.PRNGKey(0)
-    p, opt_state, loss = step(p, opt_state, None, jt, jc, key)  # compile
-    jax.block_until_ready(loss)
-    # one more warm call so the timing loop sees the steady-state input
-    # shardings (the first call's freshly-converted params were unsharded)
-    p, opt_state, loss = step(p, opt_state, None, jt, jc, key)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        p, opt_state, loss = step(p, opt_state, None, jt, jc, jax.random.fold_in(key, i))
-    jax.block_until_ready(loss)
-    ours_train_s = (time.perf_counter() - t0) / iters
+
+    def time_train(model_variant):
+        """One timing protocol for every variant: init opt state, train on
+        a donated mesh-placed COPY (the original params stay for the
+        generation phase), compile call + one extra warm call so the loop
+        sees steady-state input shardings (the first call's
+        freshly-converted params were unsharded), then the timed loop."""
+        _, opt_state = init_train_state(
+            model_variant, tx, mesh, {"params": jax.random.PRNGKey(0)}, jt, jc
+        )
+        step = make_dalle_train_step(model_variant, tx, mesh)
+        p = shard_params(jax.tree_util.tree_map(jnp.copy, params), mesh)
+        for _ in range(2):
+            p, opt_state, loss = step(p, opt_state, None, jt, jc, key)
+            jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for i in range(iters):
+            p, opt_state, loss = step(
+                p, opt_state, None, jt, jc, jax.random.fold_in(key, i)
+            )
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / iters
+
+    ours_train_s = time_train(model)
+    # fused range-split CE variant (ops/fused_ce.py) — same model, same
+    # loss number (pinned differentially in test_golden_dalle), fewer
+    # head FLOPs and no [b, n, V] logits materialization
+    import dataclasses
+
+    ours_fused_s = time_train(
+        DALLE(dataclasses.replace(cfg, loss_chunk=max(args.text_seq_len, 32)))
+    )
 
     print(json.dumps({
         "phase": "train_step",
@@ -149,7 +165,9 @@ def main():
                    "seq": cfg.total_seq_len, "batch": args.batch},
         "reference_s": round(ref_train_s, 4),
         "ours_s": round(ours_train_s, 4),
+        "ours_fused_ce_s": round(ours_fused_s, 4),
         "speedup": round(ref_train_s / ours_train_s, 2),
+        "speedup_fused": round(ref_train_s / ours_fused_s, 2),
         "note": caveat,
     }), flush=True)
 
