@@ -431,6 +431,15 @@ def main():
         print(f"phase {name} (timeout {timeout_s}s)...", file=sys.stderr, flush=True)
         res = _run_phase(name, min(timeout_s, remaining))
         phases[name] = res
+        if name == "generate_int8" and res.get("ok"):
+            # cross-rung ratio (fp rung ran just before): attached BEFORE
+            # the rung persists so rungs.jsonl carries it even if the run
+            # dies later
+            g = phases.get("generate", {})
+            if g.get("ok") and g.get("imgs_per_sec"):
+                res["int8_speedup_vs_fp"] = round(
+                    res["imgs_per_sec"] / g["imgs_per_sec"], 2
+                )
         _persist_rung(run_id, name, res)
         print(f"phase {name}: {'ok' if res['ok'] else res['error']} "
               f"({res.get('phase_s')}s)", file=sys.stderr, flush=True)
@@ -442,14 +451,6 @@ def main():
                 res["reprobe_error"] = reprobe_err
             else:
                 res["reprobe"] = "device still healthy"
-
-    # int8 decode speedup is a cross-rung ratio: computed here so the int8
-    # rung never has to re-time the fp pipeline (and can't sink it)
-    g, gi = phases.get("generate"), phases.get("generate_int8")
-    if g and g.get("ok") and gi and gi.get("ok") and g.get("imgs_per_sec"):
-        gi["int8_speedup_vs_fp"] = round(
-            gi["imgs_per_sec"] / g["imgs_per_sec"], 2
-        )
 
     # headline = best throughput among the flagship phases; tiny is the
     # fallback of last resort.  A Mosaic hang in train_flash can never

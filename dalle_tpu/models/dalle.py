@@ -82,6 +82,7 @@ class DALLEConfig:
     sandwich_norm: bool = False
     shift_tokens: bool = False
     rotary_emb: bool = False
+    rotary_v: bool = True  # reference rotates v too (attention.py:32-35)
     reversible: bool = False
     use_remat: bool = False
     remat_policy: str = "full"  # "full" | "dots" | "dots_no_batch"
@@ -145,6 +146,7 @@ class DALLEConfig:
             remat_policy=self.remat_policy,
             scan_layers=self.scan_layers,
             rotary=self.rotary_emb,
+            rotary_v=self.rotary_v,
             shift_tokens=self.shift_tokens,
             sandwich_norm=self.sandwich_norm,
             kernel_size=self.kernel_size,

@@ -93,12 +93,10 @@ def dalle_config_from_ref(
     if kw.get("attn_types"):
         kw["attn_types"] = tuple(kw["attn_types"])
     kw["loss_img_weight"] = float(kw.get("loss_img_weight", 7))
-    if kw.get("rotary_emb"):
-        warnings.warn(
-            "reference checkpoint uses rotary_emb: our rotary frequency "
-            "allocation deviates from rotary-embedding-torch (see "
-            "ops/rotary.py docstring) — converted outputs will differ"
-        )
+    # rotary tables are exact-parity with the reference's
+    # rotary-embedding-torch construction incl. v-rotation (ops/rotary.py,
+    # pinned differentially in tests/test_golden_dalle.py) — converted
+    # rotary checkpoints reproduce
     return DALLEConfig(
         num_image_tokens=num_image_tokens,
         image_fmap_size=image_fmap_size,

@@ -69,6 +69,10 @@ class TransformerConfig:
     #   "dots_no_batch" — save only batch-free matmuls (the usual TP choice)
     remat_policy: str = "full"
     rotary: bool = False
+    # rotate v with the same table, as the reference does
+    # (attention.py:32-35); False = standard q/k-only RoPE (cheaper, but
+    # rotary checkpoints stop being reference-equivalent)
+    rotary_v: bool = True
     shift_tokens: bool = False
     sandwich_norm: bool = False
     # conv_like params (reference: attention.py:90-113)
@@ -409,6 +413,8 @@ class JointAttention(nn.Module):
         if self._angles is not None:
             ang = jnp.asarray(self._angles)
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
+            if c.rotary_v:  # reference rotates v too (attention.py:32-35)
+                v = apply_rotary(v, ang)
         t, f = c.text_seq_len, c.fmap_size
         if not c.causal:
             # bidirectional (CLIP encoders): flash handles the ragged
@@ -562,6 +568,8 @@ class JointAttention(nn.Module):
         if self._angles is not None:
             ang = jnp.asarray(self._angles)[:L]
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
+            if c.rotary_v:
+                v = apply_rotary(v, ang)
         ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(c.dtype), 0, axis=2)
         cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(c.dtype), 0, axis=2)
         mask = jnp.asarray(_static_mask(c, self.attn_type)[:L, :L])
@@ -578,6 +586,8 @@ class JointAttention(nn.Module):
         if self._angles is not None:
             ang = jax.lax.dynamic_slice_in_dim(jnp.asarray(self._angles), idx, 1)
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
+            if c.rotary_v:
+                v = apply_rotary(v, ang)
         ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(c.dtype), idx, axis=2)
         cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(c.dtype), idx, axis=2)
         mask_table = jnp.asarray(_static_mask(c, self.attn_type))

@@ -2,21 +2,29 @@
 
 Re-designs the reference's hybrid rotary scheme
 (reference: dalle_pytorch/transformer.py:202-228) TPU-first: all angles are
-precomputed once as a static ``[seq_len, half_rot_dim]`` table at model build
-time, so inside ``jit`` the application is a single fused multiply-add — no
-gather, no dynamic shapes.
+precomputed once as a static ``[seq_len, R]`` table at model build time, so
+inside ``jit`` the application is a single fused multiply-add — no gather,
+no dynamic shapes.
 
-Scheme (matching the reference's capability):
-  * ``dim_head // 3`` (rounded down to even) channels get 1-D rotary over
-    *text* positions; image positions are pinned to a constant far position
-    (8192) for those channels (reference: transformer.py:214).
-  * 2 * (dim_head // 3) channels get 2-D axial rotary over the image feature
-    map with coordinates in ``linspace(-1, 1)``; text positions are pinned to
-    the constant -10 (reference: transformer.py:221).
-  * Remaining channels are left unrotated.
+Exact parity with the reference's tables (pinned differentially in
+``tests/test_golden_dalle.py`` against a faithful
+rotary-embedding-torch stand-in, ``tests/torch_refs.py``):
 
-Angles are applied to q and k only (standard RoPE; the reference also rotates
-v, which mixes value channels for no modelling benefit — deliberate deviation).
+  * ``rot_dim = dim_head // 3`` (odd allowed, reference: transformer.py:206);
+  * text band: 'lang' frequencies ``theta^(-arange(0, rot_dim, 2)/rot_dim)``
+    over *text* positions — image positions pinned to the constant far
+    position 8192 (reference: transformer.py:214);
+  * image band: per-axis 'pixel' frequencies
+    ``linspace(1, max_freq/2, rot_dim//2) * pi`` (``max_freq=10``) over
+    grid coordinates in ``linspace(-1, 1)`` — text positions pinned to the
+    constant -10 (reference: transformer.py:218-221);
+  * interleaved-pair application: angle column ``j`` rotates channels
+    ``(2j, 2j+1)`` — the library's ``(n r)``-repeat + rotate_half pairing.
+
+The reference also rotates **v** with the same table
+(reference: attention.py:32-35); ``TransformerConfig.rotary_v`` (default
+True) matches that.  Disabling it is standard RoPE (q/k only) — slightly
+cheaper, but rotary checkpoints then stop being reference-equivalent.
 """
 
 from __future__ import annotations
@@ -28,10 +36,7 @@ import numpy as np
 
 TEXT_CONST_IMG_POS = 8192.0  # image tokens' constant position in text freqs
 IMG_CONST_TEXT_COORD = -10.0  # text tokens' constant coordinate in image freqs
-
-
-def _even(n: int) -> int:
-    return n - (n % 2)
+PIXEL_MAX_FREQ = 10.0  # rotary-embedding-torch freqs_for='pixel' default
 
 
 @functools.lru_cache(maxsize=32)
@@ -41,40 +46,31 @@ def dalle_rotary_angles(
     dim_head: int,
     theta: float = 10000.0,
 ) -> np.ndarray:
-    """Angle table ``[seq_len, R]`` where ``2R`` leading head channels rotate.
-
-    Parity scope (advisor round-3): the POSITION GEOMETRY matches the
-    reference (transformer.py:206-227) and is what the differential tests
-    pin; the frequency details deviate deliberately — channel allocation
-    is ``_even(dim_head // 3)`` per band (the reference's
-    rotary-embedding-torch allows odd ``rot_dim``), and the image axial
-    band's pixel-style linspace tops out at ``fmap_size / 2`` cycles
-    rather than the external lib's fixed ``max_freq=10``.  Checkpoints
-    trained with our rotary are self-consistent; converted reference
-    rotary checkpoints will NOT reproduce (models/interop.py warns).
+    """Angle table ``[seq_len, R]``; angle column ``j`` rotates head
+    channels ``(2j, 2j+1)``, channels ``>= 2R`` pass through unrotated.
 
     Geometry: the text region spans ``text_seq_len + 1`` positions
     ([bos | text] — reference ``text_len = seq_len - img_seq_len + 1``),
-    image grid cell ``g`` sits
-    at position ``text_seq_len + 1 + g``, and the virtual final cell is
-    cropped (reference ``pos_emb[:-1]``).
+    image grid cell ``g`` sits at position ``text_seq_len + 1 + g``, and
+    the virtual final cell is cropped (reference ``pos_emb[:-1]``).
     """
     n_img = fmap_size * fmap_size
     seq_len = text_seq_len + n_img
     tl = text_seq_len + 1  # [bos | text]
     ext = tl + n_img  # incl. the virtual final grid cell
-    dt = _even(dim_head // 3)  # 1-D text channels
-    da = _even(dim_head // 3)  # per-axis 2-D image channels (row and col each)
+    rot_dim = dim_head // 3  # reference: transformer.py:206 (odd allowed)
 
     pos = np.arange(ext, dtype=np.float64)
     is_img = pos >= tl
 
-    # --- text 1-D rotary ---------------------------------------------------
-    inv_freq = theta ** (-np.arange(0, dt, 2, dtype=np.float64) / max(dt, 1))
+    # --- text 1-D rotary ('lang' freqs) ------------------------------------
+    inv_freq = theta ** (
+        -np.arange(0, rot_dim, 2, dtype=np.float64) / max(rot_dim, 1)
+    )
     tpos = np.where(is_img, TEXT_CONST_IMG_POS, pos)
-    text_angles = tpos[:, None] * inv_freq[None, :]  # [seq, dt/2]
+    text_angles = tpos[:, None] * inv_freq[None, :]  # [seq, ceil(rot_dim/2)]
 
-    # --- image 2-D axial rotary (pixel-style freqs) ------------------------
+    # --- image 2-D axial rotary ('pixel' freqs) ----------------------------
     img_idx = np.maximum(pos - tl, 0).astype(np.int64)
     row = img_idx // fmap_size
     col = img_idx % fmap_size
@@ -83,12 +79,15 @@ def dalle_rotary_angles(
     )
     rc = np.where(is_img, coords[row], IMG_CONST_TEXT_COORD)
     cc = np.where(is_img, coords[col], IMG_CONST_TEXT_COORD)
-    ax_freq = np.linspace(1.0, max(fmap_size / 2.0, 1.0), da // 2) * np.pi
+    ax_freq = np.linspace(1.0, PIXEL_MAX_FREQ / 2.0, rot_dim // 2) * np.pi
     row_angles = rc[:, None] * ax_freq[None, :]
     col_angles = cc[:, None] * ax_freq[None, :]
 
     angles = np.concatenate([text_angles, row_angles, col_angles], axis=-1)
-    assert 2 * angles.shape[-1] <= dim_head
+    assert 2 * angles.shape[-1] <= dim_head, (
+        f"rotary bands ({2 * angles.shape[-1]} channels) exceed "
+        f"dim_head={dim_head}"
+    )
     return angles[:seq_len].astype(np.float32)  # crop the virtual cell
 
 

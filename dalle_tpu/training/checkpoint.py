@@ -87,7 +87,7 @@ def save_checkpoint(
             # v2: ops/masks.py t -> t+1 region-geometry fix (round 3)
             # changed shift/axial/conv/rotary numerics — v1 checkpoints
             # load but decode differently (load_meta warns)
-            "format": "dalle_tpu/v2",
+            "format": "dalle_tpu/v3",
             "hparams": hparams,
             "vae_hparams": vae_hparams,
             "epoch": epoch,
@@ -217,9 +217,9 @@ def load_meta(path: str) -> dict:
     meta = json.loads((Path(path) / "meta.json").read_text())
     # the geometry fix only touches the DALLE joint-sequence ops — a v1
     # VAE/CLIP checkpoint is unaffected, so gate on DALLE-shaped hparams
-    if meta.get("format") == "dalle_tpu/v1" and "text_seq_len" in (
-        meta.get("hparams") or {}
-    ) and "image_fmap_size" in (meta.get("hparams") or {}):
+    hp = meta.get("hparams") or {}
+    is_dalle = "text_seq_len" in hp and "image_fmap_size" in hp
+    if meta.get("format") == "dalle_tpu/v1" and is_dalle:
         import warnings
 
         warnings.warn(
@@ -227,6 +227,21 @@ def load_meta(path: str) -> dict:
             "text-region geometry fix (ops/masks.py t -> t+1); it loads, "
             "but shift/axial/conv/rotary models decode differently than "
             "they trained",
+            stacklevel=2,
+        )
+    if (
+        meta.get("format") in ("dalle_tpu/v1", "dalle_tpu/v2")
+        and is_dalle
+        and hp.get("rotary_emb")
+    ):
+        import warnings
+
+        warnings.warn(
+            f"{path}: pre-v3 rotary checkpoint — trained before the rotary "
+            "tables moved to exact reference parity (ops/rotary.py: odd "
+            "rot_dim band widths, pixel max_freq=10, v-rotation); it "
+            "loads, but decodes differently than it trained.  Set "
+            "rotary_v=False and retrain, or retrain under v3",
             stacklevel=2,
         )
     return meta

@@ -8,14 +8,16 @@ positional embeddings, the transformer stack (PreNorm/attention/GEGLU/
 LayerScale), the logits mask, and the 1:7 weighted loss are all REAL
 reference code (dalle_pytorch/dalle_pytorch.py:309-591).
 
-Scope note: three reference deps are absent from this image.  Two are
-unused for this config (rotary-embedding-torch, g-mlp-pytorch — stubbed
-as inert).  The third, axial_positional_embedding, IS used and is stubbed
-faithfully: per-axis parameter tables broadcast-summed over the grid —
-the exact semantics of the external lib's summed mode for
-``axial_shape=(f, f)`` and of our first-party implementation
-(models/dalle.py AxialPositionalEmbedding).  Everything else executed by
-the reference model is its own code.
+Scope note: three reference deps are absent from this image.
+g-mlp-pytorch is unused for these configs (stubbed inert).  The other two
+are stubbed FAITHFULLY so the reference code paths that use them run for
+real: axial_positional_embedding (per-axis parameter tables
+broadcast-summed over the grid — the external lib's summed mode for
+``axial_shape=(f, f)``) and rotary-embedding-torch (torch_refs.py:
+'lang'/'pixel' frequency schedules, interleaved repeat, rotate_half — the
+0.1.x-era semantics the reference was written against), which powers the
+``rotary`` test case pinning our tables + v-rotation differentially.
+Everything else executed by the reference model is its own code.
 """
 
 import sys
@@ -55,9 +57,20 @@ def _install_reference():
     ax = types.ModuleType("axial_positional_embedding")
     ax.AxialPositionalEmbedding = AxialPositionalEmbedding
     stubs["axial_positional_embedding"] = ax
+    from torch_refs import (
+        RefRotaryEmbedding,
+        ref_apply_rotary_emb,
+        ref_broadcat,
+    )
+
     for name, attrs in [
+        # faithful rotary stand-in (torch_refs.py): lets the reference run
+        # with rotary_emb=True so the differential tests pin our rotary
+        # tables against the reference's actual ones
         ("rotary_embedding_torch",
-         {"RotaryEmbedding": object, "broadcat": None, "apply_rotary_emb": None}),
+         {"RotaryEmbedding": RefRotaryEmbedding,
+          "broadcat": ref_broadcat,
+          "apply_rotary_emb": ref_apply_rotary_emb}),
         ("g_mlp_pytorch", {"gMLPBlock": object}),
         ("omegaconf", {"OmegaConf": object}),
     ]:
@@ -117,8 +130,11 @@ def _map_transformer_layers(sd, prefix, depth, reversible=False):
         {"shift_tokens": True},  # NB the reference DEFAULTS this on
         {"reversible": True},  # ReversibleSequence vs our coupling chain
         {"sandwich_norm": True, "stable": True},  # norm_out + DivideMax + 0.1/0.9
+        # rotary tables + v-rotation vs the faithful rotary-embedding-torch
+        # stand-in (torch_refs.py) — frequency parity, not just geometry
+        {"rotary_emb": True},
     ],
-    ids=["plain", "shift", "reversible", "sandwich_stable"],
+    ids=["plain", "shift", "reversible", "sandwich_stable", "rotary"],
 )
 def test_dalle_forward_matches_reference(rng, flags):
     """Pins our forward to the reference's across its execution flags (our
@@ -136,12 +152,12 @@ def test_dalle_forward_matches_reference(rng, flags):
     rvae = RefVAE(
         image_size=16, num_layers=2, num_tokens=32, codebook_dim=16, hidden_dim=8
     )
-    kw = dict(shift_tokens=False)
+    kw = dict(shift_tokens=False, rotary_emb=False)
     kw.update(flags)
     ref = RefDALLE(
         dim=32, vae=rvae, num_text_tokens=50, text_seq_len=8, depth=2,
         heads=2, dim_head=16, attn_types=("full",), loss_img_weight=7,
-        rotary_emb=False, **kw,
+        **kw,
     ).eval()
 
     cfg = DALLEConfig(

@@ -363,3 +363,61 @@ class TVQModel(nn.Module):
         z = emb(ids).reshape(b, fmap, fmap, -1).permute(0, 3, 1, 2)
         x = self.decoder(self.post_quant_conv(z))
         return (x.clamp(-1.0, 1.0) + 1.0) * 0.5
+
+
+# ---------------------- rotary-embedding-torch stand-in --------------------
+# Faithful re-implementation of the external library's public algorithm
+# (lucidrains/rotary-embedding-torch, MIT; the 0.1.x-0.2.x era semantics the
+# reference was written against — unpinned in /root/reference/setup.py:27):
+# 'lang'/'pixel' frequency schedules, interleaved (n r)-repeat, rotate_half
+# pairing, and shape-broadcasting concat.  Used by the golden differential
+# tests so the reference DALLE can run with rotary_emb=True instead of an
+# inert stub, pinning OUR rotary (dalle_tpu/ops/rotary.py) against the
+# reference's actual tables.
+
+
+class RefRotaryEmbedding(nn.Module):
+    def __init__(self, dim, freqs_for="lang", theta=10000, max_freq=10):
+        super().__init__()
+        if freqs_for == "lang":
+            freqs = 1.0 / (
+                theta ** (torch.arange(0, dim, 2).float() / dim)
+            )
+        elif freqs_for == "pixel":
+            freqs = torch.linspace(1.0, max_freq / 2, dim // 2) * math.pi
+        else:
+            raise ValueError(freqs_for)
+        self.register_buffer("freqs", freqs)
+
+    def forward(self, t):
+        freqs = torch.einsum("..., f -> ... f", t.float(), self.freqs)
+        # interleaved repeat: freq j covers channels (2j, 2j+1)
+        return freqs.repeat_interleave(2, dim=-1)
+
+
+def ref_rotate_half(x):
+    x = x.reshape(*x.shape[:-1], -1, 2)
+    x1, x2 = x.unbind(dim=-1)
+    return torch.stack((-x2, x1), dim=-1).reshape(*x.shape[:-2], -1)
+
+
+def ref_apply_rotary_emb(freqs, t, start_index=0):
+    rot_dim = freqs.shape[-1]
+    end_index = start_index + rot_dim
+    t_left = t[..., :start_index]
+    t_mid = t[..., start_index:end_index]
+    t_right = t[..., end_index:]
+    t_mid = (t_mid * freqs.cos()) + (ref_rotate_half(t_mid) * freqs.sin())
+    return torch.cat((t_left, t_mid, t_right), dim=-1)
+
+
+def ref_broadcat(tensors, dim=-1):
+    shapes = [list(t.shape) for t in tensors]
+    nd = len(shapes[0])
+    dim = dim if dim >= 0 else nd + dim
+    target = [max(s[i] for s in shapes) for i in range(nd)]
+    expanded = [
+        t.expand(*[target[i] if i != dim else t.shape[i] for i in range(nd)])
+        for t in tensors
+    ]
+    return torch.cat(expanded, dim=dim)
