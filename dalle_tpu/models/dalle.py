@@ -107,6 +107,7 @@ class DALLEConfig:
     # decode-only int8 projections + head (ops/quant.py); params from
     # models/quantize.py:quantize_decode_params, never from training
     quant_int8: bool = False
+    quant_mode: str = "dynamic"  # "dynamic" (s8xs8) | "weight_only" (Pallas)
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -166,6 +167,7 @@ class DALLEConfig:
             moe_capacity_factor=self.moe_capacity_factor,
             moe_aux_weight=self.moe_aux_weight,
             quant_int8=self.quant_int8,
+            quant_mode=self.quant_mode,
             dtype=self.dtype,
         )
 
@@ -218,7 +220,10 @@ class DALLE(nn.Module):
         if c.quant_int8:
             from dalle_tpu.ops.quant import QDense
 
-            self.to_logits = QDense(c.total_tokens, dtype=c.dtype, name="to_logits")
+            self.to_logits = QDense(
+                c.total_tokens, dtype=c.dtype, mode=c.quant_mode,
+                name="to_logits",
+            )
         else:
             self.to_logits = VocabHead(
                 c.dim, c.total_tokens, dtype=c.dtype, name="to_logits"

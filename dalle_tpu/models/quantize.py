@@ -56,7 +56,10 @@ def quantize_decode_params(params):
     return walk(params)
 
 
-def quant_model_config(cfg):
+def quant_model_config(cfg, mode: str = "dynamic"):
     """The decode-time config for a trained ``DALLEConfig``: int8
-    projections on, training-only features untouched."""
-    return dataclasses.replace(cfg, quant_int8=True)
+    projections on, training-only features untouched.  ``mode``:
+    "dynamic" (s8xs8 MXU dots) or "weight_only" (Pallas in-VMEM dequant,
+    no activation quant error)."""
+    assert mode in ("dynamic", "weight_only"), mode
+    return dataclasses.replace(cfg, quant_int8=True, quant_mode=mode)

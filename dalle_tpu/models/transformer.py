@@ -123,6 +123,7 @@ class TransformerConfig:
     # decode-only int8 projections (ops/quant.py QDense): params come from
     # models/quantize.py, never from training
     quant_int8: bool = False
+    quant_mode: str = "dynamic"  # "dynamic" (s8xs8) | "weight_only" (Pallas)
     dtype: Any = jnp.float32
 
     @property
@@ -356,7 +357,8 @@ def _proj(cfg, features, name, use_bias=True):
     if cfg.quant_int8:
         from dalle_tpu.ops.quant import QDense
 
-        return QDense(features, use_bias=use_bias, dtype=cfg.dtype, name=name)
+        return QDense(features, use_bias=use_bias, dtype=cfg.dtype,
+                      mode=cfg.quant_mode, name=name)
     return nn.Dense(features, use_bias=use_bias, dtype=cfg.dtype, name=name)
 
 

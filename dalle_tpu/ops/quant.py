@@ -69,17 +69,97 @@ def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
     return out.astype(dtype)
 
 
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def _dequant_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
+    # dequantize the int8 weight block IN VMEM — HBM streamed int8 bytes,
+    # the bf16/f32 weights never exist outside this block's registers
+    w = w_ref[...].astype(x_ref.dtype) * s_ref[...].astype(x_ref.dtype)
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...], w,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+def weight_only_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
+                       dtype=jnp.float32, block_m: int = 256,
+                       block_f: int = 512, force_kernel: bool = False) -> jnp.ndarray:
+    """``x @ dequant(w_q)`` with activations at full precision (no dynamic
+    quantization error) and int8 weights streamed from HBM.
+
+    On TPU this is a Pallas kernel that dequantizes each weight block IN
+    VMEM: XLA's equivalent (``x @ (w_q.astype(b16) * scale)``) first
+    materializes the dequantized weight tensor in HBM, forfeiting the
+    bandwidth saving that motivates weight-only quantization for decode.
+    Off-TPU the plain jnp expression (bit-identical — pinned by
+    ``tests/test_quant.py``) is used directly; ``force_kernel=True`` runs
+    the kernel in interpreter mode anyway (test hook).
+
+    Blocks are lane/sublane-aligned and the grid is ``cdiv``-padded, so no
+    divisibility of m or f is required.  x: [..., d]; w_q: int8 [d, f];
+    w_scale: fp32 [f].  d is kept whole per block (VMEM budget:
+    ``d*block_f`` int8 + ``block_m*d`` activations).
+
+    NOTE: not GSPMD-partitionable — callers must not run it on
+    tp-sharded weights (generate.py rejects --int8_mode weight_only with
+    --mesh_*).
+    """
+    from dalle_tpu.ops.flash import _interpret
+
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    f = w_q.shape[1]
+    x2 = x.reshape(-1, d).astype(dtype)
+    m = x2.shape[0]
+    if m == 0:
+        return jnp.zeros((*lead, f), dtype)
+    if _interpret() and not force_kernel:
+        # off-TPU: interpreter-mode pallas would unroll the whole grid into
+        # the jaxpr; the jnp expression is the same math
+        out = x2 @ (w_q.astype(dtype) * w_scale.astype(dtype)[None, :])
+        return out.reshape(*lead, f)
+    from jax.experimental import pallas as pl
+
+    # fixed aligned blocks + cdiv grid: Mosaic pads boundary blocks, and
+    # padding is harmless here — pad rows of x only affect dropped output
+    # rows, pad cols of w only dropped output cols (d is never blocked)
+    bm = min(block_m, _round_up(m, 8))
+    bf = min(block_f, _round_up(f, 128))
+    out = pl.pallas_call(
+        _dequant_matmul_kernel,
+        grid=(pl.cdiv(m, bm), pl.cdiv(f, bf)),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bf), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bf), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bf), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, f), dtype),
+        interpret=_interpret(),
+    )(x2, w_q, w_scale.reshape(1, f).astype(jnp.float32))
+    return out.reshape(*lead, f)
+
+
 class QDense(nn.Module):
     """``nn.Dense`` stand-in holding an int8 kernel + per-channel scale.
 
     Used only for decode-time model builds (``quant_int8=True``); params are
     produced by ``models/quantize.py:quantize_decode_params`` from a trained
     fp checkpoint, never trained directly (the zero/one inits below exist
-    only so ``init``/``eval_shape`` can describe the tree)."""
+    only so ``init``/``eval_shape`` can describe the tree).
+
+    ``mode``: "dynamic" quantizes activations too (s8xs8 MXU dots, fastest);
+    "weight_only" keeps activations full precision and dequantizes int8
+    weights in VMEM via the Pallas kernel (no activation quant error —
+    halved weight traffic, fp MXU rate)."""
 
     features: int
     use_bias: bool = True
     dtype: Any = jnp.float32
+    mode: str = "dynamic"
 
     @nn.compact
     def __call__(self, x):
@@ -90,7 +170,10 @@ class QDense(nn.Module):
         scale = self.param(
             "scale", nn.initializers.ones, (self.features,), jnp.float32
         )
-        y = int8_matmul(x, kernel_q, scale, dtype=self.dtype)
+        if self.mode == "weight_only":
+            y = weight_only_matmul(x, kernel_q, scale, dtype=self.dtype)
+        else:
+            y = int8_matmul(x, kernel_q, scale, dtype=self.dtype)
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros, (self.features,), jnp.float32

@@ -68,6 +68,13 @@ def parse_args(argv=None):
                              "logits head for decode (s8xs8 MXU dots, "
                              "halved per-token weight traffic; "
                              "models/quantize.py)")
+    parser.add_argument("--int8_mode", type=str, default="dynamic",
+                        choices=("dynamic", "weight_only"),
+                        help="with --int8: dynamic = quantize activations "
+                             "too (s8xs8 MXU dots, fastest); weight_only = "
+                             "fp activations, int8 weights dequantized "
+                             "in-VMEM by a Pallas kernel (no activation "
+                             "quant error)")
     # sharded inference (beyond-reference: the reference generates on one
     # GPU only, generate.py:93-95): shard params over a device mesh and run
     # the scan decode under it — needed for models too big for one chip
@@ -176,12 +183,24 @@ def _maybe_int8(args, model, params):
     decoder is conv-dominated and runs once per image, and rerank scores
     feed a comparison, not a sample."""
     if not args.int8:
+        assert args.int8_mode == "dynamic", (
+            "--int8_mode has no effect without --int8 — pass --int8 too"
+        )
         return model, params
+    if args.int8_mode == "weight_only":
+        from dalle_tpu.parallel.mesh import mesh_kwargs_from_args
+
+        assert not mesh_kwargs_from_args(args), (
+            "--int8_mode weight_only does not compose with --mesh_* "
+            "sharded inference (the Pallas dequant kernel is not "
+            "GSPMD-partitioned); use --int8_mode dynamic"
+        )
     from dalle_tpu.models.quantize import quant_model_config, quantize_decode_params
 
-    model = DALLE(quant_model_config(model.cfg))
+    model = DALLE(quant_model_config(model.cfg, mode=args.int8_mode))
     params = quantize_decode_params(params)
-    print("int8 decode: projections + logits head quantized (s8xs8 MXU dots)")
+    print(f"int8 decode ({args.int8_mode}): projections + logits head "
+          "quantized (models/quantize.py)")
     return model, params
 
 

@@ -62,6 +62,56 @@ def _fp_model_and_params(cfg=None):
     return model, params, text, codes
 
 
+def test_weight_only_matmul_matches_dequant():
+    """The Pallas in-VMEM dequant kernel == the jnp dequant matmul exactly
+    (same fp math, just no HBM materialization of the fp weights)."""
+    from dalle_tpu.ops.quant import weight_only_matmul
+
+    kx, kw = jax.random.split(jax.random.PRNGKey(3))
+    x = jax.random.normal(kx, (2, 11, 64))  # m=22: not a multiple of bm=8
+    w = jax.random.normal(kw, (64, 100)) * 0.1  # f=100: not a multiple of bf=32
+    q, scale = quantize_kernel(w)
+    kernel = np.asarray(
+        weight_only_matmul(x, q, scale, block_m=8, block_f=32, force_kernel=True)
+    )
+    fast = np.asarray(weight_only_matmul(x, q, scale))
+    want = np.asarray(x @ (q.astype(jnp.float32) * scale))
+    np.testing.assert_allclose(kernel, want, atol=1e-5)
+    np.testing.assert_allclose(fast, want, atol=1e-5)
+    # and it's closer to the fp result than the dynamic-activation path
+    # (no activation rounding error)
+    err_wo = np.linalg.norm(kernel - np.asarray(x @ w))
+    err_dyn = np.linalg.norm(np.asarray(int8_matmul(x, q, scale)) - np.asarray(x @ w))
+    assert err_wo <= err_dyn
+
+
+def test_weight_only_model_logits_closer_than_dynamic():
+    model, params, text, codes = _fp_model_and_params()
+    fp_logits = np.asarray(model.apply({"params": params}, text, codes))
+    qparams = quantize_decode_params(params)
+    allowed = fp_logits > -1e29
+    errs = {}
+    for mode in ("dynamic", "weight_only"):
+        qmodel = DALLE(quant_model_config(model.cfg, mode=mode))
+        q_logits = np.asarray(qmodel.apply({"params": qparams}, text, codes))
+        errs[mode] = np.linalg.norm(
+            fp_logits[allowed] - q_logits[allowed]
+        ) / np.linalg.norm(fp_logits[allowed])
+    assert errs["weight_only"] < 0.05
+    assert errs["weight_only"] <= errs["dynamic"]
+
+
+def test_weight_only_decode_runs():
+    model, params, text, _ = _fp_model_and_params()
+    qmodel = DALLE(quant_model_config(model.cfg, mode="weight_only"))
+    qparams = quantize_decode_params(params)
+    codes = np.asarray(
+        generate_image_codes(qmodel, qparams, text, jax.random.PRNGKey(6))
+    )
+    assert codes.shape == (2, model.cfg.image_seq_len)
+    assert (codes >= 0).all() and (codes < model.cfg.num_image_tokens).all()
+
+
 def test_quantize_decode_params_structure():
     model, params, _, _ = _fp_model_and_params()
     qparams = quantize_decode_params(params)
