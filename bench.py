@@ -678,7 +678,19 @@ def _train_bench(tiny=False, use_flash=False, loss_chunk=None):
     flops = dalle_train_flops(cfg, batch)
     peak = detect_peak_tflops() * 1e12 * n_dev
     mfu = flops / dt / peak
+    # device memory evidence (TPU reports peak HBM; CPU returns None/empty)
+    mem = {}
+    try:
+        ms = jax.local_devices()[0].memory_stats() or {}
+        if ms.get("peak_bytes_in_use"):
+            mem = {
+                "hbm_peak_bytes": ms.get("peak_bytes_in_use"),
+                "hbm_limit_bytes": ms.get("bytes_limit"),
+            }
+    except Exception:
+        pass
     return {
+        **mem,
         "metric": "train_img_tokens_per_sec_per_chip",
         "value": round(img_tokens_per_sec, 1),
         "unit": "img_tokens/s/chip",

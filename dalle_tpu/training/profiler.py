@@ -69,14 +69,18 @@ def dalle_train_flops(cfg, batch: int) -> float:
     return mult * (matmul + attn) + head_mult * head
 
 
-def xla_cost_analysis(jitted_fn, *args) -> dict:
-    """The compiler's own cost model for a jitted function."""
-    lowered = jitted_fn.lower(*args)
-    compiled = lowered.compile()
+def compiled_cost_analysis(compiled) -> dict:
+    """Normalize an executable's ``cost_analysis()`` (list-or-dict across
+    JAX versions) to a plain dict."""
     ca = compiled.cost_analysis()
     if isinstance(ca, list):
         ca = ca[0] if ca else {}
     return dict(ca or {})
+
+
+def xla_cost_analysis(jitted_fn, *args) -> dict:
+    """The compiler's own cost model for a jitted function."""
+    return compiled_cost_analysis(jitted_fn.lower(*args).compile())
 
 
 @contextlib.contextmanager
