@@ -216,13 +216,36 @@ def _map_transformer_layers(sd, prefix, depth, reversible=False):
         else:
             a = f"{prefix}.layers.layers.{i}.0"
             g = f"{prefix}.layers.layers.{i}.1"
-        tr[f"layer_{i}_attn"] = maybe_norm_out(a, {
-            "layerscale": np.asarray(sd[f"{a}.scale"]).reshape(-1),
-            "norm": {
-                "scale": sd[f"{a}.fn.norm.weight"],
-                "bias": sd[f"{a}.fn.norm.bias"],
-            },
-            "fn": {
+        if (
+            f"{a}.fn.fn.proj_in.0.weight" in sd
+            or f"{a}.fn.fn.fn.proj_in.0.weight" in sd
+        ):
+            # 'mlp' attn_type: g-mlp-pytorch gMLPBlock → our CausalSGU
+            # (reference: transformer.py:174-182).  sgu.weight may carry a
+            # heads axis ([1, n, n]) depending on library version.
+            def g2(suffix):
+                # with/without the PreShiftToken wrapper nesting level
+                return get(f"{a}.fn.fn.{suffix}", f"{a}.fn.fn.fn.{suffix}")
+
+            sw = np.asarray(g2("sgu.weight"))
+            fn = {
+                "proj_in": {
+                    "kernel": np.asarray(g2("proj_in.0.weight")).T,
+                    "bias": g2("proj_in.0.bias"),
+                },
+                "proj_out": {
+                    "kernel": np.asarray(g2("proj_out.weight")).T,
+                    "bias": g2("proj_out.bias"),
+                },
+                "sgu_norm": {
+                    "scale": g2("sgu.norm.weight"),
+                    "bias": g2("sgu.norm.bias"),
+                },
+                "spatial_w": sw[0] if sw.ndim == 3 else sw,
+                "spatial_b": np.asarray(g2("sgu.bias")).reshape(-1),
+            }
+        else:
+            fn = {
                 "qkv": {"kernel": np.asarray(get(
                     f"{a}.fn.fn.fn.to_qkv.weight", f"{a}.fn.fn.to_qkv.weight"
                 )).T},
@@ -236,7 +259,14 @@ def _map_transformer_layers(sd, prefix, depth, reversible=False):
                         f"{a}.fn.fn.to_out.0.bias",
                     ),
                 },
+            }
+        tr[f"layer_{i}_attn"] = maybe_norm_out(a, {
+            "layerscale": np.asarray(sd[f"{a}.scale"]).reshape(-1),
+            "norm": {
+                "scale": sd[f"{a}.fn.norm.weight"],
+                "bias": sd[f"{a}.fn.norm.bias"],
             },
+            "fn": fn,
         })
         tr[f"layer_{i}_ff"] = maybe_norm_out(g, {
             "layerscale": np.asarray(sd[f"{g}.scale"]).reshape(-1),

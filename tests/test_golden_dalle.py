@@ -58,6 +58,7 @@ def _install_reference():
     ax.AxialPositionalEmbedding = AxialPositionalEmbedding
     stubs["axial_positional_embedding"] = ax
     from torch_refs import (
+        RefgMLPBlock,
         RefRotaryEmbedding,
         ref_apply_rotary_emb,
         ref_broadcat,
@@ -71,7 +72,9 @@ def _install_reference():
          {"RotaryEmbedding": RefRotaryEmbedding,
           "broadcat": ref_broadcat,
           "apply_rotary_emb": ref_apply_rotary_emb}),
-        ("g_mlp_pytorch", {"gMLPBlock": object}),
+        # faithful gMLP stand-in (torch_refs.py): the reference's 'mlp'
+        # attn_type runs for real, pinning our CausalSGU differentially
+        ("g_mlp_pytorch", {"gMLPBlock": RefgMLPBlock}),
         ("omegaconf", {"OmegaConf": object}),
     ]:
         m = types.ModuleType(name)
@@ -216,6 +219,49 @@ def test_dalle_forward_matches_reference(rng, flags):
     # and the mask itself agrees: reference fills with torch.finfo.max
     ref_masked = ref_logits < -1e30
     np.testing.assert_array_equal(~allowed, ref_masked)
+
+
+def test_dalle_gmlp_matches_reference(rng):
+    """('full', 'mlp') cycle vs the reference running the faithful
+    g-mlp-pytorch stand-in (torch_refs.py) — pins CausalSGU's proj/SGU
+    semantics (res/gate chunk order, gate LayerNorm, strictly-causal
+    mixing mask, ones bias, identity gate activation) and the interop
+    mapping for gMLP layers."""
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    RefDALLE, RefVAE = _install_reference()
+    torch.manual_seed(0)
+    rvae = RefVAE(
+        image_size=16, num_layers=2, num_tokens=32, codebook_dim=16, hidden_dim=8
+    )
+    ref = RefDALLE(
+        dim=32, vae=rvae, num_text_tokens=50, text_seq_len=8, depth=2,
+        heads=2, dim_head=16, attn_types=("full", "mlp"), loss_img_weight=7,
+        rotary_emb=False, shift_tokens=False,
+    ).eval()
+    cfg = DALLEConfig(
+        num_text_tokens=50, text_seq_len=8, num_image_tokens=32,
+        image_fmap_size=4, dim=32, depth=2, heads=2, dim_head=16,
+        attn_types=("full", "mlp"), loss_img_weight=7.0,
+    )
+    model = DALLE(cfg)
+    params = _ref_to_ours(ref, cfg)
+
+    rs = np.random.RandomState(4)
+    text = rs.randint(1, 50, (3, 8))
+    codes = rs.randint(0, 32, (3, cfg.image_seq_len))
+    with torch.no_grad():
+        want = ref(
+            torch.from_numpy(text).long(), torch.from_numpy(codes).long()
+        ).numpy()
+    got = np.asarray(
+        model.apply({"params": params}, jnp.asarray(text), jnp.asarray(codes))
+    )
+    allowed = got > -1e29
+    np.testing.assert_allclose(got[allowed], want[allowed], atol=2e-4, rtol=1e-4)
+    np.testing.assert_array_equal(~allowed, want < -1e30)  # mask parity too
 
 
 @pytest.mark.parametrize(

@@ -421,3 +421,48 @@ def ref_broadcat(tensors, dim=-1):
         for t in tensors
     ]
     return torch.cat(expanded, dim=dim)
+
+
+# -------------------------- g-mlp-pytorch stand-in -------------------------
+# Faithful re-implementation of lucidrains/g-mlp-pytorch's gMLPBlock (MIT;
+# unpinned in /root/reference/setup.py) as the reference constructs it
+# (transformer.py:174-182: dim, dim_ff=dim*4, seq_len, causal; heads=1, no
+# tiny-attention, identity gate activation): Linear+GELU proj_in, spatial
+# gating unit (res/gate chunk, LayerNorm on gate, near-zero [n,n] mixing
+# weight masked strictly-causal, ones bias), proj_out from dim_ff//2.
+# Lets the golden differential tests run the reference with 'mlp' layers
+# for real, pinning our CausalSGU (dalle_tpu/models/transformer.py).
+
+
+class RefSpatialGatingUnit(nn.Module):
+    def __init__(self, dim_ff, seq_len, causal):
+        super().__init__()
+        self.norm = nn.LayerNorm(dim_ff // 2)
+        self.weight = nn.Parameter(torch.zeros(1, seq_len, seq_len))
+        self.bias = nn.Parameter(torch.ones(1, seq_len))
+        init_eps = 1e-3 / seq_len
+        nn.init.uniform_(self.weight, -init_eps, init_eps)
+        self.causal = causal
+
+    def forward(self, x):
+        n = x.shape[1]
+        res, gate = x.chunk(2, dim=-1)
+        gate = self.norm(gate)
+        weight = self.weight[:, :n, :n]
+        bias = self.bias[:, :n]
+        if self.causal:
+            mask = torch.ones(n, n, device=x.device).triu_(1).bool()
+            weight = weight.masked_fill(mask[None], 0.0)
+        gate = torch.einsum("bnd,hmn->bmd", gate, weight) + bias[..., None]
+        return gate * res  # identity gate activation (lib default)
+
+
+class RefgMLPBlock(nn.Module):
+    def __init__(self, *, dim, dim_ff, seq_len, causal=False, **_unused):
+        super().__init__()
+        self.proj_in = nn.Sequential(nn.Linear(dim, dim_ff), nn.GELU())
+        self.sgu = RefSpatialGatingUnit(dim_ff, seq_len, causal)
+        self.proj_out = nn.Linear(dim_ff // 2, dim)
+
+    def forward(self, x, **_routed_kwargs):  # SequentialSequence routes mask=
+        return self.proj_out(self.sgu(self.proj_in(x)))
