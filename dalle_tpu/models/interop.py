@@ -61,15 +61,20 @@ def vae_config_from_ref(vae_params: Dict[str, Any]):
     """Reference ``vae_params`` dict → DiscreteVAEConfig.
 
     The reference's DiscreteVAE defaults ``normalization`` to 0.5/0.5
-    channel stats (dalle_pytorch.py:88) and does not save it — restore
-    that default here, or decoded images come out wrong."""
+    channel stats (dalle_pytorch.py:88) and its trainer does not save it —
+    restore that default, or decoded images come out wrong.  A .pt that
+    DOES carry a ``normalization`` key (our save_reference_pt writes one)
+    is honored verbatim, including an explicit None."""
     from .vae import DiscreteVAEConfig
 
-    unknown = set(vae_params) - _VAE_HPARAM_KEYS
+    unknown = set(vae_params) - _VAE_HPARAM_KEYS - {"normalization"}
     if unknown:
         warnings.warn(f"ignoring unknown reference vae hparams: {sorted(unknown)}")
     kw = {k: v for k, v in vae_params.items() if k in _VAE_HPARAM_KEYS}
-    return DiscreteVAEConfig(normalization=((0.5,) * 3, (0.5,) * 3), **kw)
+    norm = vae_params.get("normalization", ((0.5,) * 3, (0.5,) * 3))
+    if norm is not None:
+        norm = tuple(tuple(x) for x in norm)
+    return DiscreteVAEConfig(normalization=norm, **kw)
 
 
 def dalle_config_from_ref(
@@ -86,6 +91,11 @@ def dalle_config_from_ref(
 
     hp = dict(hparams)
     hp.pop("vae", None)  # reference generate.py:84 does the same cleanup
+    # sandwich_norm is normally DERIVED from norm_out presence in the state
+    # dict (the reference trainer doesn't save it), but a .pt that carries
+    # it (our save_reference_pt writes one) is honored
+    if "sandwich_norm" in hp:
+        sandwich_norm = bool(hp.pop("sandwich_norm"))
     unknown = set(hp) - _DALLE_HPARAM_KEYS
     if unknown:
         warnings.warn(f"ignoring unknown reference dalle hparams: {sorted(unknown)}")
@@ -421,3 +431,209 @@ def load_reference_pt(
     out["config"] = cfg
     out["params"] = convert_ref_dalle_state(dalle_sd, cfg)
     return out
+
+
+# --------------------------------------------------------------------------
+# reverse conversion: our checkpoints → reference-format .pt
+# --------------------------------------------------------------------------
+
+
+def _conv_inv(w):  # flax HWIO → torch Conv2d OIHW
+    return np.ascontiguousarray(np.transpose(np.asarray(w), (3, 2, 0, 1)))
+
+
+def _convT_inv(w):  # flax HWIO (spatially flipped) → torch ConvTranspose2d IOHW
+    w = np.asarray(w)[::-1, ::-1]
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 0, 1)))
+
+
+def export_ref_vae_state(params, cfg) -> Dict[str, np.ndarray]:
+    """Our DiscreteVAE flax params → the reference DiscreteVAE state_dict
+    (exact inverse of :func:`convert_ref_vae_state`)."""
+    L, R = cfg.num_layers, cfg.num_resnet_blocks
+    sd: Dict[str, np.ndarray] = {
+        "codebook.weight": np.asarray(params["codebook"]["embedding"])
+    }
+    enc, dec = params["encoder"], params["decoder"]
+
+    def put_res(prefix, block):
+        for j in range(3):
+            sd[f"{prefix}.net.{2 * j}.weight"] = _conv_inv(block[f"Conv_{j}"]["kernel"])
+            sd[f"{prefix}.net.{2 * j}.bias"] = np.asarray(block[f"Conv_{j}"]["bias"])
+
+    for i in range(L):
+        sd[f"encoder.{i}.0.weight"] = _conv_inv(enc[f"Conv_{i}"]["kernel"])
+        sd[f"encoder.{i}.0.bias"] = np.asarray(enc[f"Conv_{i}"]["bias"])
+    for r in range(R):
+        put_res(f"encoder.{L + r}", enc[f"ResBlock_{r}"])
+    sd[f"encoder.{L + R}.weight"] = _conv_inv(enc[f"Conv_{L}"]["kernel"])
+    sd[f"encoder.{L + R}.bias"] = np.asarray(enc[f"Conv_{L}"]["bias"])
+
+    off = 0
+    if R > 0:
+        sd["decoder.0.weight"] = _conv_inv(dec["Conv_0"]["kernel"])
+        sd["decoder.0.bias"] = np.asarray(dec["Conv_0"]["bias"])
+        for r in range(R):
+            put_res(f"decoder.{1 + r}", dec[f"ResBlock_{r}"])
+        off = 1 + R
+    for i in range(L):
+        sd[f"decoder.{off + i}.0.weight"] = _convT_inv(
+            dec[f"ConvTranspose_{i}"]["kernel"]
+        )
+        sd[f"decoder.{off + i}.0.bias"] = np.asarray(
+            dec[f"ConvTranspose_{i}"]["bias"]
+        )
+    last = dec[f"Conv_{1 if R > 0 else 0}"]
+    sd[f"decoder.{off + L}.weight"] = _conv_inv(last["kernel"])
+    sd[f"decoder.{off + L}.bias"] = np.asarray(last["bias"])
+    return sd
+
+
+def export_ref_dalle_state(params, cfg) -> Dict[str, np.ndarray]:
+    """Our DALLE flax params → the reference DALLE state_dict (inverse of
+    :func:`convert_ref_dalle_state`; plain sequential layout only — flatten
+    scan/pp-trained checkpoints first via models/scan_params.py /
+    models/pp_params.py, reversible is rejected)."""
+    if cfg.reversible or cfg.scan_layers or cfg.pp_stages > 1:
+        raise ValueError(
+            "export_ref_dalle_state handles the plain sequential layout "
+            "only: flatten scan/pp checkpoints first "
+            "(checkpoint.load_dalle_for_eval does this), and retrain or "
+            "re-couple reversible models"
+        )
+    f = cfg.image_fmap_size
+    sd: Dict[str, np.ndarray] = {
+        "text_emb.weight": np.asarray(params["text_emb"]["embedding"]),
+        "image_emb.weight": np.asarray(params["image_emb"]["embedding"]),
+        "to_logits.0.weight": np.asarray(params["final_norm"]["scale"]),
+        "to_logits.0.bias": np.asarray(params["final_norm"]["bias"]),
+        "to_logits.1.weight": np.ascontiguousarray(
+            np.asarray(params["to_logits"]["kernel"]).T
+        ),
+        "to_logits.1.bias": np.asarray(params["to_logits"]["bias"]),
+    }
+    if cfg.rotary_emb:
+        # the reference stores its rotary table as a persistent buffer
+        # (transformer.py:228); ours is angle-parity (ops/rotary.py), theirs
+        # is the (n r)-interleaved repeat of the same angles
+        from dalle_tpu.ops.rotary import dalle_rotary_angles
+
+        ang = dalle_rotary_angles(cfg.text_seq_len, f, cfg.dim_head)
+        sd["transformer.pos_emb"] = np.repeat(ang, 2, axis=-1)[None, None]
+    else:
+        sd["text_pos_emb.weight"] = np.asarray(params["text_pos_emb"]["embedding"])
+        rows = np.asarray(params["image_pos_emb"]["rows"])
+        cols = np.asarray(params["image_pos_emb"]["cols"])
+        sd["image_pos_emb.weights.0"] = rows.reshape(f, 1, -1)
+        sd["image_pos_emb.weights.1"] = cols.reshape(1, f, -1)
+
+    tr = params["transformer"]
+    nest = ".fn" if cfg.shift_tokens else ""
+    for i in range(cfg.depth):
+        a = f"transformer.layers.layers.{i}.0"
+        g = f"transformer.layers.layers.{i}.1"
+        attn, ff = tr[f"layer_{i}_attn"], tr[f"layer_{i}_ff"]
+        for branch, layer in ((a, attn), (g, ff)):
+            sd[f"{branch}.scale"] = np.asarray(layer["layerscale"]).reshape(1, 1, -1)
+            sd[f"{branch}.fn.norm.weight"] = np.asarray(layer["norm"]["scale"])
+            sd[f"{branch}.fn.norm.bias"] = np.asarray(layer["norm"]["bias"])
+            if "norm_out" in layer:
+                sd[f"{branch}.fn.norm_out.weight"] = np.asarray(
+                    layer["norm_out"]["scale"]
+                )
+                sd[f"{branch}.fn.norm_out.bias"] = np.asarray(
+                    layer["norm_out"]["bias"]
+                )
+        fn = attn["fn"]
+        base = f"{a}.fn.fn{nest}"
+        if "proj_in" in fn:  # gMLP (CausalSGU)
+            sd[f"{base}.proj_in.0.weight"] = np.ascontiguousarray(
+                np.asarray(fn["proj_in"]["kernel"]).T
+            )
+            sd[f"{base}.proj_in.0.bias"] = np.asarray(fn["proj_in"]["bias"])
+            sd[f"{base}.proj_out.weight"] = np.ascontiguousarray(
+                np.asarray(fn["proj_out"]["kernel"]).T
+            )
+            sd[f"{base}.proj_out.bias"] = np.asarray(fn["proj_out"]["bias"])
+            sd[f"{base}.sgu.norm.weight"] = np.asarray(fn["sgu_norm"]["scale"])
+            sd[f"{base}.sgu.norm.bias"] = np.asarray(fn["sgu_norm"]["bias"])
+            # heads-axis layout ([1, n, n] / [1, n]) — the g-mlp-pytorch
+            # era the reference targets; our loader accepts both 2-D and
+            # 3-D on the way back in
+            sd[f"{base}.sgu.weight"] = np.asarray(fn["spatial_w"])[None]
+            sd[f"{base}.sgu.bias"] = np.asarray(fn["spatial_b"])[None]
+        else:
+            sd[f"{base}.to_qkv.weight"] = np.ascontiguousarray(
+                np.asarray(fn["qkv"]["kernel"]).T
+            )
+            sd[f"{base}.to_out.0.weight"] = np.ascontiguousarray(
+                np.asarray(fn["out"]["kernel"]).T
+            )
+            sd[f"{base}.to_out.0.bias"] = np.asarray(fn["out"]["bias"])
+        gbase = f"{g}.fn.fn{nest}"
+        sd[f"{gbase}.net.0.weight"] = np.ascontiguousarray(
+            np.asarray(ff["fn"]["wi"]["kernel"]).T
+        )
+        sd[f"{gbase}.net.0.bias"] = np.asarray(ff["fn"]["wi"]["bias"])
+        sd[f"{gbase}.net.3.weight"] = np.ascontiguousarray(
+            np.asarray(ff["fn"]["wo"]["kernel"]).T
+        )
+        sd[f"{gbase}.net.3.bias"] = np.asarray(ff["fn"]["wo"]["bias"])
+    return sd
+
+
+def save_reference_pt(path, cfg, params, vae_cfg=None, vae_params=None,
+                      epoch: int = 0):
+    """Write a reference-trainer-format ``.pt`` (train_dalle.py:514-557
+    layout: hparams / vae_params / epoch / weights) from OUR checkpoint —
+    the reference's own generate.py can consume it.  The migration path
+    runs BOTH ways (load_reference_pt is the other direction)."""
+    import torch
+
+    # np.array forces a writable copy (np.asarray of a JAX array is a
+    # read-only view that torch.from_numpy warns about)
+    weights = {
+        k: torch.from_numpy(np.array(v))
+        for k, v in export_ref_dalle_state(params, cfg).items()
+    }
+    vae_hparams = None
+    if vae_params is not None:
+        assert vae_cfg is not None
+        for k, v in export_ref_vae_state(vae_params, vae_cfg).items():
+            weights[f"vae.{k}"] = torch.from_numpy(np.array(v))
+        vae_hparams = dict(
+            image_size=vae_cfg.image_size,
+            num_layers=vae_cfg.num_layers,
+            num_tokens=vae_cfg.num_tokens,
+            codebook_dim=vae_cfg.codebook_dim,
+            hidden_dim=vae_cfg.hidden_dim,
+            num_resnet_blocks=vae_cfg.num_resnet_blocks,
+            # the reference ctor DEFAULTS to 0.5/0.5 channel normalization
+            # (dalle_pytorch.py:88); pass ours explicitly (None disables)
+            normalization=(
+                tuple(tuple(x) for x in vae_cfg.normalization)
+                if vae_cfg.normalization is not None else None
+            ),
+        )
+    hparams = dict(
+        num_text_tokens=cfg.num_text_tokens,
+        text_seq_len=cfg.text_seq_len,
+        dim=cfg.dim,
+        depth=cfg.depth,
+        heads=cfg.heads,
+        dim_head=cfg.dim_head,
+        reversible=cfg.reversible,
+        attn_dropout=cfg.attn_dropout,
+        ff_dropout=cfg.ff_dropout,
+        attn_types=tuple(cfg.attn_types),
+        loss_img_weight=cfg.loss_img_weight,
+        stable=cfg.stable,
+        sandwich_norm=cfg.sandwich_norm,
+        shift_tokens=cfg.shift_tokens,
+        rotary_emb=cfg.rotary_emb,
+    )
+    torch.save(
+        {"hparams": hparams, "vae_params": vae_hparams, "epoch": epoch,
+         "weights": weights},
+        str(path),
+    )

@@ -253,3 +253,120 @@ def test_vae_pt_in_train_dalle_resolution(tmp_path):
             torch.from_numpy(img).permute(0, 3, 1, 2)
         ).numpy()
     np.testing.assert_array_equal(got.reshape(-1), want.reshape(-1))
+
+
+# ------------------- reverse direction: ours → reference -------------------
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        {},
+        {"shift_tokens": True},
+        {"sandwich_norm": True, "stable": True},
+        {"attn_types": ("full", "mlp")},
+        {"rotary_emb": True},
+    ],
+    ids=["plain", "shift", "sandwich_stable", "mlp", "rotary"],
+)
+def test_reverse_export_consumed_by_reference(tmp_path, flags):
+    """save_reference_pt writes a .pt the ACTUAL reference classes load
+    (strict state_dict) and that reproduces OUR logits — the migration
+    path runs both ways."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.interop import save_reference_pt
+    from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+
+    RefDALLE, RefVAE = _install_reference()
+    flags = dict(flags)  # parametrize reuses dict objects across reruns
+    cfg = DALLEConfig(
+        num_text_tokens=50, text_seq_len=8, num_image_tokens=32,
+        image_fmap_size=4, dim=32, depth=2, heads=2, dim_head=16,
+        attn_types=flags.pop("attn_types", ("full",)), loss_img_weight=7.0,
+        **flags,
+    )
+    vcfg = DiscreteVAEConfig(
+        image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
+        hidden_dim=8, num_resnet_blocks=1,
+        normalization=((0.5,) * 3, (0.5,) * 3),  # the reference's default
+    )
+    model, vae = DALLE(cfg), DiscreteVAE(vcfg)
+    k = jax.random.PRNGKey(11)
+    text = jax.random.randint(jax.random.fold_in(k, 1), (2, 8), 1, 50)
+    codes = jax.random.randint(jax.random.fold_in(k, 2), (2, 16), 0, 32)
+    params = model.init(jax.random.fold_in(k, 3), text, codes)["params"]
+    img = jax.random.uniform(jax.random.fold_in(k, 4), (1, 16, 16, 3))
+    vparams = vae.init(
+        {"params": jax.random.fold_in(k, 5), "gumbel": k}, img,
+        return_loss=True,
+    )["params"]
+
+    pt = tmp_path / "ours.pt"
+    save_reference_pt(pt, cfg, params, vae_cfg=vcfg, vae_params=vparams)
+
+    obj = torch.load(str(pt), weights_only=False)
+    rvae = RefVAE(**obj["vae_params"])
+    ref = RefDALLE(vae=rvae, **obj["hparams"])
+    missing, unexpected = ref.load_state_dict(obj["weights"], strict=False)
+    # every PARAMETER must load; only non-persistent buffers may be absent
+    param_names = {n for n, _ in ref.named_parameters()}
+    assert not param_names & set(missing), sorted(param_names & set(missing))
+    assert not unexpected, unexpected
+    ref.eval()
+
+    ours = np.asarray(model.apply({"params": params}, text, codes))
+    with torch.no_grad():
+        theirs = ref(
+            torch.from_numpy(np.asarray(text)).long(),
+            torch.from_numpy(np.asarray(codes)).long(),
+        ).numpy()
+    allowed = ours > -1e29
+    np.testing.assert_allclose(
+        ours[allowed], theirs[allowed], atol=2e-4, rtol=1e-4
+    )
+    # and the VAE subtree reproduces codebook indices exactly
+    t_img = torch.from_numpy(np.asarray(img)).permute(0, 3, 1, 2)
+    with torch.no_grad():
+        want_idx = rvae.get_codebook_indices(t_img).numpy()
+    got_idx = np.asarray(
+        vae.apply({"params": vparams}, img,
+                  method=DiscreteVAE.get_codebook_indices)
+    )
+    np.testing.assert_array_equal(got_idx.reshape(-1), want_idx.reshape(-1))
+
+
+def test_reverse_export_roundtrips_through_our_loader(tmp_path):
+    """ours → .pt → load_reference_pt → identical params (lossless both
+    ways through the same .pt)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.interop import load_reference_pt, save_reference_pt
+
+    cfg = DALLEConfig(
+        num_text_tokens=50, text_seq_len=8, num_image_tokens=32,
+        image_fmap_size=4, dim=32, depth=2, heads=2, dim_head=16,
+    )
+    model = DALLE(cfg)
+    k = jax.random.PRNGKey(12)
+    text = jnp.ones((1, 8), jnp.int32)
+    codes = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(k, text, codes)["params"]
+    pt = tmp_path / "rt.pt"
+    save_reference_pt(pt, cfg, params)
+    loaded = load_reference_pt(str(pt), expect="dalle", fmap_hint=4)
+    assert loaded["config"].depth == cfg.depth
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        import jax.tree_util as jtu
+
+        got = loaded["params"]
+        for p in path:
+            got = got[p.key]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(leaf), atol=1e-6,
+            err_msg=jtu.keystr(path),
+        )

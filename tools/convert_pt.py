@@ -1,17 +1,18 @@
 #!/usr/bin/env python
-"""Convert a reference-format torch ``.pt`` checkpoint into a native
-dalle_tpu checkpoint directory.
+"""Convert checkpoints between the reference's ``.pt`` format and ours —
+BOTH directions.
 
-    python tools/convert_pt.py dalle.pt out/dalle-converted
+    python tools/convert_pt.py dalle.pt out/dalle-converted      # .pt -> ours
     python tools/convert_pt.py vae.pt out/vae-converted
+    python tools/convert_pt.py --reverse CKPT_DIR out/dalle.pt   # ours -> .pt
 
 The ``.pt`` layouts are the reference trainers' save formats
 (reference: train_dalle.py:514-557, train_vae.py:196-216); conversion
-rules live in dalle_tpu/models/interop.py.  The output directory is a
-standard self-describing checkpoint: ``generate.py --dalle_path OUT``
-and ``train_dalle.py --dalle_path OUT`` (resume) / ``--vae_path OUT``
-work on it directly.  (generate.py also accepts the ``.pt`` itself; this
-tool exists for the training-resume path and for one-time conversion.)
+rules live in dalle_tpu/models/interop.py.  Forward output is a standard
+self-describing checkpoint (``generate.py --dalle_path OUT`` works on it
+directly).  Reverse output is a ``.pt`` the REFERENCE's own generate.py
+can consume — a migration path that runs both ways (the reference offers
+neither direction).
 """
 
 import argparse
@@ -23,8 +24,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("pt_path", help="reference-format .pt checkpoint")
-    ap.add_argument("out_path", help="output checkpoint directory")
+    ap.add_argument("in_path", metavar="pt_path",
+                    help="reference-format .pt (forward) or our checkpoint "
+                         "dir (--reverse)")
+    ap.add_argument("out_path", help="output checkpoint dir (forward) or "
+                                     ".pt path (--reverse)")
+    ap.add_argument("--reverse", action="store_true",
+                    help="our checkpoint dir -> reference-format .pt")
+    ap.add_argument("--no_ema", action="store_true",
+                    help="with --reverse: export raw params even when the "
+                         "checkpoint carries EMA weights")
     args = ap.parse_args(argv)
 
     import dalle_tpu
@@ -37,7 +46,11 @@ def main(argv=None):
     from dalle_tpu.models.interop import load_reference_pt
     from dalle_tpu.training.checkpoint import save_checkpoint
 
-    loaded = load_reference_pt(args.pt_path)
+    if args.reverse:
+        _reverse(args)
+        return
+
+    loaded = load_reference_pt(args.in_path)
     to_jnp = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
     if loaded["kind"] == "vae":
         # VAE-only checkpoints store their tree under "params" so
@@ -64,6 +77,41 @@ def main(argv=None):
     )
     note = "" if vae_hp else " (no embedded VAE: pair with --taming or the OpenAI default at load time)"
     print(f"converted reference DALLE .pt -> {path}{note}")
+
+
+def _reverse(args):
+    from dalle_tpu.models.interop import save_reference_pt
+    from dalle_tpu.models.vae_registry import build_vae, params_eval_shape
+    from dalle_tpu.training.checkpoint import (
+        load_dalle_for_eval,
+        load_subtree,
+        shape_dtype_of,
+    )
+    import jax
+
+    model, params, meta, notes = load_dalle_for_eval(
+        args.in_path, prefer_ema=not args.no_ema
+    )
+    for n in notes:
+        print(n)
+    vae_cfg = vae_params = None
+    if meta.get("vae_hparams") and meta["vae_hparams"].get("type", "discrete") == "discrete":
+        single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        vae, vae_cfg = build_vae(meta["vae_hparams"])
+        vae_params = load_subtree(
+            args.in_path, "vae_params",
+            shape_dtype_of(params_eval_shape(vae, vae_cfg), sharding=single),
+        )
+    save_reference_pt(
+        args.out_path, model.cfg, params,
+        vae_cfg=vae_cfg, vae_params=vae_params,
+        epoch=int(meta.get("epoch", 0) or 0),
+    )
+    note = "" if vae_params is not None else (
+        " (no embedded DiscreteVAE: the reference side must supply its "
+        "own VAE)"
+    )
+    print(f"exported reference-format .pt -> {args.out_path}{note}")
 
 
 if __name__ == "__main__":
