@@ -43,28 +43,83 @@ CASES = [
     ("causal_bf16_1280", 1280, 64, "bfloat16", False, False),
     ("sparse_bf16_1280", 1280, 64, "bfloat16", True, False),
     ("padmask_bf16_512", 512, 64, "bfloat16", False, True),
+    # the OTHER Pallas kernel: weight-only int8 in-VMEM dequant matmul
+    # (ops/quant.py) at projection shapes — its own Mosaic moment of truth
+    ("dequant_int8_512", 512, 512, "bfloat16", False, False),
     ("causal_bf16_4096", 4096, 64, "bfloat16", False, False),  # VQGAN-f8 scale
 ]
 
 
-def run_case(name: str) -> dict:
-    """Child entry: compile+run fwd and bwd for one case, check numerics."""
-    n, d, dtype_name, sparse, masked = next(
-        (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
-    )
+def _import_jax_for_probe():
+    """Shared child preamble: time the import and honor BENCH_PLATFORM
+    (the axon site hook re-exports JAX_PLATFORMS, so the config update is
+    the only reliable override)."""
     t_import = time.perf_counter()
     import jax
     import jax.numpy as jnp
 
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    return jax, jnp, time.perf_counter() - t_import
+
+
+def _run_dequant_case(name: str) -> dict:
+    """weight_only_matmul (ops/quant.py) compile+run+numerics at a flagship
+    projection shape: the CASES tuple's (n, d) are rows x fan-in, fan-out
+    is the FF-sized 4*d."""
+    jax, jnp, import_s = _import_jax_for_probe()
+
+    from dalle_tpu.ops.quant import quantize_kernel, weight_only_matmul
+
+    platform = jax.default_backend()
+    m, d = next((n_, d_) for nm, n_, d_, *_ in CASES if nm == name)
+    f = 4 * d
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (m, d), jnp.bfloat16)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (d, f)) * 0.05
+    wq, scale = quantize_kernel(w)
+
+    fn = jax.jit(lambda x: weight_only_matmul(
+        x, wq, scale, dtype=jnp.bfloat16, force_kernel=True))
+    t0 = time.perf_counter()
+    out = fn(x)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+
+    want = (x.astype(jnp.float32) @ (wq.astype(jnp.float32) * scale))
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - want)))
+    ref_scale = float(jnp.max(jnp.abs(want)))
+    return {
+        "case": name, "m": m, "d": d, "f": f, "dtype": "bfloat16",
+        "platform": platform, "interpret": platform != "tpu",
+        "import_s": round(import_s, 1),
+        "fwd_compile_s": round(compile_s, 2),
+        "fwd_ms": round(ms, 3),
+        "fwd_max_err": round(err, 6),
+        "numerics_ok": bool(err < 0.03 * max(ref_scale, 1.0)),
+    }
+
+
+def run_case(name: str) -> dict:
+    """Child entry: compile+run fwd and bwd for one case, check numerics."""
+    if name.startswith("dequant_int8"):
+        return _run_dequant_case(name)
+    n, d, dtype_name, sparse, masked = next(
+        (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
+    )
+    jax, jnp, import_s = _import_jax_for_probe()
 
     from dalle_tpu.ops import attention as A
     from dalle_tpu.ops.flash import block_layout_from_mask, flash_attention
     from dalle_tpu.ops.masks import block_sparse_mask, causal_mask
 
     platform = jax.default_backend()
-    import_s = time.perf_counter() - t_import
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     b, h = 1, 2
     blk = 128
@@ -219,9 +274,12 @@ def main():
         # nothing-even-started (import hang), so bench keeps rc=2 evidence
         any_started = any_started or ("error" not in rec
                                       or "timed out" in rec.get("error", ""))
+        bwd = rec.get("bwd_compile_s")
+        ok_line = f"ok fwd={rec.get('fwd_compile_s')}s" + (
+            f" bwd={bwd}s" if bwd is not None else ""  # fwd-only cases
+        )
         print(f"  {name}: "
-              + (f"ok fwd={rec.get('fwd_compile_s')}s bwd={rec.get('bwd_compile_s')}s"
-                 if "error" not in rec else rec["error"][:120]),
+              + (ok_line if "error" not in rec else rec["error"][:120]),
               file=sys.stderr, flush=True)
 
     n_ok = sum("error" not in r for r in results)
