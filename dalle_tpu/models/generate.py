@@ -38,7 +38,8 @@ PRIME_FRACTION = 0.4375
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "num_steps", "start", "filter_thres", "temperature", "top_p"),
+    static_argnames=("model", "num_steps", "start", "filter_thres",
+                     "temperature", "top_p", "image_only"),
 )
 def scan_decode(
     model: DALLE,
@@ -52,6 +53,7 @@ def scan_decode(
     filter_thres: float = 0.9,
     temperature: float = 1.0,
     top_p: Optional[float] = None,
+    image_only: bool = False,
 ):
     """Decode positions [start, start+num_steps); returns sampled combined
     ids [b, num_steps] where entry i is the sample from position
@@ -72,7 +74,8 @@ def scan_decode(
         p, k = inp
         fed = jnp.where(forced_mask[p], forced[:, p], prev)
         logits, cache = model.apply(
-            {"params": params}, fed, p, cache, method=DALLE.decode_step
+            {"params": params}, fed, p, cache, image_only=image_only,
+            method=DALLE.decode_step,
         )
         sampled = sample_logits(
             k, logits, temperature=temperature, filter_thres=filter_thres,
@@ -137,6 +140,9 @@ def generate_image_codes(
         filter_thres=filter_thres,
         temperature=temperature,
         top_p=top_p,
+        # every scanned position is an image position: the head projects
+        # only the image vocab slice (decode_step image_only docstring)
+        image_only=True,
     )
     img_samples = samples - c.total_text_tokens
     codes = jnp.clip(img_samples, 0, c.num_image_tokens - 1)

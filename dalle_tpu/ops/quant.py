@@ -162,7 +162,7 @@ class QDense(nn.Module):
     mode: str = "dynamic"
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cols=None):
         d = x.shape[-1]
         kernel_q = self.param(
             "kernel_q", nn.initializers.zeros, (d, self.features), jnp.int8
@@ -170,6 +170,9 @@ class QDense(nn.Module):
         scale = self.param(
             "scale", nn.initializers.ones, (self.features,), jnp.float32
         )
+        if cols is not None:  # static column range: project a vocab slice
+            kernel_q = kernel_q[:, cols[0]:cols[1]]
+            scale = scale[cols[0]:cols[1]]
         if self.mode == "weight_only":
             y = weight_only_matmul(x, kernel_q, scale, dtype=self.dtype)
         else:
@@ -178,5 +181,7 @@ class QDense(nn.Module):
             bias = self.param(
                 "bias", nn.initializers.zeros, (self.features,), jnp.float32
             )
+            if cols is not None:
+                bias = bias[cols[0]:cols[1]]
             y = y + bias.astype(y.dtype)
         return y

@@ -53,6 +53,47 @@ def test_generate_deterministic_given_key(rng):
     np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
 
 
+def test_image_only_head_slice_is_bitwise_identical(rng):
+    """The image-decode scan projects only the image vocab slice of the
+    head (decode_step image_only) and pads the text half with NEG_INF —
+    which must reproduce the full masked head EXACTLY, logits and samples
+    both (the categorical draw sees the identical array)."""
+    from dalle_tpu.models.generate import _build_forced, scan_decode
+
+    model, params, text, _ = build(rng)
+    c = model.cfg
+    forced, mask = _build_forced(model, params, text)
+    kw = dict(
+        num_steps=c.image_seq_len, start=c.text_seq_len,
+        prefill_text=text.astype(jnp.int32), filter_thres=0.9,
+    )
+    sliced = scan_decode(
+        model, params, forced, mask, rng, image_only=True, **kw
+    )
+    full = scan_decode(
+        model, params, forced, mask, rng, image_only=False, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(sliced), np.asarray(full))
+    # and the per-step logits themselves agree at an image position
+    cache = model.apply({"params": params}, 2, method=DALLE.init_cache)
+    cache = model.apply(
+        {"params": params}, text.astype(jnp.int32), cache,
+        method=DALLE.prefill,
+    )
+    fed = jnp.full((2,), c.total_text_tokens + 3, jnp.int32)
+    l_full, _ = model.apply(
+        {"params": params}, fed, c.text_seq_len, cache,
+        method=DALLE.decode_step,
+    )
+    l_img, _ = model.apply(
+        {"params": params}, fed, c.text_seq_len, cache, image_only=True,
+        method=DALLE.decode_step,
+    )
+    np.testing.assert_allclose(
+        np.asarray(l_img), np.asarray(l_full), atol=1e-6
+    )
+
+
 def test_priming_preserves_prefix(rng):
     model, params, text, codes = build(rng)
     prime = codes[:, :3]
