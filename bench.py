@@ -862,13 +862,9 @@ def _generate_bench(quant=False):
     codes0 = jax.random.randint(rng, (batch, cfg.image_seq_len), 0, cfg.num_image_tokens)
     params = model.init({"params": rng}, text, codes0)["params"]
     if quant:
-        from dalle_tpu.models.quantize import (
-            quant_model_config,
-            quantize_decode_params,
-        )
+        from dalle_tpu.models.quantize import quantize_for_decode
 
-        model = DALLE(quant_model_config(cfg))
-        params = quantize_decode_params(params)
+        model, params = quantize_for_decode(model, params)
     vae = DiscreteVAE(vcfg)
     vparams = vae.init({"params": rng, "gumbel": rng}, img, return_loss=True)["params"]
     clip = CLIP(ccfg)

@@ -56,6 +56,18 @@ def quantize_decode_params(params):
     return walk(params)
 
 
+def quantize_for_decode(model, params, mode: str = "dynamic"):
+    """One-call decode quantization: (fp model, fp params) -> (quant model,
+    quant params).  The shared idiom behind generate.py --int8, the bench
+    generate_int8 rung, and tools/export_stablehlo.py --int8."""
+    from dalle_tpu.models.dalle import DALLE
+
+    return (
+        DALLE(quant_model_config(model.cfg, mode=mode)),
+        quantize_decode_params(params),
+    )
+
+
 def quant_model_config(cfg, mode: str = "dynamic"):
     """The decode-time config for a trained ``DALLEConfig``: int8
     projections on, training-only features untouched.  ``mode``:

@@ -195,10 +195,9 @@ def _maybe_int8(args, model, params):
             "sharded inference (the Pallas dequant kernel is not "
             "GSPMD-partitioned); use --int8_mode dynamic"
         )
-    from dalle_tpu.models.quantize import quant_model_config, quantize_decode_params
+    from dalle_tpu.models.quantize import quantize_for_decode
 
-    model = DALLE(quant_model_config(model.cfg, mode=args.int8_mode))
-    params = quantize_decode_params(params)
+    model, params = quantize_for_decode(model, params, mode=args.int8_mode)
     print(f"int8 decode ({args.int8_mode}): projections + logits head "
           "quantized (models/quantize.py)")
     return model, params

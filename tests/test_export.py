@@ -55,6 +55,27 @@ def test_export_decode_valid_and_deterministic(tmp_path):
     np.testing.assert_array_equal(a, b)  # same key -> same samples
 
 
+def test_export_int8_model_roundtrip(tmp_path):
+    """A dynamic-int8 quant model exports as pure StableHLO and the
+    artifact reproduces the live quant model's decode."""
+    from dalle_tpu.models.quantize import (
+        quant_model_config,
+        quantize_decode_params,
+    )
+
+    model, params, text, _ = _tiny_model()
+    qmodel = DALLE(quant_model_config(model.cfg, mode="dynamic"))
+    qparams = quantize_decode_params(params)
+    export_dalle(qmodel, qparams, str(tmp_path), batch=2)
+    dec = load_exported(tmp_path / "decode.stablehlo")
+    key = jax.random.PRNGKey(9)
+    got = np.asarray(dec(qparams, text, key))
+    from dalle_tpu.models.generate import generate_image_codes
+
+    live = np.asarray(generate_image_codes(qmodel, qparams, text, key))
+    np.testing.assert_array_equal(got, live)
+
+
 def test_export_meta_describes_artifacts(tmp_path):
     model, params, _, _ = _tiny_model()
     export_dalle(model, params, str(tmp_path), batch=2)

@@ -153,12 +153,20 @@ def main():
     ap.add_argument("--no_ema", action="store_true",
                     help="export the raw training params even when the "
                          "checkpoint carries an ema_params subtree")
+    ap.add_argument("--int8", action="store_true",
+                    help="quantize projections + head before export "
+                         "(dynamic s8xs8 mode only: pure StableHLO ops, "
+                         "portable; weight_only would bake a "
+                         "platform-specific Pallas kernel)")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args()
     import dalle_tpu
 
     dalle_tpu.force_cpu_if_virtual()
     if args.selftest:
+        if args.int8:
+            ap.error("--selftest exercises the fp path only; run "
+                     "--int8 against a real checkpoint")
         _selftest()
         return
     if not args.dalle_path:
@@ -171,6 +179,11 @@ def main():
     )
     for n in notes:
         print(n, file=sys.stderr)
+    if args.int8:
+        from dalle_tpu.models.quantize import quantize_for_decode
+
+        model, params = quantize_for_decode(model, params, mode="dynamic")
+        print("int8 (dynamic) quantized before export", file=sys.stderr)
     meta = export_dalle(
         model, params, args.out, batch=args.batch,
         temperature=args.temperature, filter_thres=args.filter_thres,
