@@ -861,10 +861,23 @@ def _generate_bench(quant=False):
     model = DALLE(cfg)
     codes0 = jax.random.randint(rng, (batch, cfg.image_seq_len), 0, cfg.num_image_tokens)
     params = model.init({"params": rng}, text, codes0)["params"]
+    kv8 = False
     if quant:
         from dalle_tpu.models.quantize import quantize_for_decode
 
         model, params = quantize_for_decode(model, params)
+        # On TPU, measure the full int8 deployment mode (generate.py
+        # --int8 --kv_int8): int8 weights AND int8 KV cache — the two HBM
+        # streams that bound autoregressive decode, both halved.  On the
+        # CPU fallback the int8 cache is pure emulation overhead (no
+        # bandwidth-bound MXU to feed), which would pollute the
+        # cross-round history with a fake regression, so kv8 stays off
+        # there; the JSON records which mode ran.
+        kv8 = jax.default_backend() == "tpu"
+        if kv8:
+            from dalle_tpu.models.quantize import kv_int8_model
+
+            model = kv_int8_model(model)
     vae = DiscreteVAE(vcfg)
     vparams = vae.init({"params": rng, "gumbel": rng}, img, return_loss=True)["params"]
     clip = CLIP(ccfg)
@@ -896,7 +909,7 @@ def _generate_bench(quant=False):
         "batch": batch,
         "compile_s": round(compile_s, 1),
         "clip_score_mean": round(float(jnp.mean(scores)), 4),
-        **({"quant": "int8"} if quant else {}),
+        **({"quant": "int8+kv8" if kv8 else "int8"} if quant else {}),
         "note": "random weights — measures pipeline speed; CLIP score is harness evidence only",
     }
 

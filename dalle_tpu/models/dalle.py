@@ -111,6 +111,9 @@ class DALLEConfig:
     # models/quantize.py:quantize_decode_params, never from training
     quant_int8: bool = False
     quant_mode: str = "dynamic"  # "dynamic" (s8xs8) | "weight_only" (Pallas)
+    # decode-only int8 KV cache (transformer.py kv_int8): no extra params,
+    # orthogonal to quant_int8
+    kv_int8: bool = False
     dtype: Any = jnp.float32
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
@@ -171,6 +174,7 @@ class DALLEConfig:
             moe_aux_weight=self.moe_aux_weight,
             quant_int8=self.quant_int8,
             quant_mode=self.quant_mode,
+            kv_int8=self.kv_int8,
             dtype=self.dtype,
         )
 

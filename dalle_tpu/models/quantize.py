@@ -68,6 +68,17 @@ def quantize_for_decode(model, params, mode: str = "dynamic"):
     )
 
 
+def kv_int8_model(model):
+    """Rebuild a DALLE with the int8 KV cache on (transformer.py kv_int8).
+    No param change — the mode adds none.  The shared idiom behind
+    generate.py --kv_int8, the bench generate_int8 rung, and
+    tools/export_stablehlo.py --kv_int8; composes with
+    :func:`quantize_for_decode` (cfg fields are orthogonal)."""
+    from dalle_tpu.models.dalle import DALLE
+
+    return DALLE(dataclasses.replace(model.cfg, kv_int8=True))
+
+
 def quant_model_config(cfg, mode: str = "dynamic"):
     """The decode-time config for a trained ``DALLEConfig``: int8
     projections on, training-only features untouched.  ``mode``:

@@ -124,6 +124,14 @@ class TransformerConfig:
     # models/quantize.py, never from training
     quant_int8: bool = False
     quant_mode: str = "dynamic"  # "dynamic" (s8xs8) | "weight_only" (Pallas)
+    # decode-only int8 KV cache (ops/quant.py quantize_rows): K/V cached as
+    # int8 + one fp32 scale per (token, head), dequantized into the
+    # attention dot each step.  Halves the OTHER big HBM stream of
+    # autoregressive decode (the cache re-read per token; quant_int8 covers
+    # the weight stream).  Orthogonal to quant_int8 — no extra params, any
+    # checkpoint works.  Beyond-reference (its decode has no cache at all,
+    # reference: dalle_pytorch.py:483-498).
+    kv_int8: bool = False
     dtype: Any = jnp.float32
 
     @property
@@ -556,10 +564,54 @@ class JointAttention(nn.Module):
     def init_cache(self, batch: int) -> Cache:
         c = self.cfg
         shape = (batch, c.heads, c.seq_len, c.dim_head)
+        if c.kv_int8:
+            from dalle_tpu.ops.quant import EPS
+
+            sshape = (batch, c.heads, c.seq_len, 1)
+            return {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.full(sshape, EPS, jnp.float32),
+                "v_scale": jnp.full(sshape, EPS, jnp.float32),
+            }
         return {
             "k": jnp.zeros(shape, c.dtype),
             "v": jnp.zeros(shape, c.dtype),
         }
+
+    def _cache_store(self, cache: Cache, k, v, idx) -> Cache:
+        """Write k/v [b,h,L,d] into the cache at position ``idx`` (int8
+        rows + scales under kv_int8, plain ``c.dtype`` otherwise)."""
+        c = self.cfg
+        upd = jax.lax.dynamic_update_slice_in_dim
+        if c.kv_int8:
+            from dalle_tpu.ops.quant import quantize_rows
+
+            kq, ks = quantize_rows(k)
+            vq, vs = quantize_rows(v)
+            return {
+                "k": upd(cache["k"], kq, idx, axis=2),
+                "v": upd(cache["v"], vq, idx, axis=2),
+                "k_scale": upd(cache["k_scale"], ks, idx, axis=2),
+                "v_scale": upd(cache["v_scale"], vs, idx, axis=2),
+            }
+        return {
+            "k": upd(cache["k"], k.astype(c.dtype), idx, axis=2),
+            "v": upd(cache["v"], v.astype(c.dtype), idx, axis=2),
+        }
+
+    def _cache_kv(self, cache: Cache):
+        """The cached K/V as dot operands; under kv_int8 the dequant is a
+        convert-multiply XLA fuses into the attention dot."""
+        c = self.cfg
+        if c.kv_int8:
+            from dalle_tpu.ops.quant import dequantize_rows
+
+            return (
+                dequantize_rows(cache["k"], cache["k_scale"], c.dtype),
+                dequantize_rows(cache["v"], cache["v_scale"], c.dtype),
+            )
+        return cache["k"], cache["v"]
 
     def prefill(self, x, cache):
         """Teacher-forced prefix [b, L, dim] (text region, L <= text_seq_len):
@@ -572,12 +624,11 @@ class JointAttention(nn.Module):
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
             if c.rotary_v:
                 v = apply_rotary(v, ang)
-        ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(c.dtype), 0, axis=2)
-        cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(c.dtype), 0, axis=2)
+        new_cache = self._cache_store(cache, k, v, 0)
         mask = jnp.asarray(_static_mask(c, self.attn_type)[:L, :L])
         out = attn_ops._sdpa(q, k, v, mask[None, None])
         out = out.transpose(0, 2, 1, 3).reshape(b, L, -1)
-        return self.to_out(out), {"k": ck, "v": cv}
+        return self.to_out(out), new_cache
 
     def decode_step(self, x_t, idx, cache, deterministic=True):
         """x_t: [b, dim] token at position idx; returns ([b, dim], cache')."""
@@ -590,13 +641,13 @@ class JointAttention(nn.Module):
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
             if c.rotary_v:
                 v = apply_rotary(v, ang)
-        ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(c.dtype), idx, axis=2)
-        cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(c.dtype), idx, axis=2)
+        new_cache = self._cache_store(cache, k, v, idx)
+        ck, cv = self._cache_kv(new_cache)
         mask_table = jnp.asarray(_static_mask(c, self.attn_type))
         row = jax.lax.dynamic_slice_in_dim(mask_table, idx, 1, axis=0)  # [1, n]
         out = attn_ops._sdpa(q, ck, cv, row[None, None])  # [b,h,1,d]
         out = out.transpose(0, 2, 1, 3).reshape(b, -1)
-        return self.to_out(out), {"k": ck, "v": cv}
+        return self.to_out(out), new_cache
 
 
 class CausalSGU(nn.Module):
@@ -638,32 +689,56 @@ class CausalSGU(nn.Module):
 
     def init_cache(self, batch: int) -> Cache:
         c = self.cfg
-        return {"v": jnp.zeros((batch, c.seq_len, self.inner // 2), c.dtype)}
+        shape = (batch, c.seq_len, self.inner // 2)
+        if c.kv_int8:
+            from dalle_tpu.ops.quant import EPS
+
+            return {
+                "v": jnp.zeros(shape, jnp.int8),
+                "v_scale": jnp.full((batch, c.seq_len, 1), EPS, jnp.float32),
+            }
+        return {"v": jnp.zeros(shape, c.dtype)}
+
+    def _cache_store(self, cache: Cache, v, idx) -> Cache:
+        c = self.cfg
+        upd = jax.lax.dynamic_update_slice_in_dim
+        if c.kv_int8:
+            from dalle_tpu.ops.quant import quantize_rows
+
+            vq, vs = quantize_rows(v)
+            return {
+                "v": upd(cache["v"], vq, idx, axis=1),
+                "v_scale": upd(cache["v_scale"], vs, idx, axis=1),
+            }
+        return {"v": upd(cache["v"], v.astype(c.dtype), idx, axis=1)}
 
     def prefill(self, x, cache):
         L = x.shape[1]
         y = jax.nn.gelu(self.proj_in(x), approximate=False)
         u, v = jnp.split(y, 2, axis=-1)
         v = self.sgu_norm(v)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            cache["v"], v.astype(self.cfg.dtype), 0, axis=1
-        )
+        new_cache = self._cache_store(cache, v, 0)
         w = self._gate_weight()[:L, :L]
         b_row = self.spatial_b[:L]
         gated = jnp.einsum("ij,bjd->bid", w, v) + b_row[None, :, None].astype(v.dtype)
-        return self.proj_out(u * gated), {"v": cv}
+        return self.proj_out(u * gated), new_cache
 
     def decode_step(self, x_t, idx, cache, deterministic=True):
+        c = self.cfg
         y = jax.nn.gelu(self.proj_in(x_t), approximate=False)
         u, v = jnp.split(y, 2, axis=-1)
         v = self.sgu_norm(v)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            cache["v"], v[:, None].astype(self.cfg.dtype), idx, axis=1
-        )
+        new_cache = self._cache_store(cache, v[:, None], idx)
+        if c.kv_int8:
+            from dalle_tpu.ops.quant import dequantize_rows
+
+            cv = dequantize_rows(new_cache["v"], new_cache["v_scale"], c.dtype)
+        else:
+            cv = new_cache["v"]
         w_row = jax.lax.dynamic_slice_in_dim(self._gate_weight(), idx, 1, axis=0)[0]
         b_row = jax.lax.dynamic_slice_in_dim(self.spatial_b, idx, 1)[0]
         gated = jnp.einsum("j,bjd->bd", w_row, cv) + b_row.astype(v.dtype)
-        return self.proj_out(u * gated), {"v": cv}
+        return self.proj_out(u * gated), new_cache
 
 
 class SubLayer(nn.Module):

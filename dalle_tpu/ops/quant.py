@@ -185,3 +185,26 @@ class QDense(nn.Module):
                 bias = bias[cols[0]:cols[1]]
             y = y + bias.astype(y.dtype)
         return y
+
+
+def quantize_rows(x: jnp.ndarray):
+    """fp [..., d] -> (int8 [..., d], fp32 scale [..., 1]) per-row symmetric.
+
+    The KV-cache quantizer (``TransformerConfig.kv_int8``): one scale per
+    cached token per head, absmax over the feature axis.  Same
+    EPS-clamped-scale contract as :func:`quantize_kernel` so all-tiny rows
+    round-trip to ~0 instead of garbage."""
+    xf = jnp.asarray(x, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0, EPS)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_rows(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32):
+    """Inverse of :func:`quantize_rows`.
+
+    Written as convert-multiply so XLA fuses it into the consuming dot:
+    the HBM read of a kv_int8 cache stays int8 + one fp32 scale per row —
+    the bandwidth saving that motivates the mode (autoregressive decode
+    re-reads the WHOLE K/V cache every generated token)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)

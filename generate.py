@@ -75,6 +75,13 @@ def parse_args(argv=None):
                              "fp activations, int8 weights dequantized "
                              "in-VMEM by a Pallas kernel (no activation "
                              "quant error)")
+    parser.add_argument("--kv_int8", action="store_true",
+                        help="int8 KV cache for the decode scan: the cache "
+                             "re-read per generated token is the other big "
+                             "HBM stream besides the weights — stored int8 "
+                             "+ per-token scales, dequantized into the "
+                             "attention dot.  No extra params; composes "
+                             "with --int8 and --mesh_*")
     # sharded inference (beyond-reference: the reference generates on one
     # GPU only, generate.py:93-95): shard params over a device mesh and run
     # the scan decode under it — needed for models too big for one chip
@@ -100,6 +107,7 @@ def main(argv=None):
         )
         model, params, vae, vae_params, cfg = _load_reference_pt(args)
         model, params = _maybe_int8(args, model, params)
+        model = _maybe_kv_int8(args, model)
         _generate_loop(args, tokenizer, model, params, vae, vae_params,
                        cfg, clip=None, clip_params=None)
         return
@@ -173,6 +181,7 @@ def main(argv=None):
         )
 
     model, params = _maybe_int8(args, model, params)
+    model = _maybe_kv_int8(args, model)
     _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                    clip, clip_params)
 
@@ -201,6 +210,17 @@ def _maybe_int8(args, model, params):
     print(f"int8 decode ({args.int8_mode}): projections + logits head "
           "quantized (models/quantize.py)")
     return model, params
+
+
+def _maybe_kv_int8(args, model):
+    """--kv_int8: rebuild the model with an int8 KV cache (params
+    unchanged — the mode adds none; transformer.py kv_int8)."""
+    if not args.kv_int8:
+        return model
+    from dalle_tpu.models.quantize import kv_int8_model
+
+    print("int8 KV cache: decode cache stored int8 + per-token scales")
+    return kv_int8_model(model)
 
 
 def _load_reference_pt(args):

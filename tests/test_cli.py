@@ -104,6 +104,17 @@ def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
     written = list(Path(gen_dir).glob("*/*.jpg"))
     assert len(written) == 2, written
 
+    # the full quantized deployment mode: int8 weights + int8 KV cache
+    q_dir = str(tmp_path / "outputs_int8")
+    generate.main([
+        "--dalle_path", dalle_out + "/dalle-final",
+        "--text", "red square",
+        "--num_images", "2", "--batch_size", "2",
+        "--int8", "--kv_int8",
+        "--outputs_dir", q_dir,
+    ])
+    assert len(list(Path(q_dir).glob("*/*.jpg"))) == 2
+
 
 def test_train_dalle_webdataset_cli(tmp_path):
     """train_dalle end to end from tar shards (--wds), the reference's

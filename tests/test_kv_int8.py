@@ -1,0 +1,169 @@
+"""Int8 KV cache for the decode scan (``kv_int8``): cache structure, logits
+parity vs the fp cache, composition with int8 weights, and the bandwidth
+accounting.  Beyond-reference capability: the reference's decode has no KV
+cache at all (reference: dalle_pytorch.py:483-498 re-runs the full forward
+per token).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.generate import generate_image_codes
+from dalle_tpu.models.quantize import kv_int8_model as _kv_model
+
+
+def _tiny_cfg(**kw):
+    base = dict(
+        num_text_tokens=50, text_seq_len=8, num_image_tokens=32,
+        image_fmap_size=4, dim=32, depth=2, heads=2, dim_head=16,
+        attn_types=("full", "axial_row"),
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def _fp_model_and_params(cfg=None):
+    cfg = cfg or _tiny_cfg()
+    model = DALLE(cfg)
+    k = jax.random.PRNGKey(7)
+    text = jax.random.randint(jax.random.fold_in(k, 1), (2, cfg.text_seq_len), 1, 50)
+    codes = jax.random.randint(
+        jax.random.fold_in(k, 2), (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = model.init(jax.random.fold_in(k, 3), text, codes)["params"]
+    return model, params, text, codes
+
+
+def _forced_decode_logits(model, params, text, image_codes, steps):
+    """Teacher-forced decode: prefill the text prefix, then feed the given
+    image codes token by token, collecting each step's logits.  Mirrors
+    models/generate.py:scan_decode with every position forced, so the
+    inputs (and hence any logits difference) are identical across cache
+    modes."""
+    c = model.cfg
+    b = text.shape[0]
+    remapped = model.apply({"params": params}, text, method=DALLE.remap_pad_tokens)
+    n = c.total_seq_len
+    forced = jnp.zeros((b, n), jnp.int32)
+    forced = forced.at[:, 1 : c.text_seq_len + 1].set(remapped)
+    n_img_fed = n - c.text_seq_len - 1
+    forced = forced.at[:, c.text_seq_len + 1 :].set(
+        image_codes[:, :n_img_fed] + c.total_text_tokens
+    )
+    cache = model.apply({"params": params}, b, method=DALLE.init_cache)
+    cache = model.apply(
+        {"params": params}, text.astype(jnp.int32), cache, method=DALLE.prefill
+    )
+    outs = []
+    for i in range(steps):
+        p = c.text_seq_len + i
+        logits, cache = model.apply(
+            {"params": params}, forced[:, p], p, cache, method=DALLE.decode_step
+        )
+        outs.append(logits)
+    return np.asarray(jnp.stack(outs, 1)), cache
+
+
+def test_cache_structure_and_bytes():
+    model, params, _, _ = _fp_model_and_params(
+        _tiny_cfg(attn_types=("full", "mlp"))
+    )
+    kvm = _kv_model(model)
+    fp_cache = model.apply({"params": params}, 2, method=DALLE.init_cache)
+    q_cache = kvm.apply({"params": params}, 2, method=DALLE.init_cache)
+    tc = q_cache["layer_0"]["attn"]["fn"]
+    assert tc["k"].dtype == jnp.int8 and tc["v"].dtype == jnp.int8
+    assert tc["k_scale"].dtype == jnp.float32
+    assert tc["k_scale"].shape == tc["k"].shape[:-1] + (1,)
+    # the 'mlp' (gMLP) layer's gate cache quantizes too
+    sc = q_cache["layer_1"]["attn"]["fn"]
+    assert sc["v"].dtype == jnp.int8 and sc["v_scale"].dtype == jnp.float32
+    nbytes = lambda c: sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(c)
+    )
+    # fp32 cache -> int8 + one f32 scale per row: ~4x smaller per token
+    assert nbytes(q_cache) < 0.3 * nbytes(fp_cache)
+
+
+def test_decode_logits_close_to_fp():
+    model, params, text, codes = _fp_model_and_params()
+    fp, _ = _forced_decode_logits(model, params, text, codes, steps=6)
+    q, _ = _forced_decode_logits(_kv_model(model), params, text, codes, steps=6)
+    allowed = fp > -1e29  # compare only unmasked vocab entries
+    np.testing.assert_array_equal(allowed, q > -1e29)
+    rel = np.linalg.norm(fp[allowed] - q[allowed]) / np.linalg.norm(fp[allowed])
+    assert rel < 0.03, rel
+
+
+def test_prefilled_rows_quantized():
+    """Prefill writes the text region through the same quantizer — the rows
+    are int8 and dequantize back to ~the fp cache rows."""
+    model, params, text, codes = _fp_model_and_params()
+    _, fp_cache = _forced_decode_logits(model, params, text, codes, steps=1)
+    _, q_cache = _forced_decode_logits(
+        _kv_model(model), params, text, codes, steps=1
+    )
+    fp_k = np.asarray(fp_cache["layer_0"]["attn"]["fn"]["k"])
+    qq = q_cache["layer_0"]["attn"]["fn"]
+    deq = np.asarray(qq["k"].astype(jnp.float32) * qq["k_scale"])
+    t = model.cfg.text_seq_len
+    # per-row absmax/127 quantization: error bounded by half a step
+    step = np.asarray(qq["k_scale"])[:, :, : t + 1]
+    err = np.abs(deq[:, :, : t + 1] - fp_k[:, :, : t + 1])
+    assert (err <= step / 2 + 1e-6).all()
+
+
+def test_greedy_samples_match_fp():
+    """Near-argmax sampling: the int8 cache's ~0.4%-per-row error must not
+    flip the top-1 token on a tiny model (deterministic given the seed)."""
+    model, params, text, _ = _fp_model_and_params()
+    kw = dict(key=jax.random.PRNGKey(11), temperature=1e-6, filter_thres=0.0)
+    fp_codes = np.asarray(generate_image_codes(model, params, text, **kw))
+    q_codes = np.asarray(
+        generate_image_codes(_kv_model(model), params, text, **kw)
+    )
+    assert fp_codes.shape == q_codes.shape == (2, model.cfg.image_seq_len)
+    match = (fp_codes == q_codes).mean()
+    assert match >= 0.95, match
+
+
+def test_composes_with_int8_weights():
+    from dalle_tpu.models.quantize import quantize_for_decode
+
+    model, params, text, _ = _fp_model_and_params()
+    qmodel, qparams = quantize_for_decode(model, params)
+    qkv = _kv_model(qmodel)
+    assert qkv.cfg.quant_int8 and qkv.cfg.kv_int8
+    codes = np.asarray(
+        generate_image_codes(qkv, qparams, text, jax.random.PRNGKey(5))
+    )
+    assert codes.shape == (2, model.cfg.image_seq_len)
+    assert (codes >= 0).all() and (codes < model.cfg.num_image_tokens).all()
+
+
+def test_rotary_and_shift_paths():
+    """kv_int8 under the decode paths with extra cache state: rotary tables
+    and the token-shift hist cache (hist itself stays fp — it is read two
+    rows per step, not re-streamed whole)."""
+    cfg = _tiny_cfg(rotary_emb=True, shift_tokens=True, attn_types=("full",))
+    model, params, text, codes = _fp_model_and_params(cfg)
+    fp, _ = _forced_decode_logits(model, params, text, codes, steps=4)
+    q, _ = _forced_decode_logits(_kv_model(model), params, text, codes, steps=4)
+    allowed = fp > -1e29
+    rel = np.linalg.norm(fp[allowed] - q[allowed]) / np.linalg.norm(fp[allowed])
+    assert rel < 0.03, rel
+
+
+def test_training_forward_unaffected():
+    """kv_int8 is decode-only: the training __call__ never touches a cache,
+    so losses are bitwise identical."""
+    model, params, text, codes = _fp_model_and_params()
+    loss_fp = model.apply(
+        {"params": params}, text, codes, return_loss=True
+    )
+    loss_q = _kv_model(model).apply(
+        {"params": params}, text, codes, return_loss=True
+    )
+    np.testing.assert_array_equal(np.asarray(loss_fp), np.asarray(loss_q))

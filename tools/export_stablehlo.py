@@ -158,6 +158,10 @@ def main():
                          "(dynamic s8xs8 mode only: pure StableHLO ops, "
                          "portable; weight_only would bake a "
                          "platform-specific Pallas kernel)")
+    ap.add_argument("--kv_int8", action="store_true",
+                    help="int8 KV cache in the exported decoder (pure "
+                         "StableHLO quant/dequant ops; transformer.py "
+                         "kv_int8)")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args()
     import dalle_tpu
@@ -184,6 +188,11 @@ def main():
 
         model, params = quantize_for_decode(model, params, mode="dynamic")
         print("int8 (dynamic) quantized before export", file=sys.stderr)
+    if args.kv_int8:
+        from dalle_tpu.models.quantize import kv_int8_model
+
+        model = kv_int8_model(model)
+        print("int8 KV cache enabled in the exported decoder", file=sys.stderr)
     meta = export_dalle(
         model, params, args.out, batch=args.batch,
         temperature=args.temperature, filter_thres=args.filter_thres,
