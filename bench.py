@@ -73,6 +73,7 @@ PHASES = [
     ("train", 1200, True),        # flagship, dense XLA attention (can't hang in Mosaic)
     ("train_fused", 900, True),   # flagship + fused range-split CE (ops/fused_ce.py)
     ("train_flash", 900, True),   # flagship, Pallas flash kernel
+    ("train_flash_fused", 900, True),  # flash attention + fused CE together: the expected-best TPU mode
     ("flash_check", 600, True),
     ("generate", 1080, True),
     ("generate_int8", 600, True),  # int8 decode (ops/quant.py), own rung
@@ -391,12 +392,12 @@ def main():
     import atexit
 
     atexit.register(_release_busy, busy_file)
-    # default covers the sum of phase budgets (7000s incl. the flash_probe,
-    # train_fused and generate_int8 rungs) plus slack; a worst-case
-    # preflight (2x300s) or repeated reprobes can still eat into the tail
-    # phases' budgets — the deadline bounds the WHOLE run on purpose,
-    # trading tail evidence for a predictable driver runtime
-    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "7800"))
+    # default covers the sum of phase budgets (7900s incl. the flash_probe,
+    # train_fused, train_flash_fused and generate_int8 rungs) plus slack; a
+    # worst-case preflight (2x300s) or repeated reprobes can still eat into
+    # the tail phases' budgets — the deadline bounds the WHOLE run on
+    # purpose, trading tail evidence for a predictable driver runtime
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "8700"))
     attempts = []
     info = None
     for attempt in range(2):
@@ -456,7 +457,7 @@ def main():
     # fallback of last resort.  A Mosaic hang in train_flash can never
     # sink the headline — the dense flagship already ran.
     flagship_ok = [
-        s for s in ("train", "train_fused", "train_flash")
+        s for s in ("train", "train_fused", "train_flash", "train_flash_fused")
         if phases.get(s, {}).get("ok")
     ]
     headline = None
@@ -512,7 +513,7 @@ def main():
                 k: v for k, v in r.items() if k not in ("ok",)
             })
             for n, r in phases.items()
-            if n not in ("train", "train_fused", "train_flash", "train_tiny")
+            if n not in ("train", "train_fused", "train_flash", "train_flash_fused", "train_tiny")
         },
         "train_phases": {
             n: (
@@ -525,7 +526,7 @@ def main():
                 if r.get("ok") else r
             )
             for n, r in phases.items()
-            if n in ("train", "train_fused", "train_flash", "train_tiny")
+            if n in ("train", "train_fused", "train_flash", "train_flash_fused", "train_tiny")
         },
         "total_s": round(time.time() - t_start, 1),
     }
@@ -642,7 +643,9 @@ def _train_bench(tiny=False, use_flash=False, loss_chunk=None):
     if profile_dir:
         profile_dir = os.path.join(
             profile_dir,
-            "flash" if use_flash else ("fused" if loss_chunk else "dense"),
+            ("flash_fused" if loss_chunk else "flash")
+            if use_flash
+            else ("fused" if loss_chunk else "dense"),
         )
     if profile_dir and not tiny:
         from dalle_tpu.training.profiler import profile_window
@@ -957,6 +960,7 @@ PHASE_FNS = {
     "train": _train_bench,
     "train_fused": lambda: _train_bench(loss_chunk=256),
     "train_flash": lambda: _train_bench(use_flash=True),
+    "train_flash_fused": lambda: _train_bench(use_flash=True, loss_chunk=256),
     "flash_check": _flash_check,
     "generate": _generate_bench,
     "generate_int8": lambda: _generate_bench(quant=True),
