@@ -106,6 +106,89 @@ def save_checkpoint(
     return str(path)
 
 
+class AsyncCheckpointWriter:
+    """Background-thread checkpoint writes — the train loop stops paying
+    for serialization + disk IO.
+
+    ``save()`` synchronously snapshots the array trees to host memory
+    (``jax.device_get`` — the only part that must see device state at the
+    step's value) and hands the actual :func:`save_checkpoint` call to a
+    worker thread.  At most one write is in flight: a second ``save()``
+    (or ``wait()``) joins the previous one first, so retention pruning and
+    directory renames never race.  A failed background write re-raises at
+    the next ``save()``/``wait()`` — a crashed save is an error, not a
+    silent gap in the checkpoint series.
+
+    Single-process only: the multi-host save path is a collective with
+    cross-process barriers (``_mp_barrier``) that every process must enter
+    at the same point — trainers fall back to synchronous saves there.
+    The reference has no async analog (its ``save_model`` blocks the loop,
+    reference: train_dalle.py:514-557).
+    """
+
+    def __init__(self):
+        assert jax.process_count() == 1, (
+            "AsyncCheckpointWriter is single-process; multi-host saves are "
+            "collectives and must stay synchronous"
+        )
+        self._thread = None
+        self._error = None
+
+    def save(self, path: str, **kwargs) -> None:
+        """Same signature as :func:`save_checkpoint`; returns immediately
+        after the host snapshot."""
+        import threading
+
+        self.wait()
+        host_kwargs = dict(kwargs)
+        # snapshot exactly the subtrees save_checkpoint treats as arrays
+        for name in _SUBTREES:
+            if host_kwargs.get(name) is not None:
+                host_kwargs[name] = jax.device_get(host_kwargs[name])
+
+        def work():
+            try:
+                save_checkpoint(path, **host_kwargs)
+            except BaseException as e:  # re-raised on the main thread
+                self._error = e
+
+        # non-daemon: a crash between an accepted in-loop save and the
+        # next save()/wait() must still land the checkpoint — interpreter
+        # exit joins non-daemon threads, so the write finishes instead of
+        # being killed mid-flight (the sync baseline would have persisted
+        # it; async must not be lossier under failure)
+        self._thread = threading.Thread(
+            target=work, name="ckpt-writer", daemon=False
+        )
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight write (if any); re-raise its failure."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
+
+
+def make_async_writer(enabled: bool) -> Optional[AsyncCheckpointWriter]:
+    """The trainers' shared ``--async_ckpt`` setup: a writer when enabled
+    and single-process, else None (with a loud fallback warning under
+    multi-host, whose saves are collectives and must stay synchronous)."""
+    if not enabled:
+        return None
+    if jax.process_count() > 1:
+        import warnings
+
+        warnings.warn(
+            "--async_ckpt is single-process only (multi-host saves are "
+            "collectives); falling back to synchronous saves"
+        )
+        return None
+    return AsyncCheckpointWriter()
+
+
 def _family_pattern(name: str) -> str:
     """name like foo-step123 → 'foo-step*'; else exact name won't prune."""
     import re

@@ -186,3 +186,55 @@ def test_opt_state_subtree_roundtrip(tmp_path, rng):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # restored state is USABLE: another update step runs
     tx.update(grads, restored, params)
+
+
+def test_async_writer_roundtrip_and_snapshot(tmp_path, rng):
+    """AsyncCheckpointWriter: the background write lands a loadable
+    checkpoint, and the saved values are a SNAPSHOT at save() time — the
+    caller mutating its arrays afterwards must not leak into the file."""
+    from dalle_tpu.training.checkpoint import (
+        AsyncCheckpointWriter,
+        load_subtree,
+        shape_dtype_of,
+    )
+
+    params = {"w": jax.random.normal(rng, (8, 8))}
+    want = np.asarray(params["w"]).copy()
+    writer = AsyncCheckpointWriter()
+    path = str(tmp_path / "async-ck")
+    writer.save(path, params=params, hparams={"dim": 8}, step=3)
+    # mutate the caller's tree while the write may still be in flight
+    params["w"] = params["w"] + 100.0
+    writer.wait()
+    assert is_checkpoint(path)
+    meta = load_meta(path)
+    assert meta["step"] == 3 and meta["hparams"] == {"dim": 8}
+    got = load_subtree(path, "params", shape_dtype_of({"w": want}))
+    np.testing.assert_allclose(np.asarray(got["w"]), want, atol=0)
+
+
+def test_async_writer_serializes_and_raises(tmp_path, rng):
+    """A second save() joins the first (ordering: the newest write wins
+    the same path), and a failed background write re-raises on the main
+    thread instead of disappearing."""
+    import pytest
+
+    from dalle_tpu.training.checkpoint import AsyncCheckpointWriter
+
+    writer = AsyncCheckpointWriter()
+    path = str(tmp_path / "ck")
+    a = {"w": jnp.zeros((4,))}
+    b = {"w": jnp.ones((4,))}
+    writer.save(path, params=a, hparams={}, step=1)
+    writer.save(path, params=b, hparams={}, step=2)  # joins write #1 first
+    writer.wait()
+    assert load_meta(path)["step"] == 2
+
+    # unserializable hparams fail in the worker; wait() must surface it
+    writer.save(str(tmp_path / "bad"), params=a, hparams={"f": object()})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        writer.wait()
+    # the writer stays usable after a failure
+    writer.save(path, params=a, hparams={}, step=5)
+    writer.wait()
+    assert load_meta(path)["step"] == 5

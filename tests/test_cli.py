@@ -503,6 +503,9 @@ def test_ga_lr_decay_and_pruning(tiny_data, tmp_path):
         "--lr_decay",
         "--save_every_n_steps", "2",
         "--keep_n_checkpoints", "2",
+        # in-loop saves through the background writer: the step-family
+        # assertions below then prove async saves land + prune correctly
+        "--async_ckpt",
     ])
     from dalle_tpu.training.checkpoint import is_checkpoint, load_meta
 

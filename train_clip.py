@@ -49,6 +49,10 @@ def parse_args(argv=None):
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="clip_ckpt")
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--async_ckpt", action="store_true",
+                        help="in-loop step checkpoints from a background "
+                             "thread (single-process only; "
+                             "training/checkpoint.py AsyncCheckpointWriter)")
     parser.add_argument("--wandb_name", type=str, default="clip_train")
     parser.add_argument("--no_wandb", action="store_true")
     # model (defaults mirror the reference README snippet, README.md:210-227)
@@ -194,15 +198,25 @@ def main(argv=None):
         resume_epoch = resume_meta.get("epoch", 0)
     start_epoch = resume_epoch
 
+    from dalle_tpu.training.checkpoint import make_async_writer
+
+    ckpt_writer = make_async_writer(args.async_ckpt)
+
     def save(name, *, in_loop=False):
         # every process calls: save_checkpoint is a collective under
         # multi-host (orbax sharded writes + cross-process barriers,
         # checkpoint.py); it gates directory ops on process 0 itself
-        save_checkpoint(
-            str(ckpt_dir / name), params=params, hparams=cfg.to_dict(),
+        kwargs = dict(
+            params=params, hparams=cfg.to_dict(),
             opt_state=opt_state, epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
         )
+        if ckpt_writer is not None:
+            if in_loop:
+                ckpt_writer.save(str(ckpt_dir / name), **kwargs)
+                return
+            ckpt_writer.wait()
+        save_checkpoint(str(ckpt_dir / name), **kwargs)
 
     from dalle_tpu.training.profiler import Meter
 

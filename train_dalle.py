@@ -86,6 +86,13 @@ def parse_args(argv=None):
                         help="jax.profiler trace at step 200 (reference parity)")
     parser.add_argument("--epochs", type=int, default=20)
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--async_ckpt", action="store_true",
+                        help="write in-loop step checkpoints from a "
+                             "background thread: the loop only pays for "
+                             "the device->host snapshot, not "
+                             "serialization + disk IO.  Single-process "
+                             "only (multi-host saves are collectives and "
+                             "stay synchronous)")
     parser.add_argument("--keep_n_checkpoints", type=int, default=None)
     parser.add_argument("--batch_size", type=int, default=4)
     parser.add_argument("--ga_steps", type=int, default=1)
@@ -448,14 +455,17 @@ def main(argv=None):
     # a no-op instead of re-training the last epoch
     resume_epoch = start_epoch
 
+    from dalle_tpu.training.checkpoint import make_async_writer
+
+    ckpt_writer = make_async_writer(args.async_ckpt)
+
     def save(tag, *, in_loop=False):
         # every process calls: save_checkpoint is a collective under
         # multi-host (orbax sharded writes + cross-process barriers,
         # checkpoint.py); it gates directory ops on process 0 itself.
         # in_loop saves run BEFORE the step counter increments, so the
         # stored step is global_step+1 (= number of applied updates).
-        save_checkpoint(
-            str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}"),
+        kwargs = dict(
             params=params,
             hparams=cfg.to_dict(),
             opt_state=opt_state,  # resume restores it (reference :424)
@@ -467,6 +477,16 @@ def main(argv=None):
             scheduler_state=sched.state_dict() if sched else None,
             keep_n=args.keep_n_checkpoints,
         )
+        path = str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}")
+        if ckpt_writer is not None:
+            if in_loop:
+                # the frequent, loop-stalling saves go async
+                ckpt_writer.save(path, **kwargs)
+                return
+            # epoch/final/init saves stay synchronous: the epoch artifact
+            # upload and the fail-early contract read the dir right after
+            ckpt_writer.wait()
+        save_checkpoint(path, **kwargs)
 
     # fail-early checkpoint (reference: train_dalle.py:561-563)
     save("init")

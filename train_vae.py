@@ -59,6 +59,10 @@ def parse_args(argv=None):
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="vae_ckpt")
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--async_ckpt", action="store_true",
+                        help="in-loop step checkpoints from a background "
+                             "thread (single-process only; "
+                             "training/checkpoint.py AsyncCheckpointWriter)")
     parser.add_argument("--wandb_name", type=str, default="dalle_tpu_train_vae")
     parser.add_argument("--no_wandb", action="store_true")
     parser.add_argument("--config_json", type=str, default=None,
@@ -203,14 +207,17 @@ def main(argv=None):
     resume_epoch = start_epoch
     t10 = time.perf_counter()
 
+    from dalle_tpu.training.checkpoint import make_async_writer
+
+    ckpt_writer = make_async_writer(args.async_ckpt)
+
     def save(name, *, in_loop=False):
         # every process calls: save_checkpoint is a collective under
         # multi-host (orbax sharded writes + cross-process barriers,
         # checkpoint.py); it gates directory ops on process 0 itself.
         # in_loop saves run BEFORE the step counter increments, so the
         # stored step is global_step+1 (= number of applied updates).
-        save_checkpoint(
-            f"{args.output_path}/{name}",
+        kwargs = dict(
             params=params,
             hparams=cfg.to_dict(),
             opt_state=opt_state,
@@ -218,6 +225,13 @@ def main(argv=None):
             step=global_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict(),
         )
+        path = f"{args.output_path}/{name}"
+        if ckpt_writer is not None:
+            if in_loop:
+                ckpt_writer.save(path, **kwargs)
+                return
+            ckpt_writer.wait()
+        save_checkpoint(path, **kwargs)
 
     for epoch in range(start_epoch, args.epochs):
         resume_epoch = epoch
