@@ -90,7 +90,20 @@ PHASE_ARGV = {
         "--skip_4096",
         "--timeout", "150",
     ],
+    "flash_tune": [
+        sys.executable,
+        os.path.join(REPO, "tools", "flash_tune.py"),
+    ],
 }
+
+# opt-in rung (BENCH_TUNE=1): block-size sweep between the kernel probe
+# and the train rungs — its best config is exported to the later phases'
+# environment.  Off by default to protect the chip-window time budget.
+# Its budget also raises the global-deadline default (read at run time in
+# main) so the tail rungs aren't silently starved on a tuned run.
+_TUNE_BUDGET_S = 600
+if os.environ.get("BENCH_TUNE"):
+    PHASES.insert(1, ("flash_tune", _TUNE_BUDGET_S, True))
 RUNGS_PATH = os.path.join(LOG_DIR, "rungs.jsonl")
 
 _PREFLIGHT_CODE = """
@@ -397,7 +410,8 @@ def main():
     # worst-case preflight (2x300s) or repeated reprobes can still eat into
     # the tail phases' budgets — the deadline bounds the WHOLE run on
     # purpose, trading tail evidence for a predictable driver runtime
-    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "8700"))
+    default_deadline = 8700 + (_TUNE_BUDGET_S if os.environ.get("BENCH_TUNE") else 0)
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", default_deadline))
     attempts = []
     info = None
     for attempt in range(2):
@@ -441,6 +455,16 @@ def main():
                 res["int8_speedup_vs_fp"] = round(
                     res["imgs_per_sec"] / g["imgs_per_sec"], 2
                 )
+        if name == "flash_tune" and res.get("ok") and res.get("best_train"):
+            # apply the tuned block sizes to every later phase (they run
+            # as subprocesses and inherit this environment): train_flash*
+            # then measures the TUNED kernel, not the 128x128 default
+            bt = res["best_train"]
+            os.environ["DALLE_TPU_FLASH_BLOCK_Q"] = str(bt["bq"])
+            os.environ["DALLE_TPU_FLASH_BLOCK_K"] = str(bt["bk"])
+            print(f"flash_tune: applying block_q={bt['bq']} "
+                  f"block_k={bt['bk']} to later phases",
+                  file=sys.stderr, flush=True)
         _persist_rung(run_id, name, res)
         print(f"phase {name}: {'ok' if res['ok'] else res['error']} "
               f"({res.get('phase_s')}s)", file=sys.stderr, flush=True)

@@ -60,13 +60,39 @@ def pick_block(n: int, target: int = 128) -> int:
     return max(b, 1)
 
 
-def _layout_or_causal(layout, nqb, nkb, causal):
+def default_block(which: str) -> int:
+    """Block-size default for :func:`flash_attention` / :func:`flash_plan`.
+
+    ``DALLE_TPU_FLASH_BLOCK_Q`` / ``_K`` override the built-in 128 — the
+    application path for ``tools/flash_tune.py`` results: export the env
+    vars the tuner prints and every flash call site (training, bench,
+    generate) picks them up without code edits."""
+    import os
+
+    assert which in ("q", "k"), which
+    var = f"DALLE_TPU_FLASH_BLOCK_{which.upper()}"
+    raw = os.environ.get(var)
+    if not raw:
+        return 128
+    val = int(raw)
+    assert val > 0, f"{var}={raw!r}: block size must be a positive integer"
+    return val
+
+
+def _layout_or_causal(layout, nqb, nkb, bq, bk, causal):
     if layout is None:
-        layout = (
-            np.tril(np.ones((nqb, nkb), dtype=bool))
-            if causal
-            else np.ones((nqb, nkb), dtype=bool)
-        )
+        if causal:
+            # block (i, j) is live iff its first key position is visible to
+            # its last query position: j*bk <= (i+1)*bq - 1.  With bq == bk
+            # this is plain tril; with bq != bk a tril over the rectangular
+            # block grid drops live blocks (or keeps dead ones) — the
+            # elementwise causal mask inside the kernel handles the
+            # partial-block boundary either way.
+            i = np.arange(nqb)[:, None]
+            j = np.arange(nkb)[None, :]
+            layout = j * bk < (i + 1) * bq
+        else:
+            layout = np.ones((nqb, nkb), dtype=bool)
     assert layout.shape == (nqb, nkb)
     return np.asarray(layout, dtype=np.bool_)
 
@@ -159,7 +185,7 @@ _KPM_SLOT = 4  # kpm_ref position in the kernels' ref lists (after lay/q/k/v)
 def _flash_fwd(q, k, v, kpm, layout, bq, bk, scale, causal, h):
     bh, n, d = q.shape
     nqb, nkb = n // bq, n // bk
-    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb, causal), jnp.int32)
+    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb, bq, bk, causal), jnp.int32)
     kernel = functools.partial(
         _fwd_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal,
         has_mask=kpm is not None,
@@ -297,7 +323,7 @@ def _bwd_dkv_kernel(
 def _flash_bwd(q, k, v, o, lse, do, kpm, layout, bq, bk, scale, causal, h):
     bh, n, d = q.shape
     nqb, nkb = n // bq, n // bk
-    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb, causal), jnp.int32)
+    lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb, bq, bk, causal), jnp.int32)
     has_mask = kpm is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh, n]
 
@@ -422,8 +448,8 @@ def flash_attention(
     *,
     layout: Optional[np.ndarray] = None,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     key_pad_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """q, k, v: [b, h, n, d] → [b, h, n, d].
@@ -439,8 +465,8 @@ def flash_attention(
     coverage) — callers should not rely on such rows.
     """
     b, h, n, d = q.shape
-    bq = pick_block(n, block_q)
-    bk = pick_block(n, block_k)
+    bq = pick_block(n, block_q if block_q is not None else default_block("q"))
+    bk = pick_block(n, block_k if block_k is not None else default_block("k"))
     if layout is not None:
         assert layout.shape == (n // bq, n // bk), (
             f"layout {layout.shape} != {(n // bq, n // bk)}"
@@ -467,7 +493,7 @@ def block_layout_from_mask(mask: np.ndarray, bq: int, bk: int) -> np.ndarray:
     return blocks.any(axis=(1, 3))
 
 
-def flash_plan(mask: np.ndarray, prefer: int = 128):
+def flash_plan(mask: np.ndarray, prefer: Optional[int] = None):
     """Find the largest flash block size whose (layout ⊗ causal)
     reconstruction equals ``mask`` exactly.  Returns (layout, block) or None
     (→ caller falls back to dense-masked attention).  This is the safety
@@ -475,7 +501,7 @@ def flash_plan(mask: np.ndarray, prefer: int = 128):
     n = mask.shape[0]
     i = np.arange(n)
     causal = i[None, :] <= i[:, None]
-    b = pick_block(n, prefer)
+    b = pick_block(n, prefer if prefer is not None else default_block("q"))
     while b >= 8:
         if n % b == 0:
             layout = block_layout_from_mask(mask, b, b)

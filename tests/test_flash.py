@@ -200,3 +200,53 @@ def test_noncausal_transformer_flash_matches_dense(rng):
     want = m_dense.apply({"params": params}, x, key_pad_mask=kpmj)
     got = Transformer(cfg(True)).apply({"params": params}, x, key_pad_mask=kpmj)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_block_env_knobs(rng, monkeypatch):
+    """DALLE_TPU_FLASH_BLOCK_Q/_K set the kernel's default block sizes
+    (tools/flash_tune.py's application path) without changing numerics."""
+    from dalle_tpu.ops.flash import default_block, flash_attention
+
+    assert default_block("q") == 128  # built-in default
+    monkeypatch.setenv("DALLE_TPU_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("DALLE_TPU_FLASH_BLOCK_K", "32")
+    assert default_block("q") == 64 and default_block("k") == 32
+    q, k, v = [
+        jax.random.normal(jax.random.fold_in(rng, i), (1, 2, 128, 16))
+        for i in range(3)
+    ]
+    got = flash_attention(q, k, v)  # env-driven 64x32 blocks
+    monkeypatch.delenv("DALLE_TPU_FLASH_BLOCK_Q")
+    monkeypatch.delenv("DALLE_TPU_FLASH_BLOCK_K")
+    want = flash_attention(q, k, v)  # default 128x128
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_asymmetric_blocks_match_dense(rng):
+    """Regression: causal block layouts with bq != bk (a tril over the
+    rectangular block grid used to drop live blocks — found by the
+    flash_tune sweep's asymmetric configs)."""
+    q, k, v = [
+        jax.random.normal(jax.random.fold_in(rng, i), (1, 2, 128, 16))
+        for i in range(3)
+    ]
+    want = A.full_causal_attention(q, k, v)
+    want_grad = jax.grad(
+        lambda q: jnp.sum(A.full_causal_attention(q, k, v))
+    )(q)
+    for bq, bk in ((64, 16), (16, 64), (64, 32), (32, 64)):
+        got = flash_attention(q, k, v, block_q=bq, block_k=bk)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5,
+            err_msg=f"bq={bq} bk={bk}",
+        )
+        # the backward kernels (dq, dkv) walk the same rectangular layout
+        got_grad = jax.grad(
+            lambda q, _bq=bq, _bk=bk: jnp.sum(
+                flash_attention(q, k, v, block_q=_bq, block_k=_bk)
+            )
+        )(q)
+        np.testing.assert_allclose(
+            np.asarray(got_grad), np.asarray(want_grad), atol=2e-5,
+            err_msg=f"grad bq={bq} bk={bk}",
+        )

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Flash-kernel block-size autotuner.
+
+Mosaic's best (block_q, block_k) for ``ops/flash.py`` depends on the
+generation of TPU under it (VMEM size, MXU shape) — a constant baked into
+the kernel is wrong on at least one chip.  This tool sweeps the block
+sizes that divide the sequence length at flagship shapes, times fwd and
+fwd+bwd per config on the CURRENT backend, and prints the winners as
+environment exports:
+
+    export DALLE_TPU_FLASH_BLOCK_Q=<bq> DALLE_TPU_FLASH_BLOCK_K=<bk>
+
+which every flash call site (training, bench, generate) picks up as its
+default (``ops/flash.py:default_block``) — tuning applies without code
+edits.  Per-config results append to ``--log`` BEFORE the next config
+runs, so a mid-sweep wedge still leaves evidence (same discipline as
+tools/flash_probe.py).  Off-TPU the kernel runs in interpret mode: the
+sweep is then harness validation, not perf evidence (recorded as
+``on_tpu: false``).
+
+Run it inside a chip window after ``tools/flash_probe.py`` passes (the
+probe isolates Mosaic compile hangs; the tuner assumes compilation works).
+Reference capability context: the DeepSpeed sparse kernels this replaces
+ship fixed block=16 configs (/root/reference/dalle_pytorch/attention.py:335-351).
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_LOG = os.path.join(REPO, "bench_logs", "flash_tune.jsonl")
+
+
+def _candidates(n: int, smoke: bool):
+    """(bq, bk) pairs: divisors of n from the plausible TPU range."""
+    sizes = [b for b in (64, 128, 256, 512, 640) if b <= n and n % b == 0]
+    if smoke:
+        sizes = sizes[:2]
+    return list(itertools.product(sizes, sizes))
+
+
+def run_sweep(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dalle_tpu.ops.flash import flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    b, h = args.bh // args.heads, args.heads
+    rng = jax.random.PRNGKey(0)
+    qkv = [
+        jax.random.normal(jax.random.fold_in(rng, i), (b, h, args.n, args.d), dtype)
+        for i in range(3)
+    ]
+
+    os.makedirs(os.path.dirname(args.log), exist_ok=True)
+    results = []
+    for bq, bk in _candidates(args.n, args.smoke):
+        rec = {"bq": bq, "bk": bk, "n": args.n, "d": args.d, "bh": args.bh,
+               "dtype": args.dtype, "on_tpu": on_tpu, "t": time.time()}
+        try:
+            fwd = jax.jit(lambda q, k, v, _bq=bq, _bk=bk: flash_attention(
+                q, k, v, block_q=_bq, block_k=_bk))
+            loss = jax.jit(jax.grad(lambda q, k, v, _bq=bq, _bk=bk: jnp.sum(
+                flash_attention(q, k, v, block_q=_bq, block_k=_bk).astype(jnp.float32))))
+            t0 = time.perf_counter()
+            fwd(*qkv).block_until_ready()
+            rec["fwd_compile_s"] = round(time.perf_counter() - t0, 2)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = fwd(*qkv)
+            out.block_until_ready()
+            rec["fwd_ms"] = round((time.perf_counter() - t0) / args.iters * 1e3, 3)
+            t0 = time.perf_counter()
+            loss(*qkv).block_until_ready()
+            rec["bwd_compile_s"] = round(time.perf_counter() - t0, 2)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                g = loss(*qkv)
+            g.block_until_ready()
+            rec["fwdbwd_ms"] = round((time.perf_counter() - t0) / args.iters * 1e3, 3)
+            rec["ok"] = True
+        except Exception as e:  # a failed config is data, not a crash
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"[-300:]
+        results.append(rec)
+        with open(args.log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[{time.strftime('%H:%M:%S')}] bq={bq} bk={bk}: "
+              + (f"fwd {rec.get('fwd_ms')}ms fwdbwd {rec.get('fwdbwd_ms')}ms"
+                 if rec["ok"] else rec["error"]),
+              file=sys.stderr)
+
+    ok = [r for r in results if r.get("ok")]
+    summary = {
+        "tool": "flash_tune", "n": args.n, "d": args.d, "bh": args.bh,
+        "dtype": args.dtype, "on_tpu": on_tpu,
+        "configs_ok": len(ok), "configs_total": len(results),
+    }
+    if ok:
+        best_f = min(ok, key=lambda r: r["fwd_ms"])
+        best_t = min(ok, key=lambda r: r["fwdbwd_ms"])
+        summary["best_fwd"] = {k: best_f[k] for k in ("bq", "bk", "fwd_ms")}
+        summary["best_train"] = {k: best_t[k] for k in ("bq", "bk", "fwdbwd_ms")}
+        summary["export"] = (
+            f"export DALLE_TPU_FLASH_BLOCK_Q={best_t['bq']} "
+            f"DALLE_TPU_FLASH_BLOCK_K={best_t['bk']}"
+        )
+    return summary
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1280,
+                    help="sequence length (flagship joint sequence)")
+    ap.add_argument("--d", type=int, default=64, help="head dim")
+    ap.add_argument("--bh", type=int, default=64,
+                    help="batch*heads lanes (flagship: batch 8 x heads 8)")
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dtype", choices=("bfloat16", "float32"),
+                    default="bfloat16")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--log", default=DEFAULT_LOG)
+    ap.add_argument("--smoke", action="store_true",
+                    help="2x2 configs at the given shapes (harness check)")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE"):
+        # bench harness smoke (CPU interpret): tiny shapes, 2x2 configs —
+        # validates the rung end to end without minutes-per-config cost
+        args.n, args.d, args.bh, args.iters, args.smoke = 256, 32, 8, 2, True
+    summary = run_sweep(args)
+    print(json.dumps(summary))
+    return 0 if summary["configs_ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
