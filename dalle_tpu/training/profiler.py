@@ -39,16 +39,23 @@ def detect_peak_tflops(device: Optional[jax.Device] = None) -> float:
     return PEAK_TFLOPS["v4"]
 
 
+def _encoder_flops(dim, depth, heads, dim_head, ff_mult, seq, tokens) -> float:
+    """Matmul-dominated fwd FLOPs of one (pre-norm, GEGLU) transformer
+    encoder over ``tokens`` = batch*seq positions — shared by the DALLE
+    and CLIP meters so the formula can't drift between trainers."""
+    inner = heads * dim_head
+    per_layer = 2 * dim * 3 * inner + 2 * inner * dim  # qkv + out proj
+    per_layer += 2 * dim * (dim * ff_mult * 2) + 2 * (dim * ff_mult) * dim  # GEGLU
+    return depth * (per_layer * tokens + 4 * inner * seq * tokens)
+
+
 def dalle_train_flops(cfg, batch: int) -> float:
     """Analytic fwd+bwd FLOPs per train step (matmul-dominated terms)."""
     d = cfg.dim
-    inner = cfg.heads * cfg.dim_head
     n = cfg.total_seq_len
     tokens = batch * n
-    per_layer = 2 * d * 3 * inner + 2 * inner * d  # qkv + out proj
-    per_layer += 2 * d * (d * cfg.ff_mult * 2) + 2 * (d * cfg.ff_mult) * d  # GEGLU
-    matmul = cfg.depth * per_layer * tokens
-    attn = cfg.depth * 4 * inner * n * tokens  # qk^T + pv
+    body = _encoder_flops(d, cfg.depth, cfg.heads, cfg.dim_head,
+                          cfg.ff_mult, n, tokens)
     mult = 3.0  # fwd + 2x bwd
     if getattr(cfg, "reversible", False):
         mult += 1.0  # recompute in the inverted backward
@@ -66,7 +73,25 @@ def dalle_train_flops(cfg, batch: int) -> float:
         # the head sits OUTSIDE the reversible stack, so it is never part
         # of the inverted-backward recompute: always fwd + 2x bwd
         head_mult = 3.0
-    return mult * (matmul + attn) + head_mult * head
+    return mult * body + head_mult * head
+
+
+def clip_train_flops(cfg, batch: int) -> float:
+    """Analytic fwd+bwd FLOPs per CLIP train step: text encoder + ViT patch
+    encoder + patch/latent projections + the [b, b] similarity matmul
+    (models/clip.py; encoder geometry mirrors _enc_config's dim_head=64).
+    Gives train_clip the same MFU meter as the other trainers."""
+    fwd = _encoder_flops(cfg.dim_text, cfg.text_enc_depth, cfg.text_heads,
+                         64, 4, cfg.text_seq_len, batch * cfg.text_seq_len)
+    fwd += _encoder_flops(cfg.dim_image, cfg.visual_enc_depth,
+                          cfg.visual_heads, 64, 4, cfg.num_patches,
+                          batch * cfg.num_patches)
+    patch_dim = cfg.channels * cfg.visual_patch_size**2
+    fwd += 2 * patch_dim * cfg.dim_image * batch * cfg.num_patches
+    fwd += 2 * cfg.dim_text * cfg.dim_latent * batch  # pooled text -> latent
+    fwd += 2 * cfg.dim_image * cfg.dim_latent * batch
+    fwd += 2 * cfg.dim_latent * batch * batch  # similarity logits
+    return 3.0 * fwd  # fwd + 2x bwd
 
 
 def compiled_cost_analysis(compiled) -> dict:

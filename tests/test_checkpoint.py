@@ -238,3 +238,32 @@ def test_async_writer_serializes_and_raises(tmp_path, rng):
     writer.save(path, params=a, hparams={}, step=5)
     writer.wait()
     assert load_meta(path)["step"] == 5
+
+
+def test_clip_flops_close_to_xla(rng):
+    """clip_train_flops (the train_clip MFU meter) vs the compiler's own
+    FLOP count — same sanity bound as the DALLE model's meter."""
+    from dalle_tpu.models.clip import CLIP, CLIPConfig
+    from dalle_tpu.training.profiler import clip_train_flops, xla_cost_analysis
+
+    ccfg = CLIPConfig(
+        dim_text=64, dim_image=64, dim_latent=64, num_text_tokens=64,
+        text_enc_depth=2, text_seq_len=8, text_heads=4,
+        visual_enc_depth=2, visual_heads=4, visual_image_size=32,
+        visual_patch_size=8,
+    )
+    clip = CLIP(ccfg)
+    text = jnp.ones((4, 8), jnp.int32)
+    imgs = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    params = clip.init({"params": rng}, text, imgs)["params"]
+
+    def loss_fn(p, t, i):
+        return clip.apply({"params": p}, t, i, return_loss=True)
+
+    grad_fn = jax.jit(jax.grad(loss_fn))
+    ca = xla_cost_analysis(grad_fn, params, text, imgs)
+    xla_flops = ca.get("flops", 0.0)
+    analytic = clip_train_flops(ccfg, 4)
+    assert analytic > 0
+    if xla_flops > 0:
+        assert 0.2 < xla_flops / analytic < 5.0, (xla_flops, analytic)

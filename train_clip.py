@@ -218,11 +218,11 @@ def main(argv=None):
             ckpt_writer.wait()
         save_checkpoint(str(ckpt_dir / name), **kwargs)
 
-    from dalle_tpu.training.profiler import Meter
+    from dalle_tpu.training.profiler import Meter, clip_train_flops
 
     save("clip-init")  # fail-early (reference idiom: train_dalle.py:561-563)
     meter = Meter(
-        flops_per_step=0.0,  # no analytic CLIP FLOP model; mfu not reported
+        flops_per_step=clip_train_flops(cfg, args.batch_size),
         tokens_per_step=args.batch_size * args.text_seq_len,
         samples_per_step=args.batch_size,
     )
@@ -239,11 +239,13 @@ def main(argv=None):
                 if is_root:
                     print(
                         f"epoch {epoch} step {global_step} loss {loss_f:.5f} "
-                        f"({m['samples_per_sec']:.1f} samples/s)"
+                        f"({m['samples_per_sec']:.1f} samples/s, "
+                        f"MFU {m['mfu']:.1%})"
                     )
                     run.log(
                         {"loss": loss_f, "epoch": epoch,
-                         "samples_per_sec": m["samples_per_sec"]},
+                         "samples_per_sec": m["samples_per_sec"],
+                         "mfu": m["mfu"]},
                         step=global_step,
                     )
             if global_step and global_step % args.save_every_n_steps == 0:
