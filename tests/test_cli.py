@@ -620,3 +620,97 @@ def test_train_clip_resume(tiny_data, tmp_path, capsys):
     # completed run: no-op
     train_clip.main(common + ["--epochs", "2"])
     assert load_meta(out + "/clip-final")["step"] == meta2["step"]
+
+
+def test_crash_and_auto_resume(tiny_data, tmp_path, capsys):
+    """Fault injection (SURVEY.md §5.3 — the reference's recovery model is
+    'restart from the latest checkpoint'): SIGKILL a trainer mid-run, then
+    prove --auto_resume restarts from the newest completed step save and
+    finishes.  Run with --async_ckpt so the kill also exercises the
+    background writer's crash behavior (a torn write must leave only a
+    .tmp dir, which auto-resume skips)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    import train_dalle
+    import train_vae
+
+    vae_out = str(tmp_path / "vae_ckpt")
+    train_vae.main([
+        "--image_folder", tiny_data, "--image_size", "16",
+        "--batch_size", "4", "--epochs", "1", "--num_tokens", "16",
+        "--num_layers", "2", "--num_resnet_blocks", "0", "--emb_dim", "8",
+        "--hidden_dim", "8", "--output_path", vae_out, "--no_wandb",
+        "--mesh_dp", "4",
+    ])
+
+    out = tmp_path / "dalle_ckpt"
+    common = [
+        "--image_text_folder", tiny_data,
+        "--batch_size", "4", "--dim", "16", "--depth", "1",
+        "--heads", "2", "--dim_head", "8", "--text_seq_len", "8",
+        "--attn_types", "full", "--truncate_captions",
+        "--output_path", str(out), "--no_wandb", "--mesh_dp", "4",
+        "--save_every_n_steps", "1", "--async_ckpt", "--auto_resume",
+    ]
+    # victim run in a killable subprocess: many epochs so it cannot finish
+    err_path = tmp_path / "victim.stderr"
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                          "train_dalle.py")]
+            + common + ["--vae_path", vae_out + "/vae-final",
+                        "--epochs", "50"],
+            start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=err_f,
+        )
+    try:
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if list(out.glob("dalle-step*")) and not any(
+                d.name.endswith(".tmp") for d in out.glob("dalle-step*")
+            ):
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"victim exited early rc={proc.returncode}; stderr tail: "
+                    + "\n".join(err_path.read_text().splitlines()[-15:])
+                )
+            time.sleep(1.0)
+        else:
+            raise AssertionError(
+                "no step checkpoint appeared before kill; stderr tail: "
+                + "\n".join(err_path.read_text().splitlines()[-15:])
+            )
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except ProcessLookupError:
+            pass  # the victim lost a race with its own exit; ckpt exists
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+    from dalle_tpu.training.checkpoint import (
+        find_latest_checkpoint, is_checkpoint, load_meta,
+    )
+
+    latest = find_latest_checkpoint(str(out), "dalle")
+    assert latest and is_checkpoint(latest), latest
+    killed_meta = load_meta(latest)
+    killed_step = killed_meta["step"]
+    assert killed_step >= 1
+
+    # survivor run resumes in-process and must actually TRAIN (not just
+    # re-save): one epoch beyond whatever the killed run had reached
+    capsys.readouterr()
+    survivor_epochs = killed_meta["epoch"] + 1
+    train_dalle.main(common + ["--epochs", str(survivor_epochs)])
+    outp = capsys.readouterr().out
+    assert "--auto_resume: resuming from" in outp
+    final = out / "dalle-final"
+    assert is_checkpoint(str(final))
+    assert load_meta(str(final))["step"] > killed_step
