@@ -76,6 +76,10 @@ class DALLEConfig:
     depth: int = 2
     heads: int = 8
     dim_head: int = 64
+    # grouped-query attention (transformer.py kv_heads): K/V heads shared
+    # across query-head groups — the decode KV cache shrinks by
+    # heads/kv_heads.  None = standard MHA (reference parity)
+    kv_heads: Optional[int] = None
     ff_mult: int = 4
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
@@ -141,6 +145,7 @@ class DALLEConfig:
             depth=self.depth,
             heads=self.heads,
             dim_head=self.dim_head,
+            kv_heads=self.kv_heads,
             text_seq_len=self.text_seq_len,
             fmap_size=self.image_fmap_size,
             attn_types=self.attn_types,

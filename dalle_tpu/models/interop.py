@@ -309,6 +309,11 @@ def _map_transformer_layers(sd, prefix, depth, reversible=False):
 def convert_ref_dalle_state(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     """Reference DALLE state_dict (``vae.*`` keys already stripped) → our
     flax param tree.  Param surface per dalle_pytorch.py:309-591."""
+    assert cfg.kv_heads in (None, cfg.heads), (
+        "grouped-query attention (kv_heads < heads) has no reference "
+        "equivalent — a reference qkv is [dim, 3*heads*dim_head] and cannot "
+        "fill a grouped projection; convert into a config without kv_heads"
+    )
     f = cfg.image_fmap_size
     P: Dict[str, Any] = {
         "text_emb": {"embedding": np.asarray(sd["text_emb.weight"])},
@@ -589,6 +594,12 @@ def save_reference_pt(path, cfg, params, vae_cfg=None, vae_params=None,
     the reference's own generate.py can consume it.  The migration path
     runs BOTH ways (load_reference_pt is the other direction)."""
     import torch
+
+    assert cfg.kv_heads in (None, cfg.heads), (
+        "grouped-query attention (kv_heads < heads) has no reference "
+        "equivalent — the reference's fused qkv is strictly multi-head "
+        "(attention.py:45); retrain or convert without --kv_heads to export"
+    )
 
     # np.array forces a writable copy (np.asarray of a JAX array is a
     # read-only view that torch.from_numpy warns about)

@@ -51,6 +51,14 @@ class TransformerConfig:
     depth: int = 2
     heads: int = 8
     dim_head: int = 64
+    # grouped-query attention (beyond-reference; the reference is always
+    # multi-head, attention.py:39-86): kv_heads < heads shares each K/V
+    # head across heads/kv_heads query heads — the decode KV cache (and
+    # its per-token re-read) shrinks by that factor, composing
+    # multiplicatively with kv_int8.  None = heads (standard MHA, the
+    # reference-parity default; checkpoints are shape-compatible only
+    # within one kv_heads setting).
+    kv_heads: Optional[int] = None
     # joint-sequence geometry: positions < text_seq_len are the text region,
     # the rest form an fmap_size x fmap_size image grid.  fmap_size=0 gives a
     # plain text transformer (used by CLIP).
@@ -133,6 +141,17 @@ class TransformerConfig:
     # reference: dalle_pytorch.py:483-498).
     kv_int8: bool = False
     dtype: Any = jnp.float32
+
+    @property
+    def num_kv_heads(self) -> int:
+        if self.kv_heads is None:
+            return self.heads
+        kv = self.kv_heads
+        assert kv > 0, f"kv_heads {kv} must be a positive integer"
+        assert self.heads % kv == 0, (
+            f"heads {self.heads} not divisible by kv_heads {kv}"
+        )
+        return kv
 
     @property
     def seq_len(self) -> int:
@@ -400,7 +419,8 @@ class JointAttention(nn.Module):
     def setup(self):
         c = self.cfg
         inner = c.heads * c.dim_head
-        self.to_qkv = _proj(c, inner * 3, "qkv", use_bias=False)
+        kv_inner = c.num_kv_heads * c.dim_head
+        self.to_qkv = _proj(c, inner + 2 * kv_inner, "qkv", use_bias=False)
         self.to_out = _proj(c, c.dim, "out")
         self.drop = nn.Dropout(c.attn_dropout)
         if c.rotary:
@@ -411,10 +431,28 @@ class JointAttention(nn.Module):
             self._angles = None
 
     def _heads(self, y, n):
+        """Fused projection → q [b,heads,n,d], k/v [b,num_kv_heads,n,d].
+        With kv_heads == heads the splits land on the same byte boundaries
+        as the former [3, heads, d] reshape — bit-identical for existing
+        checkpoints."""
         c = self.cfg
-        y = y.reshape(y.shape[0], n, 3, c.heads, c.dim_head)
-        q, k, v = y[:, :, 0], y[:, :, 1], y[:, :, 2]
-        return (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [b,h,n,d]
+        d = c.dim_head
+        hq, hkv = c.heads * d, c.num_kv_heads * d
+        q, k, v = jnp.split(y, [hq, hq + hkv], axis=-1)
+        shape = lambda t: t.reshape(
+            t.shape[0], n, -1, d
+        ).transpose(0, 2, 1, 3)
+        return shape(q), shape(k), shape(v)
+
+    def _expand_kv(self, k, v):
+        """Broadcast grouped K/V heads to full heads for the full-sequence
+        compute paths (structured ops, flash, SP): query head i reads kv
+        head i // group — consecutive-blocks mapping, matching the decode
+        path's [kv, group] reshape."""
+        g = self.cfg.heads // self.cfg.num_kv_heads
+        if g == 1:
+            return k, v
+        return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
 
     def __call__(self, x, key_pad_mask=None, deterministic=True):
         c = self.cfg
@@ -425,6 +463,7 @@ class JointAttention(nn.Module):
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
             if c.rotary_v:  # reference rotates v too (attention.py:32-35)
                 v = apply_rotary(v, ang)
+        k, v = self._expand_kv(k, v)
         t, f = c.text_seq_len, c.fmap_size
         if not c.causal:
             # bidirectional (CLIP encoders): flash handles the ragged
@@ -563,11 +602,12 @@ class JointAttention(nn.Module):
 
     def init_cache(self, batch: int) -> Cache:
         c = self.cfg
-        shape = (batch, c.heads, c.seq_len, c.dim_head)
+        # grouped (num_kv_heads) layout: the cache IS the GQA memory win
+        shape = (batch, c.num_kv_heads, c.seq_len, c.dim_head)
         if c.kv_int8:
             from dalle_tpu.ops.quant import EPS
 
-            sshape = (batch, c.heads, c.seq_len, 1)
+            sshape = (batch, c.num_kv_heads, c.seq_len, 1)
             return {
                 "k": jnp.zeros(shape, jnp.int8),
                 "v": jnp.zeros(shape, jnp.int8),
@@ -624,7 +664,8 @@ class JointAttention(nn.Module):
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
             if c.rotary_v:
                 v = apply_rotary(v, ang)
-        new_cache = self._cache_store(cache, k, v, 0)
+        new_cache = self._cache_store(cache, k, v, 0)  # grouped layout
+        k, v = self._expand_kv(k, v)
         mask = jnp.asarray(_static_mask(c, self.attn_type)[:L, :L])
         out = attn_ops._sdpa(q, k, v, mask[None, None])
         out = out.transpose(0, 2, 1, 3).reshape(b, L, -1)
@@ -642,12 +683,17 @@ class JointAttention(nn.Module):
             if c.rotary_v:
                 v = apply_rotary(v, ang)
         new_cache = self._cache_store(cache, k, v, idx)
-        ck, cv = self._cache_kv(new_cache)
+        ck, cv = self._cache_kv(new_cache)  # [b, kv, n, d]
         mask_table = jnp.asarray(_static_mask(c, self.attn_type))
         row = jax.lax.dynamic_slice_in_dim(mask_table, idx, 1, axis=0)  # [1, n]
-        out = attn_ops._sdpa(q, ck, cv, row[None, None])  # [b,h,1,d]
-        out = out.transpose(0, 2, 1, 3).reshape(b, -1)
-        return self.to_out(out), new_cache
+        # grouped read — the GQA point: fold the head-group into the query
+        # axis so the cache is read at its [b, kv, n, d] size (no repeat
+        # materializes).  At kv == heads the fold is [b, h, 1, d] and this
+        # is element-for-element the plain MHA read, same head-major layout.
+        g = c.heads // c.num_kv_heads
+        qg = q[:, :, 0].reshape(b, c.num_kv_heads, g, c.dim_head)
+        out = attn_ops._sdpa(qg, ck, cv, row[None, None])  # [b,kv,g,d]
+        return self.to_out(out.reshape(b, -1)), new_cache
 
 
 class CausalSGU(nn.Module):

@@ -39,12 +39,16 @@ def detect_peak_tflops(device: Optional[jax.Device] = None) -> float:
     return PEAK_TFLOPS["v4"]
 
 
-def _encoder_flops(dim, depth, heads, dim_head, ff_mult, seq, tokens) -> float:
+def _encoder_flops(dim, depth, heads, dim_head, ff_mult, seq, tokens,
+                   kv_heads=None) -> float:
     """Matmul-dominated fwd FLOPs of one (pre-norm, GEGLU) transformer
     encoder over ``tokens`` = batch*seq positions — shared by the DALLE
-    and CLIP meters so the formula can't drift between trainers."""
+    and CLIP meters so the formula can't drift between trainers.
+    ``kv_heads``: grouped-query attention shrinks the K/V projection
+    (attention FLOPs are unchanged — every query head still attends)."""
     inner = heads * dim_head
-    per_layer = 2 * dim * 3 * inner + 2 * inner * dim  # qkv + out proj
+    kv_inner = (kv_heads or heads) * dim_head
+    per_layer = 2 * dim * (inner + 2 * kv_inner) + 2 * inner * dim  # qkv + out
     per_layer += 2 * dim * (dim * ff_mult * 2) + 2 * (dim * ff_mult) * dim  # GEGLU
     return depth * (per_layer * tokens + 4 * inner * seq * tokens)
 
@@ -55,7 +59,8 @@ def dalle_train_flops(cfg, batch: int) -> float:
     n = cfg.total_seq_len
     tokens = batch * n
     body = _encoder_flops(d, cfg.depth, cfg.heads, cfg.dim_head,
-                          cfg.ff_mult, n, tokens)
+                          cfg.ff_mult, n, tokens,
+                          kv_heads=getattr(cfg, "kv_heads", None))
     mult = 3.0  # fwd + 2x bwd
     if getattr(cfg, "reversible", False):
         mult += 1.0  # recompute in the inverted backward

@@ -120,6 +120,12 @@ def parse_args(argv=None):
     parser.add_argument("--depth", type=int, default=2)
     parser.add_argument("--heads", type=int, default=8)
     parser.add_argument("--dim_head", type=int, default=64)
+    parser.add_argument("--kv_heads", type=int, default=None,
+                        help="grouped-query attention: K/V heads shared "
+                             "across heads/kv_heads query-head groups — "
+                             "the decode KV cache shrinks by that factor "
+                             "(composes with generate.py --kv_int8).  "
+                             "Default: = --heads (standard MHA)")
     parser.add_argument("--reversible", action="store_true")
     parser.add_argument("--use_remat", action="store_true",
                         help="rematerialize layer activations (memory lever)")
@@ -287,6 +293,7 @@ def main(argv=None):
             depth=args.depth,
             heads=args.heads,
             dim_head=args.dim_head,
+            kv_heads=args.kv_heads,
             ff_mult=4,
             attn_dropout=args.attn_dropout,
             ff_dropout=args.ff_dropout,
