@@ -569,6 +569,9 @@ def test_train_vae_resume(tiny_data, tmp_path, capsys):
         "--num_resnet_blocks", "0", "--emb_dim", "8", "--hidden_dim", "8",
         "--output_path", out, "--no_wandb", "--mesh_dp", "4",
         "--auto_resume",
+        # bf16 through BOTH legs: the resume branch must re-apply the
+        # compute-policy flag (dtype is popped from saved hparams)
+        "--bf16",
     ]
     train_vae.main(common + ["--epochs", "1"])
     from dalle_tpu.training.checkpoint import load_meta
@@ -602,6 +605,7 @@ def test_train_clip_resume(tiny_data, tmp_path, capsys):
         "--visual_enc_depth", "1", "--text_heads", "2", "--visual_heads", "2",
         "--text_seq_len", "8", "--truncate_captions", "--no_wandb",
         "--output_path", out, "--mesh_dp", "4", "--auto_resume",
+        "--bf16",  # compute-policy flag must survive the resume branch
     ]
     train_clip.main(common + ["--epochs", "1"])
     from dalle_tpu.training.checkpoint import load_meta

@@ -14,6 +14,7 @@ jitted ``make_clip_train_step`` → a self-describing checkpoint that
 import argparse
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dalle_tpu.data import DataLoader, TextImageDataset
@@ -46,6 +47,10 @@ def parse_args(argv=None):
     parser.add_argument("--epochs", type=int, default=20)
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
+    parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
+                        action="store_true",
+                        help="bf16 compute for both encoders (2x MXU rate "
+                             "on TPU); params stay f32")
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="clip_ckpt")
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
@@ -117,6 +122,12 @@ def main(argv=None):
     if args.clip_resume_path:
         resume_meta = load_meta(args.clip_resume_path)
         cfg = CLIPConfig.from_dict(resume_meta["hparams"])
+        # dtype is compute policy, not an hparam (to_dict pops it):
+        # re-apply the flag so --bf16 survives a resume
+        import dataclasses as _dc
+        cfg = _dc.replace(
+            cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
+        )
         # the dataset and init dummies must match the checkpoint's model,
         # not whatever flags the restart command line happened to carry
         for flag, ckpt_val in (
@@ -145,6 +156,7 @@ def main(argv=None):
             visual_image_size=args.image_size,
             visual_patch_size=args.patch_size,
             scan_layers=args.scan_layers,
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         )
 
     ds = TextImageDataset(

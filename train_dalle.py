@@ -282,6 +282,12 @@ def main(argv=None):
 
     if resume_meta is not None:
         cfg = DALLEConfig.from_dict(resume_meta["hparams"])
+        # dtype is compute policy, not an hparam (to_dict pops it):
+        # re-apply the flag so --bf16 survives a resume
+        import dataclasses as _dc
+        cfg = _dc.replace(
+            cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
+        )
     else:
         num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
         cfg = DALLEConfig(

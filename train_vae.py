@@ -55,6 +55,10 @@ def parse_args(argv=None):
     parser.add_argument("--hidden_dim", type=int, default=256)
     parser.add_argument("--kl_loss_weight", type=float, default=0.0)
     parser.add_argument("--straight_through", action="store_true")
+    parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
+                        action="store_true",
+                        help="bf16 compute for the conv stacks (2x MXU "
+                             "rate on TPU); params stay f32")
     parser.add_argument("--num_images_save", type=int, default=4)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="vae_ckpt")
@@ -107,6 +111,12 @@ def main(argv=None):
     if args.vae_resume_path:
         resume_meta = load_meta(args.vae_resume_path)
         cfg = DiscreteVAEConfig.from_dict(resume_meta["hparams"])
+        # dtype is compute policy, not an hparam (to_dict pops it):
+        # re-apply the flag so --bf16 survives a resume
+        import dataclasses as _dc
+        cfg = _dc.replace(
+            cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
+        )
         if args.image_size != cfg.image_size:
             import warnings
 
@@ -128,6 +138,7 @@ def main(argv=None):
             temperature=args.starting_temp,
             straight_through=args.straight_through,
             kl_div_loss_weight=args.kl_loss_weight,
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         )
     vae = DiscreteVAE(cfg)
 
