@@ -552,6 +552,12 @@ class JointAttention(nn.Module):
         from dalle_tpu.ops.flash import flash_attention, flash_plan
 
         c = self.cfg
+        # ONE auto-on-TPU resolution for every flash-capable path below
+        use_flash = (
+            c.use_flash
+            if c.use_flash is not None
+            else _jax.default_backend() == "tpu"
+        )
         if c.sp_axis is not None:
             # both SP schemes thread the pad mask through (ring slices it
             # per rotating chunk; ulysses hands it to the flash kernel)
@@ -562,13 +568,16 @@ class JointAttention(nn.Module):
                     )
 
                     return ulysses_attention_sharded(
-                        q, k, v, key_pad_mask, sp_axis=c.sp_axis, causal=True
+                        q, k, v, key_pad_mask, sp_axis=c.sp_axis,
+                        causal=True, use_flash=use_flash,
                     )
                 from dalle_tpu.parallel.ring import ring_attention_sharded
 
                 return ring_attention_sharded(
                     q, k, v, key_pad_mask, sp_axis=c.sp_axis, causal=True,
                     schedule=c.sp_schedule,
+                    # flash-chunk ring (parallel/ring.py use_flash)
+                    use_flash=use_flash,
                 )
             import warnings
 
@@ -578,11 +587,6 @@ class JointAttention(nn.Module):
                 "their own sequence-sharded path)",
                 stacklevel=2,
             )
-        use_flash = (
-            c.use_flash
-            if c.use_flash is not None
-            else _jax.default_backend() == "tpu"
-        )
         if use_flash:
             # the kernel applies an optional key-pad mask in-block, so a
             # ragged batch no longer forces the dense fallback

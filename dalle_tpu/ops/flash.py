@@ -320,12 +320,19 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, kpm, layout, bq, bk, scale, causal, h):
+def _flash_bwd(q, k, v, o, lse, do, kpm, layout, bq, bk, scale, causal, h,
+               dlse=None):
     bh, n, d = q.shape
     nqb, nkb = n // bq, n // bk
     lay = jnp.asarray(_layout_or_causal(layout, nqb, nkb, bq, bk, causal), jnp.int32)
     has_mask = kpm is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh, n]
+    if dlse is not None:
+        # lse-output variant (flash_attention_lse): d lse_i / d s_ij = p_ij,
+        # so the score gradient gains + p_ij * dlse_i — algebraically
+        # ds = p * (dP - (delta - dlse)), i.e. the SAME kernels with the
+        # row statistic adjusted.  dv/dkpm are lse-independent.
+        delta = delta - dlse.astype(jnp.float32)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, nkb=nkb, bq=bq, bk=bk, scale=scale, causal=causal,
@@ -398,36 +405,44 @@ def _flash_bwd(q, k, v, o, lse, do, kpm, layout, bq, bk, scale, causal, h):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
-)
 def _flash_core(q, k, v, kpm, layout_key, bq, bk, causal, h):
-    out, _ = _flash_fwd(
-        q, k, v, kpm, _LAYOUTS.get(layout_key), bq, bk,
-        q.shape[-1] ** -0.5, causal, h,
-    )
+    """out-only flash: the lse variant with the second output dropped.
+    One custom_vjp serves both — an unused lse cotangent arrives as zeros
+    and ``delta - 0`` reproduces the classic backward exactly."""
+    out, _ = _flash_core_lse(q, k, v, kpm, layout_key, bq, bk, causal, h)
     return out
 
 
-def _flash_core_fwd(q, k, v, kpm, layout_key, bq, bk, causal, h):
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+)
+def _flash_core_lse(q, k, v, kpm, layout_key, bq, bk, causal, h):
+    return _flash_fwd(
+        q, k, v, kpm, _LAYOUTS.get(layout_key), bq, bk,
+        q.shape[-1] ** -0.5, causal, h,
+    )
+
+
+def _flash_core_lse_fwd(q, k, v, kpm, layout_key, bq, bk, causal, h):
     out, lse = _flash_fwd(
         q, k, v, kpm, _LAYOUTS.get(layout_key), bq, bk,
         q.shape[-1] ** -0.5, causal, h,
     )
-    return out, (q, k, v, kpm, out, lse)
+    return (out, lse), (q, k, v, kpm, out, lse)
 
 
-def _flash_core_bwd(layout_key, bq, bk, causal, h, res, g):
+def _flash_core_lse_bwd(layout_key, bq, bk, causal, h, res, g):
     q, k, v, kpm, out, lse = res
+    do, dlse = g
     dq, dk, dv = _flash_bwd(
-        q, k, v, out, lse, g, kpm, _LAYOUTS.get(layout_key), bq, bk,
-        q.shape[-1] ** -0.5, causal, h,
+        q, k, v, out, lse, do, kpm, _LAYOUTS.get(layout_key), bq, bk,
+        q.shape[-1] ** -0.5, causal, h, dlse=dlse,
     )
     dkpm = None if kpm is None else jnp.zeros_like(kpm)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dkpm
 
 
-_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 
 # custom_vjp nondiff args must be hashable; numpy layouts are registered here
 _LAYOUTS: dict = {None: None}
@@ -479,6 +494,42 @@ def flash_attention(
     fold = lambda x: x.reshape(b * h, n, d)
     out = _flash_core(fold(q), fold(k), fold(v), kpm, key, bq, bk, causal, h)
     return out.reshape(b, h, n, d)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    key_pad_mask: Optional[jnp.ndarray] = None,
+):
+    """:func:`flash_attention` that ALSO returns the per-row logsumexp
+    ([b, h, n], natural log, over scaled scores) — the merge statistic for
+    combining partial attention over key chunks:
+
+        lse = logaddexp(lse1, lse2)
+        out = out1 * exp(lse1 - lse) + out2 * exp(lse2 - lse)
+
+    Differentiable in both outputs (the dlse term folds into the backward
+    kernels' delta row statistic).  This is what ring attention's
+    flash-chunk mode (parallel/ring.py use_flash) is built on.  Rows with
+    every visible key masked emit lse ≈ NEG_INF, so they merge with zero
+    weight."""
+    b, h, n, d = q.shape
+    bq = pick_block(n, block_q if block_q is not None else default_block("q"))
+    bk = pick_block(n, block_k if block_k is not None else default_block("k"))
+    kpm = None
+    if key_pad_mask is not None:
+        assert key_pad_mask.shape == (b, n), (key_pad_mask.shape, (b, n))
+        kpm = key_pad_mask.astype(jnp.float32)
+    fold = lambda x: x.reshape(b * h, n, d)
+    out, lse = _flash_core_lse(
+        fold(q), fold(k), fold(v), kpm, None, bq, bk, causal, h
+    )
+    return out.reshape(b, h, n, d), lse.reshape(b, h, n)
 
 
 def block_layout_from_mask(mask: np.ndarray, bq: int, bk: int) -> np.ndarray:

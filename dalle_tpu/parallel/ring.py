@@ -59,6 +59,71 @@ def _online_update(s_blk, v_blk, m, l, acc):
     return m_new, l_new, acc_new
 
 
+def _merge_partial(o, lse, o_s, lse_s):
+    """Fold one chunk's flash partial (normalized out + logsumexp) into the
+    carried partial: out = Σ out_i·e^{lse_i} / Σ e^{lse_i}, max-shifted.
+    A NEG_INF lse (empty carry, or a fully-pad-masked chunk) merges with
+    zero weight."""
+    m = jnp.maximum(lse, lse_s)
+    w1 = jnp.exp(lse - m)
+    w2 = jnp.exp(lse_s - m)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    o_new = (
+        o * w1[..., None] + o_s.astype(jnp.float32) * w2[..., None]
+    ) / denom[..., None]
+    return o_new, m + jnp.log(denom)
+
+
+def _ring_schedule(k, v, init, attend, *, axis_name, causal):
+    """Shared contiguous-ring driver.  The rotation, the ``src``
+    computation, and the causal live set (skip src > idx; src == idx is
+    the diagonal) live HERE, once — both chunk implementations (einsum
+    online-update, flash + logsumexp merge) fold through the same
+    schedule, so the skip set can never drift between them.
+    ``attend(st, k_cur, v_cur, src, diag)`` folds one chunk into the
+    carry; ``diag`` is a static bool: the chunk needs within-chunk
+    causality (only ever the diagonal)."""
+    p_size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    def step(carry, s):
+        k_cur, v_cur, st, n_done = carry
+        src = (idx - s) % p_size  # owner of the chunk I currently hold
+
+        def run(diag):
+            return lambda p: (attend(p[0], k_cur, v_cur, src, diag), p[1] + 1)
+
+        pack = (st, n_done)
+        if causal:
+            # src > idx: every local query precedes every incoming key —
+            # the whole block's matmuls are skipped
+            pack = jax.lax.cond(
+                src == idx,
+                run(True),
+                lambda p: jax.lax.cond(
+                    src < idx, run(False), lambda p2: p2, p
+                ),
+                pack,
+            )
+        else:
+            pack = run(False)(pack)
+        st, n_done = pack
+        # rotate K/V to the next device (ring over ICI) — every step, on
+        # every device: the rotation IS the ring, skipping it would
+        # deadlock the collective
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        return (
+            jax.lax.ppermute(k_cur, axis_name, perm),
+            jax.lax.ppermute(v_cur, axis_name, perm),
+            st, n_done,
+        ), None
+
+    (_, _, st, n_done), _ = jax.lax.scan(
+        step, (k, v, init, jnp.zeros((), jnp.int32)), jnp.arange(p_size)
+    )
+    return st, n_done
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -68,68 +133,136 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     return_stats: bool = False,
+    use_flash: bool = False,
 ):
     """Local view: q, k, v [b, h, n_local, d], sequence sharded over
     ``axis_name``; key_pad_mask: optional GLOBAL [b, n] (replicated),
     nonzero = valid key.  Returns the local output chunk [b, h, n_local, d]
-    (plus the number of computed ring steps when ``return_stats``)."""
+    (plus the number of computed ring steps when ``return_stats``).
+
+    ``use_flash``: run each live chunk through the Pallas flash kernel
+    (``flash_attention_lse``) and fold partials via logsumexp merge
+    (``_merge_partial``) instead of the einsum online update — same
+    schedule (``_ring_schedule``), same skip set, no [b,h,nl,nl] score
+    block in HBM."""
     p_size = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, nl, d = q.shape
+
+    def kpm_chunk(src):
+        if key_pad_mask is None:
+            return None
+        return jax.lax.dynamic_slice_in_dim(key_pad_mask, src * nl, nl, axis=1)
+
+    if use_flash:
+        from dalle_tpu.ops.flash import flash_attention_lse
+
+        def attend(st, k_cur, v_cur, src, diag):
+            o, lse = st
+            o_s, lse_s = flash_attention_lse(
+                q, k_cur, v_cur, causal=diag, key_pad_mask=kpm_chunk(src)
+            )
+            return _merge_partial(o, lse, o_s, lse_s)
+
+        init = (
+            jnp.zeros((b, h, nl, d), jnp.float32),
+            jnp.full((b, h, nl), NEG_INF, jnp.float32),
+        )
+        (o, _), n_done = _ring_schedule(
+            k, v, init, attend, axis_name=axis_name, causal=causal
+        )
+        out = o.astype(q.dtype)
+        return (out, n_done) if return_stats else out
+
     scale = d**-0.5
     qf = q.astype(jnp.float32) * scale
-
     qpos = idx * nl + jnp.arange(nl)  # global positions of my queries
 
-    def step(carry, s):
-        k_cur, v_cur, m, l, acc, n_done = carry
-        src = (idx - s) % p_size  # owner of the chunk I currently hold
-
-        def attend(m, l, acc, n_done):
-            kpos = src * nl + jnp.arange(nl)
-            sblk = jnp.einsum(
-                "bhid,bhjd->bhij", qf, k_cur.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )
-            if causal:
-                mask = qpos[:, None] >= kpos[None, :]
-                sblk = jnp.where(mask[None, None], sblk, NEG_INF)
-            if key_pad_mask is not None:
-                kpm_blk = jax.lax.dynamic_slice_in_dim(
-                    key_pad_mask, src * nl, nl, axis=1
-                )  # [b, nl] of the incoming chunk
-                sblk = jnp.where(
-                    kpm_blk[:, None, None, :] > 0, sblk, NEG_INF
-                )
-            m_new, l_new, acc_new = _online_update(sblk, v_cur, m, l, acc)
-            return m_new, l_new, acc_new, n_done + 1
-
+    def attend(st, k_cur, v_cur, src, diag):
+        del diag  # the global-position mask covers diagonal AND full chunks
+        m, l, acc = st
+        sblk = jnp.einsum(
+            "bhid,bhjd->bhij", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
         if causal:
-            # contiguous chunks: src > idx means every local query precedes
-            # every incoming key — skip the whole block's matmuls
-            m, l, acc, n_done = jax.lax.cond(
-                src <= idx, attend, lambda m, l, a, n: (m, l, a, n),
-                m, l, acc, n_done,
-            )
-        else:
-            m, l, acc, n_done = attend(m, l, acc, n_done)
+            kpos = src * nl + jnp.arange(nl)
+            mask = qpos[:, None] >= kpos[None, :]
+            sblk = jnp.where(mask[None, None], sblk, NEG_INF)
+        kpm_blk = kpm_chunk(src)  # [b, nl] of the incoming chunk
+        if kpm_blk is not None:
+            sblk = jnp.where(kpm_blk[:, None, None, :] > 0, sblk, NEG_INF)
+        return _online_update(sblk, v_cur, m, l, acc)
 
-        # rotate K/V to the next device (ring over ICI) — every step, on
-        # every device: the rotation IS the ring, skipping it would
-        # deadlock the collective
-        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m, l, acc, n_done), None
-
-    m0 = jnp.full((b, h, nl, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, nl, 1), jnp.float32)
-    a0 = jnp.zeros((b, h, nl, d), jnp.float32)
-    (k, v, m, l, acc, n_done), _ = jax.lax.scan(
-        step, (k, v, m0, l0, a0, jnp.zeros((), jnp.int32)), jnp.arange(p_size)
+    init = (
+        jnp.full((b, h, nl, 1), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, nl, 1), jnp.float32),
+        jnp.zeros((b, h, nl, d), jnp.float32),
+    )
+    (m, l, acc), n_done = _ring_schedule(
+        k, v, init, attend, axis_name=axis_name, causal=causal
     )
     out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
     return (out, n_done) if return_stats else out
+
+
+def _zigzag_schedule(k, v, c, init, quadrant, *, axis_name):
+    """Shared zigzag driver: the quadrant live set
+
+        (qA,kA) full when src < idx, diagonal when src == idx
+        (qB,kA) always full
+        (qB,kB) full when src > idx, diagonal when src == idx
+        (qA,kB) never
+
+    lives HERE, once, for both quadrant implementations.
+    ``quadrant(st, qhalf, khalf, k_cur, v_cur, kpos, diag) -> st``."""
+    p_size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    ar = jnp.arange(c)
+
+    def step(carry, s):
+        k_cur, v_cur, st_a, st_b, n_done = carry
+        src = (idx - s) % p_size
+        kpos_a = src * c + ar
+        kpos_b = (2 * p_size - 1 - src) * c + ar
+
+        def run(qh_, kh_, kpos, diag):
+            return lambda st, n: (
+                quadrant(st, qh_, kh_, k_cur, v_cur, kpos, diag), n + 1
+            )
+
+        skip = lambda st, n: (st, n)
+        st_a, n_done = jax.lax.cond(
+            src == idx,
+            run("A", "A", kpos_a, True),
+            lambda st, n: jax.lax.cond(
+                src < idx, run("A", "A", kpos_a, False), skip, st, n
+            ),
+            st_a, n_done,
+        )
+        st_b, n_done = run("B", "A", kpos_a, False)(st_b, n_done)
+        st_b, n_done = jax.lax.cond(
+            src == idx,
+            run("B", "B", kpos_b, True),
+            lambda st, n: jax.lax.cond(
+                src > idx, run("B", "B", kpos_b, False), skip, st, n
+            ),
+            st_b, n_done,
+        )
+        # (qA,kB): qA precedes every kB globally — never live, never built
+
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        return (
+            jax.lax.ppermute(k_cur, axis_name, perm),
+            jax.lax.ppermute(v_cur, axis_name, perm),
+            st_a, st_b, n_done,
+        ), None
+
+    (_, _, st_a, st_b, n_done), _ = jax.lax.scan(
+        step, (k, v, init(), init(), jnp.zeros((), jnp.int32)),
+        jnp.arange(p_size),
+    )
+    return st_a, st_b, n_done
 
 
 def zigzag_ring_attention(
@@ -140,6 +273,7 @@ def zigzag_ring_attention(
     *,
     axis_name: str,
     return_stats: bool = False,
+    use_flash: bool = False,
 ):
     """Load-BALANCED causal ring attention (zigzag chunk layout).
 
@@ -147,86 +281,84 @@ def zigzag_ring_attention(
     wall-clock: at every step some device still computes a full local
     block.  Zigzag fixes the balance: the sequence is cut into 2P chunks
     and device i holds chunks (i, 2P-1-i) — its local block is the
-    concatenation [A|B].  Under causality exactly the quadrants
-
-        (qA,kA) iff src <= i   (diagonal at s=0)
-        (qB,kA) always         (qB is late, kA is early)
-        (qB,kB) iff src >= i   (diagonal at s=0)
-        (qA,kB) never          (qA is early, kB is late)
-
-    are live, so EVERY device at EVERY step computes ~2 of 4 c×c
+    concatenation [A|B].  Under causality the quadrant live set (see
+    ``_zigzag_schedule``) gives EVERY device at EVERY step ~2 of 4 c×c
     quadrants — max-load equals mean-load and wall-clock halves vs the
     contiguous schedule.  Callers must pass chunks in zigzag order
     (``zigzag_permutation``); ``ring_attention_sharded(schedule="zigzag")``
     does the (de)permutation.
 
     ``return_stats``: also return the number of computed quadrants
-    (asserted balanced in tests/test_ring.py)."""
+    (asserted balanced in tests/test_ring.py).
+
+    ``use_flash``: flash-kernel quadrants + logsumexp merge — same live
+    set (one shared driver), no materialized score blocks."""
     p_size = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, nl, d = q.shape
     assert nl % 2 == 0, "zigzag needs an even local chunk (n % 2P == 0)"
     c = nl // 2
-    scale = d**-0.5
-    qf = q.astype(jnp.float32) * scale
     ar = jnp.arange(c)
     qpos = {"A": idx * c + ar, "B": (2 * p_size - 1 - idx) * c + ar}
+
+    def half(x, which):
+        return x[:, :, :c] if which == "A" else x[:, :, c:]
+
+    def kpm_at(kpos):
+        if key_pad_mask is None:
+            return None
+        # gather: zigzag key positions are not contiguous in the global mask
+        return jnp.take(key_pad_mask, kpos, axis=1)  # [b, c]
+
+    if use_flash:
+        from dalle_tpu.ops.flash import flash_attention_lse
+
+        def quadrant(st, qhalf, khalf, k_cur, v_cur, kpos, diag):
+            o, lse = st
+            o_s, lse_s = flash_attention_lse(
+                half(q, qhalf), half(k_cur, khalf), half(v_cur, khalf),
+                causal=diag, key_pad_mask=kpm_at(kpos),
+            )
+            return _merge_partial(o, lse, o_s, lse_s)
+
+        init = lambda: (
+            jnp.zeros((b, h, c, d), jnp.float32),
+            jnp.full((b, h, c), NEG_INF, jnp.float32),
+        )
+        st_a, st_b, n_done = _zigzag_schedule(
+            k, v, c, init, quadrant, axis_name=axis_name
+        )
+        out = jnp.concatenate([st_a[0], st_b[0]], axis=2).astype(q.dtype)
+        return (out, n_done) if return_stats else out
+
+    scale = d**-0.5
+    qf = q.astype(jnp.float32) * scale
     qh = {"A": qf[:, :, :c], "B": qf[:, :, c:]}
 
-    def quadrant(qk, kpos_half, k_cur, v_cur, state, n_done):
+    def quadrant(st, qhalf, khalf, k_cur, v_cur, kpos, diag):
         """Masked online-softmax update of one c×c quadrant."""
-        (m, l, acc), (qhalf, khalf) = state, qk
-        kpos = kpos_half[khalf]
-        kc = k_cur[:, :, :c] if khalf == "A" else k_cur[:, :, c:]
-        vc = v_cur[:, :, :c] if khalf == "A" else v_cur[:, :, c:]
+        del diag  # the global-position mask covers diagonal AND full
+        m, l, acc = st
+        kc = half(k_cur, khalf)
+        vc = half(v_cur, khalf)
         s_blk = jnp.einsum(
             "bhid,bhjd->bhij", qh[qhalf], kc.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
         mask = qpos[qhalf][:, None] >= kpos[None, :]
         s_blk = jnp.where(mask[None, None], s_blk, NEG_INF)
-        if key_pad_mask is not None:
-            kpm_blk = jnp.take(key_pad_mask, kpos, axis=1)  # [b, c] (gather:
-            # zigzag key positions are not contiguous in the global mask)
+        kpm_blk = kpm_at(kpos)
+        if kpm_blk is not None:
             s_blk = jnp.where(kpm_blk[:, None, None, :] > 0, s_blk, NEG_INF)
-        return _online_update(s_blk, vc, m, l, acc), n_done + 1
+        return _online_update(s_blk, vc, m, l, acc)
 
-    def step(carry, s):
-        k_cur, v_cur, st_a, st_b, n_done = carry
-        src = (idx - s) % p_size
-        kpos_half = {"A": src * c + ar, "B": (2 * p_size - 1 - src) * c + ar}
-
-        # (qA,kA): live iff src <= idx
-        st_a, n_done = jax.lax.cond(
-            src <= idx,
-            lambda st, n: quadrant(("A", "A"), kpos_half, k_cur, v_cur, st, n),
-            lambda st, n: (st, n), st_a, n_done,
-        )
-        # (qB,kA): always live
-        st_b, n_done = quadrant(("B", "A"), kpos_half, k_cur, v_cur, st_b, n_done)
-        # (qB,kB): live iff src >= idx
-        st_b, n_done = jax.lax.cond(
-            src >= idx,
-            lambda st, n: quadrant(("B", "B"), kpos_half, k_cur, v_cur, st, n),
-            lambda st, n: (st, n), st_b, n_done,
-        )
-        # (qA,kB): qA precedes every kB globally — never live, never built
-
-        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, st_a, st_b, n_done), None
-
-    def init_state():
-        return (
-            jnp.full((b, h, c, 1), NEG_INF, jnp.float32),
-            jnp.zeros((b, h, c, 1), jnp.float32),
-            jnp.zeros((b, h, c, d), jnp.float32),
-        )
-
-    (k, v, st_a, st_b, n_done), _ = jax.lax.scan(
-        step, (k, v, init_state(), init_state(), jnp.zeros((), jnp.int32)),
-        jnp.arange(p_size),
+    init = lambda: (
+        jnp.full((b, h, c, 1), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, c, 1), jnp.float32),
+        jnp.zeros((b, h, c, d), jnp.float32),
+    )
+    st_a, st_b, n_done = _zigzag_schedule(
+        k, v, c, init, quadrant, axis_name=axis_name
     )
     halves = []
     for m, l, acc in (st_a, st_b):
@@ -256,6 +388,7 @@ def ring_attention_sharded(
     causal: bool = True,
     mesh=None,
     schedule: str = "contiguous",
+    use_flash: bool = False,
 ):
     """Global view: q, k, v [b, h, n, d] under jit with an (ambient) mesh.
 
@@ -296,7 +429,9 @@ def ring_attention_sharded(
         zz = zigzag_permutation(q.shape[2], p_size)
         inv = np.argsort(zz)
         zzj = jnp.asarray(zz)
-        fn = functools.partial(zigzag_ring_attention, axis_name=sp_axis)
+        fn = functools.partial(
+            zigzag_ring_attention, axis_name=sp_axis, use_flash=use_flash
+        )
         if key_pad_mask is None:
             out = jax.shard_map(
                 lambda q, k, v: fn(q, k, v),
@@ -312,7 +447,9 @@ def ring_attention_sharded(
             )(q[:, :, zzj], k[:, :, zzj], v[:, :, zzj], key_pad_mask)
         return out[:, :, jnp.asarray(inv)]
 
-    fn = functools.partial(ring_attention, axis_name=sp_axis, causal=causal)
+    fn = functools.partial(
+        ring_attention, axis_name=sp_axis, causal=causal, use_flash=use_flash
+    )
     if key_pad_mask is None:
         return jax.shard_map(
             lambda q, k, v: fn(q, k, v),

@@ -35,6 +35,7 @@ def ulysses_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Local view: q, k, v [b, h, n_local, d], sequence sharded over
     ``axis_name``; h must divide by the axis size.  key_pad_mask: optional
@@ -60,7 +61,9 @@ def ulysses_attention(
         )
 
     qg, kg, vg = to_seq(q), to_seq(k), to_seq(v)
-    if causal and jax.default_backend() == "tpu":
+    if use_flash is None:  # the shared auto convention (transformer.py)
+        use_flash = jax.default_backend() == "tpu"
+    if causal and use_flash:
         # O(n)-memory local attention — the pairing that makes Ulysses a
         # long-context scheme rather than an n² trade; the kernel takes
         # the pad mask in-block (ops/flash.py), so ragged batches stay fast
@@ -89,6 +92,7 @@ def ulysses_attention_sharded(
     sp_axis: str = "sp",
     causal: bool = True,
     mesh=None,
+    use_flash: Optional[bool] = None,
 ):
     """Global view: q, k, v [b, h, n, d] under jit with an (ambient) mesh.
     Same spec-wiring as :func:`ring_attention_sharded`: batch over
@@ -103,7 +107,10 @@ def ulysses_attention_sharded(
         "dalle_tpu.parallel.mesh.ambient(mesh) (train_lib does this)"
     )
     spec = P(("dp", "fsdp"), "tp", sp_axis, None)
-    fn = functools.partial(ulysses_attention, axis_name=sp_axis, causal=causal)
+    fn = functools.partial(
+        ulysses_attention, axis_name=sp_axis, causal=causal,
+        use_flash=use_flash,
+    )
     if key_pad_mask is None:
         return jax.shard_map(
             lambda q, k, v: fn(q, k, v),

@@ -405,7 +405,20 @@ def load_dalle_for_eval(path: str, *, prefer_ema: bool = True):
     single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     meta = load_meta(path)
     cfg = DALLEConfig.from_dict(meta["hparams"])
-    notes = []
+    if cfg.sp_axis is not None:
+        # sequence parallelism is a TRAIN-time sharding choice with no
+        # param footprint; decode re-shards via generate's --mesh_* flags.
+        # Left in place it breaks even the param-template trace (ring
+        # attention asserts an ambient mesh).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, sp_axis=None)
+        notes = [
+            "sp-trained checkpoint: sequence parallelism dropped for "
+            "decode (re-shard via --mesh_* if wanted)"
+        ]
+    else:
+        notes = []
     trained_cfg, convert = cfg, None
     if cfg.scan_layers:
         from dalle_tpu.models.scan_params import unrolled_eval_setup

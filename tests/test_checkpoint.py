@@ -267,3 +267,31 @@ def test_clip_flops_close_to_xla(rng):
     assert analytic > 0
     if xla_flops > 0:
         assert 0.2 < xla_flops / analytic < 5.0, (xla_flops, analytic)
+
+
+def test_eval_load_strips_sequence_parallelism(tmp_path, rng):
+    """An sp-trained checkpoint must decode on a single device:
+    load_dalle_for_eval clears sp_axis (a train-time sharding choice with
+    no param footprint) — left in place, even the param-template trace
+    dies in ring attention's mesh assertion."""
+    from dalle_tpu.models.generate import generate_image_codes
+    from dalle_tpu.training.checkpoint import load_dalle_for_eval
+
+    c = cfg()
+    sp_cfg = __import__("dataclasses").replace(c, sp_axis="sp")
+    model = DALLE(sp_cfg)
+    text = jnp.ones((1, c.text_seq_len), jnp.int32)
+    codes = jnp.zeros((1, c.image_seq_len), jnp.int32)
+    # init under a mesh so the sp trace is legal at save time
+    from dalle_tpu.parallel.mesh import ambient
+
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=2)
+    with ambient(mesh):
+        params = model.init(jax.random.PRNGKey(0), text, codes)["params"]
+    path = str(tmp_path / "sp-ck")
+    save_checkpoint(path, params=params, hparams=sp_cfg.to_dict())
+
+    emodel, eparams, _, _ = load_dalle_for_eval(path)
+    assert emodel.cfg.sp_axis is None
+    out = generate_image_codes(emodel, eparams, text, jax.random.PRNGKey(1))
+    assert out.shape == (1, c.image_seq_len)

@@ -159,6 +159,13 @@ def parse_args(argv=None):
     parser.add_argument("--pp_stages", type=int, default=1,
                         help="pipeline-parallel stages (needs --mesh_pp)")
     parser.add_argument("--pp_microbatches", type=int, default=4)
+    parser.add_argument("--use_flash", type=str, default="auto",
+                        choices=("auto", "on", "off"),
+                        help="Pallas flash attention for full/sparse layers "
+                             "and the flash-chunk ring: auto = on when the "
+                             "backend is TPU; on/off force (off isolates a "
+                             "suspected kernel issue on TPU; on exercises "
+                             "the kernel in interpret mode off-TPU)")
     parser.add_argument("--sp_ring", action="store_true",
                         help="sequence parallelism over mesh_sp (scheme "
                              "chosen by --sp_mode)")
@@ -318,6 +325,7 @@ def main(argv=None):
             pp_microbatches=args.pp_microbatches,
             # --sp_mode alone enables SP too: asking for a scheme means
             # asking for sequence parallelism
+            use_flash={"auto": None, "on": True, "off": False}[args.use_flash],
             sp_axis="sp" if (args.sp_ring or args.sp_mode) else None,
             sp_mode=args.sp_mode or "ring",
             sp_schedule=args.sp_schedule,
