@@ -122,3 +122,25 @@ def test_ulysses_key_pad_mask(rng, devices):
     np.testing.assert_allclose(
         np.asarray(got) * valid, np.asarray(want) * valid, atol=1e-5
     )
+
+
+def test_ulysses_flash_forced_matches_dense(rng, devices):
+    """use_flash=True forces the Pallas kernel through the all_to_all
+    re-shard (interpret mode off-TPU) — the --use_flash on/off override
+    must actually reach ulysses (it used to hardcode its kernel choice),
+    fwd + grads."""
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+    want = A.full_causal_attention(q, k, v)
+    fn = lambda q, k, v: ulysses_attention_sharded(
+        q, k, v, causal=True, mesh=mesh, use_flash=True
+    )
+    got = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    g_flash = jax.grad(lambda q: jnp.sum(fn(q, k, v) ** 2))(q)
+    g_dense = jax.grad(
+        lambda q: jnp.sum(A.full_causal_attention(q, k, v) ** 2)
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g_flash), np.asarray(g_dense), atol=5e-5
+    )
