@@ -132,6 +132,33 @@ def main():
     ).reshape(-1)
     np.testing.assert_allclose(all_losses, loss_f, rtol=1e-6)
 
+    # ring sequence parallelism ACROSS the process boundary: sp=4 spans
+    # 2 procs x 2 local devices, so the 1->2 and 3->0 hops of every K/V
+    # rotation cross processes (the 0->1 and 2->3 hops stay local) — the
+    # multi-host leg of the SP design (single-host ring parity lives in
+    # tests/test_ring.py)
+    from dalle_tpu.ops import attention as A_ops
+    from dalle_tpu.parallel.ring import ring_attention_sharded
+
+    mesh_sp = make_mesh(dp=1, tp=1, sp=4)
+    rs = np.random.RandomState(7)
+    qkv_np = [rs.randn(1, 2, 16, 8).astype(np.float32) for _ in range(3)]
+    sh_sp = NamedSharding(mesh_sp, P(None, None, "sp", None))
+    qg, kg, vg = [
+        jax.make_array_from_callback(x.shape, sh_sp, lambda idx, x=x: x[idx])
+        for x in qkv_np
+    ]
+    ring_out = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, causal=True, mesh=mesh_sp),
+        out_shardings=NamedSharding(mesh_sp, P()),  # replicate for readback
+    )(qg, kg, vg)
+    import jax.numpy as jnp
+
+    want_ring = A_ops.full_causal_attention(*[jnp.asarray(x) for x in qkv_np])
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(ring_out)), np.asarray(want_ring), atol=1e-5
+    )
+
     backend.local_barrier()
     print(f"MP_WORKER_OK rank={proc_id}")
 
