@@ -165,6 +165,7 @@ def generate_images(
     top_p: Optional[float] = None,
     img: Optional[jnp.ndarray] = None,
     num_init_img_tokens: Optional[int] = None,
+    prime_codes: Optional[jnp.ndarray] = None,
     clip=None,
     clip_params=None,
 ):
@@ -172,10 +173,15 @@ def generate_images(
 
     Mirrors ``DALLE.generate_images`` (reference: dalle_pytorch.py:453-509).
     Returns images [b, H, W, C], or (images, clip_scores) when a CLIP model
-    is supplied.
+    is supplied.  ``prime_codes`` [b, k] skips the encode for callers that
+    already hold the primed VAE codes (generate.py encodes its
+    --prime_image once, not per batch chunk); mutually exclusive with
+    ``img``.
     """
     c = model.cfg
-    prime_codes = None
+    assert img is None or prime_codes is None, (
+        "pass img= OR prime_codes=, not both"
+    )
     if img is not None:
         n_init = num_init_img_tokens or int(PRIME_FRACTION * c.image_seq_len)
         assert 0 < n_init < c.image_seq_len, (

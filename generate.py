@@ -43,6 +43,24 @@ def parse_args(argv=None):
                         help="nucleus sampling mass (overrides --top_k; "
                              "beyond-reference)")
     parser.add_argument("--temperature", type=float, default=1.0)
+    parser.add_argument("--prime_image", type=str, default=None,
+                        help="image file whose first VAE codes seed every "
+                             "generation (the reference's img= priming, "
+                             "dalle_pytorch.py:472-481, which its CLI "
+                             "never exposed)")
+    def _positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(
+                f"--num_init_img_tokens must be >= 1, got {n}"
+            )
+        return n
+
+    parser.add_argument("--num_init_img_tokens", type=_positive_int,
+                        default=None,
+                        help="with --prime_image: how many primed codes "
+                             "(default: 43.75%% of the image sequence, "
+                             "the OpenAI 14/32 recipe)")
     parser.add_argument("--outputs_dir", type=str, default="outputs")
     parser.add_argument("--gentxt", action="store_true",
                         help="complete the prompt with the model first")
@@ -305,6 +323,31 @@ def _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,
         stack.enter_context(ambient(mesh))
         print(f"sharded inference over mesh {dict(mesh.shape)}")
 
+    prime_codes = None
+    if args.prime_image:
+        from PIL import Image
+
+        from dalle_tpu.models.generate import PRIME_FRACTION
+
+        # every VAE flavor exposes .image_size (the configs differ)
+        vsize = vae.image_size
+        pil = Image.open(args.prime_image).convert("RGB").resize((vsize, vsize))
+        img1 = jnp.asarray(
+            np.asarray(pil, np.float32)[None] / 255.0
+        )  # [1, H, W, C] in [0, 1], the VAE encode contract
+        n_init = args.num_init_img_tokens or int(
+            PRIME_FRACTION * cfg.image_seq_len
+        )
+        assert 0 < n_init < cfg.image_seq_len, (
+            f"--num_init_img_tokens {n_init} must be < image_seq_len "
+            f"{cfg.image_seq_len}"
+        )
+        # encode ONCE; the chunk loop only tiles the integer codes
+        prime_codes = vae.apply(
+            {"params": vae_params}, img1, method=type(vae).get_codebook_indices
+        )[:, :n_init]
+        print(f"priming from {args.prime_image} ({n_init} codes)")
+
     try:
         rng = jax.random.PRNGKey(args.seed)
         for prompt_i, raw_text in enumerate(args.text.split("|")):
@@ -344,6 +387,10 @@ def _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                     model, params, vae, vae_params, text_batch, key,
                     filter_thres=args.top_k, temperature=args.temperature,
                     top_p=args.top_p, clip=clip, clip_params=clip_params,
+                    prime_codes=(
+                        jnp.tile(prime_codes, (args.batch_size, 1))
+                        if prime_codes is not None else None
+                    ),
                 )
                 images, scores = out if clip is not None else (out, None)
                 images = np.asarray(images, np.float32)[:n]

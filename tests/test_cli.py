@@ -115,6 +115,19 @@ def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
     ])
     assert len(list(Path(q_dir).glob("*/*.jpg"))) == 2
 
+    # --prime_image: seed generations from a real image's VAE codes
+    # (the reference's img= priming, never exposed on its CLI)
+    p_dir = str(tmp_path / "outputs_primed")
+    generate.main([
+        "--dalle_path", dalle_out + "/dalle-final",
+        "--text", "red square",
+        "--num_images", "2", "--batch_size", "2",
+        "--prime_image", str(Path(tiny_data) / "img0.png"),
+        "--num_init_img_tokens", "2",
+        "--outputs_dir", p_dir,
+    ])
+    assert len(list(Path(p_dir).glob("*/*.jpg"))) == 2
+
 
 def test_train_dalle_webdataset_cli(tmp_path):
     """train_dalle end to end from tar shards (--wds), the reference's
