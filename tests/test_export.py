@@ -85,3 +85,20 @@ def test_export_meta_describes_artifacts(tmp_path):
     for art in meta["artifacts"].values():
         assert (tmp_path / art["path"]).stat().st_size == art["bytes"]
         assert art["in_avals"] and art["out_avals"]
+
+
+def test_export_kv_int8_decoder(tmp_path):
+    """A kv_int8 model's decoder exports as pure StableHLO (the cache
+    quant/dequant are plain convert/mul ops) and still samples validly —
+    what tools/export_stablehlo.py --kv_int8 ships."""
+    from dalle_tpu.models.quantize import kv_int8_model
+
+    model, params, text, _ = _tiny_model()
+    qkv_model = kv_int8_model(model)
+    export_dalle(qkv_model, params, str(tmp_path), batch=2)
+    dec = load_exported(tmp_path / "decode.stablehlo")
+    key = jax.random.PRNGKey(3)
+    out = np.asarray(dec(params, text, key))
+    assert out.shape == (2, model.cfg.image_seq_len)
+    assert (out >= 0).all() and (out < model.cfg.num_image_tokens).all()
+    np.testing.assert_array_equal(out, np.asarray(dec(params, text, key)))
