@@ -146,3 +146,24 @@ def test_gqa_prefill_matches_stepwise():
         filter_thres=0.0, temperature=1e-8,
     )
     np.testing.assert_array_equal(np.asarray(pre), np.asarray(full))
+
+
+def test_gqa_composes_with_int8_weights():
+    """quantize_decode_params walks module names, not shapes — the narrowed
+    GQA qkv kernel must quantize per-output-channel like any projection,
+    and the full int8 deployment stack (int8 weights + int8 cache + GQA)
+    must decode."""
+    from dalle_tpu.models.quantize import kv_int8_model, quantize_for_decode
+
+    cfg = _cfg(kv_heads=2, attn_types=("full",))
+    model, params, text, _ = _init(cfg)
+    qmodel, qparams = quantize_for_decode(model, params)
+    assert qparams["transformer"]["layer_0_attn"]["fn"]["qkv"][
+        "kernel_q"
+    ].shape[-1] == (4 + 2 * 2) * cfg.dim_head  # q full + 2x grouped kv
+    full = kv_int8_model(qmodel)
+    codes = np.asarray(
+        generate_image_codes(full, qparams, text, jax.random.PRNGKey(2))
+    )
+    assert codes.shape == (2, cfg.image_seq_len)
+    assert (codes >= 0).all() and (codes < cfg.num_image_tokens).all()
