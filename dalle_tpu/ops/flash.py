@@ -60,23 +60,27 @@ def pick_block(n: int, target: int = 128) -> int:
     return max(b, 1)
 
 
-def default_block(which: str) -> int:
-    """Block-size default for :func:`flash_attention` / :func:`flash_plan`.
-
-    ``DALLE_TPU_FLASH_BLOCK_Q`` / ``_K`` override the built-in 128 — the
-    application path for ``tools/flash_tune.py`` results: export the env
-    vars the tuner prints and every flash call site (training, bench,
-    generate) picks them up without code edits."""
+def env_block_default(var: str, fallback: int) -> int:
+    """Validated env-var block-size knob — the application path for
+    ``tools/flash_tune.py`` results: export the vars the tuner prints and
+    every kernel call site picks them up without code edits.  Shared by
+    the flash and weight-only-dequant kernels so the parsing/validation
+    cannot drift."""
     import os
 
-    assert which in ("q", "k"), which
-    var = f"DALLE_TPU_FLASH_BLOCK_{which.upper()}"
     raw = os.environ.get(var)
     if not raw:
-        return 128
+        return fallback
     val = int(raw)
     assert val > 0, f"{var}={raw!r}: block size must be a positive integer"
     return val
+
+
+def default_block(which: str) -> int:
+    """Flash-kernel block default: ``DALLE_TPU_FLASH_BLOCK_Q`` / ``_K``
+    override the built-in 128."""
+    assert which in ("q", "k"), which
+    return env_block_default(f"DALLE_TPU_FLASH_BLOCK_{which.upper()}", 128)
 
 
 def _layout_or_causal(layout, nqb, nkb, bq, bk, causal):

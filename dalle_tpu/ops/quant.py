@@ -28,7 +28,7 @@ Accuracy and structure are pinned by ``tests/test_quant.py``.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -84,9 +84,18 @@ def _dequant_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
     ).astype(o_ref.dtype)
 
 
+def _wo_default(which: str, fallback: int) -> int:
+    """Weight-only dequant kernel block defaults: ``DALLE_TPU_WO_BLOCK_M``
+    / ``_F`` (tools/flash_tune.py --kernel dequant prints the exports)."""
+    from dalle_tpu.ops.flash import env_block_default
+
+    return env_block_default(f"DALLE_TPU_WO_BLOCK_{which.upper()}", fallback)
+
+
 def weight_only_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
-                       dtype=jnp.float32, block_m: int = 256,
-                       block_f: int = 512, force_kernel: bool = False) -> jnp.ndarray:
+                       dtype=jnp.float32, block_m: Optional[int] = None,
+                       block_f: Optional[int] = None,
+                       force_kernel: bool = False) -> jnp.ndarray:
     """``x @ dequant(w_q)`` with activations at full precision (no dynamic
     quantization error) and int8 weights streamed from HBM.
 
@@ -109,6 +118,8 @@ def weight_only_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
     """
     from dalle_tpu.ops.flash import _interpret
 
+    block_m = _wo_default("m", 256) if block_m is None else block_m
+    block_f = _wo_default("f", 512) if block_f is None else block_f
     lead = x.shape[:-1]
     d = x.shape[-1]
     f = w_q.shape[1]

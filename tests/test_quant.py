@@ -248,3 +248,24 @@ def test_no_fp_kernel_survives_under_quant_names():
         keys = [str(getattr(p, "key", p)) for p in path]
         if len(keys) >= 2 and keys[-2] in QUANT_MODULE_NAMES:
             assert keys[-1] != "kernel", keys
+
+
+def test_weight_only_block_env_knobs(monkeypatch):
+    """DALLE_TPU_WO_BLOCK_M/_F set the dequant kernel's default blocks
+    (tools/flash_tune.py --kernel dequant application path) without
+    changing numerics."""
+    import jax
+
+    from dalle_tpu.ops.quant import weight_only_matmul
+
+    kx, kw = jax.random.split(jax.random.PRNGKey(9))
+    x = jax.random.normal(kx, (2, 9, 64))
+    q, scale = quantize_kernel(jax.random.normal(kw, (64, 96)) * 0.1)
+    want = np.asarray(weight_only_matmul(x, q, scale, force_kernel=True))
+    monkeypatch.setenv("DALLE_TPU_WO_BLOCK_M", "8")
+    monkeypatch.setenv("DALLE_TPU_WO_BLOCK_F", "32")
+    got = np.asarray(weight_only_matmul(x, q, scale, force_kernel=True))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    monkeypatch.setenv("DALLE_TPU_WO_BLOCK_M", "0")
+    with pytest.raises(AssertionError, match="WO_BLOCK_M"):
+        weight_only_matmul(x, q, scale, force_kernel=True)

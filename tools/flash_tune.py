@@ -45,6 +45,82 @@ def _candidates(n: int, smoke: bool):
     return list(itertools.product(sizes, sizes))
 
 
+def _time_case(fn, call_args, iters):
+    """(compile_s, per-iter ms) for one jitted config — the shared timing
+    discipline of every sweep."""
+    t0 = time.perf_counter()
+    fn(*call_args).block_until_ready()
+    compile_s = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*call_args)
+    out.block_until_ready()
+    return compile_s, round((time.perf_counter() - t0) / iters * 1e3, 3)
+
+
+def _record(log_path, rec, msg):
+    """Append-BEFORE-next-config + stderr progress (the mid-sweep-wedge
+    evidence guarantee both sweeps promise)."""
+    with open(log_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr)
+
+
+def run_dequant_sweep(args) -> dict:
+    """--kernel dequant: sweep the weight-only int8 kernel's (block_m,
+    block_f) at projection shapes (ops/quant.py weight_only_matmul; the
+    generate.py --int8_mode weight_only hot path).  Winners print as
+    DALLE_TPU_WO_BLOCK_M/_F exports — the kernel's env-tunable defaults."""
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dalle_tpu.ops.quant import quantize_kernel, weight_only_matmul
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    m, d, f = args.m, args.dq_d, args.dq_f
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (m, d), dtype)
+    wq, ws = quantize_kernel(jax.random.normal(jax.random.fold_in(rng, 1), (d, f)))
+
+    ms = [b for b in (128, 256, 512) if b <= m]
+    fs = [b for b in (256, 512, 1024) if b <= f]
+    if args.smoke:
+        ms, fs = ms[:2], fs[:2]
+    os.makedirs(os.path.dirname(args.log), exist_ok=True)
+    results = []
+    for bm, bf in itertools.product(ms, fs):
+        rec = {"kernel": "dequant", "bm": bm, "bf": bf, "m": m, "d": d,
+               "f": f, "dtype": args.dtype, "on_tpu": on_tpu, "t": time.time()}
+        try:
+            fwd = jax.jit(lambda x, _bm=bm, _bf=bf: weight_only_matmul(
+                x, wq, ws, dtype=dtype, block_m=_bm, block_f=_bf,
+                force_kernel=not on_tpu))
+            rec["compile_s"], rec["fwd_ms"] = _time_case(fwd, (x,), args.iters)
+            rec["ok"] = True
+        except Exception as e:
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"[-300:]
+        results.append(rec)
+        _record(args.log, rec,
+                f"bm={bm} bf={bf}: "
+                + (f"{rec.get('fwd_ms')}ms" if rec["ok"] else rec["error"]))
+    ok = [r for r in results if r.get("ok")]
+    summary = {"tool": "flash_tune", "kernel": "dequant", "m": m, "d": d,
+               "f": f, "on_tpu": on_tpu, "configs_ok": len(ok),
+               "configs_total": len(results)}
+    if ok:
+        best = min(ok, key=lambda r: r["fwd_ms"])
+        summary["best"] = {k: best[k] for k in ("bm", "bf", "fwd_ms")}
+        summary["export"] = (
+            f"export DALLE_TPU_WO_BLOCK_M={best['bm']} "
+            f"DALLE_TPU_WO_BLOCK_F={best['bf']}"
+        )
+    return summary
+
+
 def run_sweep(args) -> dict:
     import jax
     import jax.numpy as jnp
@@ -72,33 +148,21 @@ def run_sweep(args) -> dict:
                 q, k, v, block_q=_bq, block_k=_bk))
             loss = jax.jit(jax.grad(lambda q, k, v, _bq=bq, _bk=bk: jnp.sum(
                 flash_attention(q, k, v, block_q=_bq, block_k=_bk).astype(jnp.float32))))
-            t0 = time.perf_counter()
-            fwd(*qkv).block_until_ready()
-            rec["fwd_compile_s"] = round(time.perf_counter() - t0, 2)
-            t0 = time.perf_counter()
-            for _ in range(args.iters):
-                out = fwd(*qkv)
-            out.block_until_ready()
-            rec["fwd_ms"] = round((time.perf_counter() - t0) / args.iters * 1e3, 3)
-            t0 = time.perf_counter()
-            loss(*qkv).block_until_ready()
-            rec["bwd_compile_s"] = round(time.perf_counter() - t0, 2)
-            t0 = time.perf_counter()
-            for _ in range(args.iters):
-                g = loss(*qkv)
-            g.block_until_ready()
-            rec["fwdbwd_ms"] = round((time.perf_counter() - t0) / args.iters * 1e3, 3)
+            rec["fwd_compile_s"], rec["fwd_ms"] = _time_case(
+                fwd, qkv, args.iters
+            )
+            rec["bwd_compile_s"], rec["fwdbwd_ms"] = _time_case(
+                loss, qkv, args.iters
+            )
             rec["ok"] = True
         except Exception as e:  # a failed config is data, not a crash
             rec["ok"] = False
             rec["error"] = f"{type(e).__name__}: {e}"[-300:]
         results.append(rec)
-        with open(args.log, "a") as f:
-            f.write(json.dumps(rec) + "\n")
-        print(f"[{time.strftime('%H:%M:%S')}] bq={bq} bk={bk}: "
-              + (f"fwd {rec.get('fwd_ms')}ms fwdbwd {rec.get('fwdbwd_ms')}ms"
-                 if rec["ok"] else rec["error"]),
-              file=sys.stderr)
+        _record(args.log, rec,
+                f"bq={bq} bk={bk}: "
+                + (f"fwd {rec.get('fwd_ms')}ms fwdbwd {rec.get('fwdbwd_ms')}ms"
+                   if rec["ok"] else rec["error"]))
 
     ok = [r for r in results if r.get("ok")]
     summary = {
@@ -132,11 +196,26 @@ def main():
     ap.add_argument("--log", default=DEFAULT_LOG)
     ap.add_argument("--smoke", action="store_true",
                     help="2x2 configs at the given shapes (harness check)")
+    ap.add_argument("--kernel", choices=("flash", "dequant"),
+                    default="flash",
+                    help="which Pallas kernel to sweep: flash attention "
+                         "blocks, or the weight-only int8 dequant matmul")
+    ap.add_argument("--m", type=int, default=512,
+                    help="dequant sweep: activation rows (batch*tokens)")
+    ap.add_argument("--dq_d", type=int, default=512,
+                    help="dequant sweep: input features")
+    ap.add_argument("--dq_f", type=int, default=2048,
+                    help="dequant sweep: output features (FF inner dim)")
     args = ap.parse_args()
     if os.environ.get("BENCH_SMOKE"):
         # bench harness smoke (CPU interpret): tiny shapes, 2x2 configs —
         # validates the rung end to end without minutes-per-config cost
         args.n, args.d, args.bh, args.iters, args.smoke = 256, 32, 8, 2, True
+        args.m, args.dq_d, args.dq_f = 256, 128, 512
+    if args.kernel == "dequant":
+        summary = run_dequant_sweep(args)
+        print(json.dumps(summary))
+        return 0 if summary["configs_ok"] else 2
     summary = run_sweep(args)
     print(json.dumps(summary))
     return 0 if summary["configs_ok"] else 2
