@@ -101,7 +101,8 @@ class DALLEConfig:
     sparse_random_blocks: Optional[int] = None
     use_flash: Optional[bool] = None  # None = auto (Pallas kernel on TPU)
     sp_axis: Optional[str] = None  # sequence parallelism over this mesh axis
-    sp_mode: str = "ring"  # "ring" (ppermute) | "ulysses" (all_to_all)
+    sp_mode: str = "ring"  # "ring" | "ulysses" | "usp" (hybrid, parallel/usp.py)
+    sp_ulysses: int = 2  # usp only: the all_to_all group size
     sp_schedule: str = "contiguous"  # ring only: | "zigzag" (balanced)
     pp_stages: int = 1  # GPipe pipeline parallelism over the 'pp' mesh axis
     pp_microbatches: int = 4
@@ -169,6 +170,7 @@ class DALLEConfig:
             use_flash=self.use_flash,
             sp_axis=self.sp_axis,
             sp_mode=self.sp_mode,
+            sp_ulysses=self.sp_ulysses,
             sp_schedule=self.sp_schedule,
             pp_stages=self.pp_stages,
             pp_microbatches=self.pp_microbatches,

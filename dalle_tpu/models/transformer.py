@@ -101,6 +101,10 @@ class TransformerConfig:
     # = all_to_all head<->sequence re-shard (parallel/ulysses.py; needs
     # local heads % sp == 0).  The reference has neither (SURVEY.md §5.7).
     sp_mode: str = "ring"
+    # USP hybrid (sp_mode="usp"): the sp axis factors as sp_ulysses x
+    # ring — grouped all_to_alls inside each sp_ulysses-sized neighbor
+    # group, a strided group ring across (parallel/usp.py)
+    sp_ulysses: int = 2
     # ring schedule: "contiguous" (cond-skip) or "zigzag" (load-balanced
     # chunk layout — per-step wall-clock halves; parallel/ring.py)
     sp_schedule: str = "contiguous"
@@ -570,6 +574,14 @@ class JointAttention(nn.Module):
                     return ulysses_attention_sharded(
                         q, k, v, key_pad_mask, sp_axis=c.sp_axis,
                         causal=True, use_flash=use_flash,
+                    )
+                if c.sp_mode == "usp":
+                    from dalle_tpu.parallel.usp import usp_attention_sharded
+
+                    return usp_attention_sharded(
+                        q, k, v, key_pad_mask, sp_axis=c.sp_axis,
+                        ulysses=c.sp_ulysses, causal=True,
+                        use_flash=use_flash,
                     )
                 from dalle_tpu.parallel.ring import ring_attention_sharded
 

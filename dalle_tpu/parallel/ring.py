@@ -74,7 +74,7 @@ def _merge_partial(o, lse, o_s, lse_s):
     return o_new, m + jnp.log(denom)
 
 
-def _ring_schedule(k, v, init, attend, *, axis_name, causal):
+def _ring_schedule(k, v, init, attend, *, axis_name, causal, stride=1):
     """Shared contiguous-ring driver.  The rotation, the ``src``
     computation, and the causal live set (skip src > idx; src == idx is
     the diagonal) live HERE, once — both chunk implementations (einsum
@@ -82,13 +82,20 @@ def _ring_schedule(k, v, init, attend, *, axis_name, causal):
     schedule, so the skip set can never drift between them.
     ``attend(st, k_cur, v_cur, src, diag)`` folds one chunk into the
     carry; ``diag`` is a static bool: the chunk needs within-chunk
-    causality (only ever the diagonal)."""
+    causality (only ever the diagonal).
+
+    ``stride`` > 1 rings over GROUPS of ``stride`` consecutive axis
+    members (USP: the group interior is the Ulysses all_to_all,
+    parallel/usp.py): the rotation shifts by ``stride`` so each member
+    exchanges with its same-rank peer in the neighbor group, and
+    ``src``/liveness are group indices."""
     p_size = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = jax.lax.axis_index(axis_name) // stride  # group index
+    n_steps = p_size // stride
 
     def step(carry, s):
         k_cur, v_cur, st, n_done = carry
-        src = (idx - s) % p_size  # owner of the chunk I currently hold
+        src = (idx - s) % n_steps  # owner GROUP of the chunk I hold
 
         def run(diag):
             return lambda p: (attend(p[0], k_cur, v_cur, src, diag), p[1] + 1)
@@ -108,10 +115,10 @@ def _ring_schedule(k, v, init, attend, *, axis_name, causal):
         else:
             pack = run(False)(pack)
         st, n_done = pack
-        # rotate K/V to the next device (ring over ICI) — every step, on
-        # every device: the rotation IS the ring, skipping it would
-        # deadlock the collective
-        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        # rotate K/V to the next device/group (ring over ICI) — every
+        # step, on every device: the rotation IS the ring, skipping it
+        # would deadlock the collective
+        perm = [(i, (i + stride) % p_size) for i in range(p_size)]
         return (
             jax.lax.ppermute(k_cur, axis_name, perm),
             jax.lax.ppermute(v_cur, axis_name, perm),
@@ -119,7 +126,7 @@ def _ring_schedule(k, v, init, attend, *, axis_name, causal):
         ), None
 
     (_, _, st, n_done), _ = jax.lax.scan(
-        step, (k, v, init, jnp.zeros((), jnp.int32)), jnp.arange(p_size)
+        step, (k, v, init, jnp.zeros((), jnp.int32)), jnp.arange(n_steps)
     )
     return st, n_done
 
@@ -134,6 +141,7 @@ def ring_attention(
     causal: bool = True,
     return_stats: bool = False,
     use_flash: bool = False,
+    stride: int = 1,
 ):
     """Local view: q, k, v [b, h, n_local, d], sequence sharded over
     ``axis_name``; key_pad_mask: optional GLOBAL [b, n] (replicated),
@@ -144,9 +152,13 @@ def ring_attention(
     (``flash_attention_lse``) and fold partials via logsumexp merge
     (``_merge_partial``) instead of the einsum online update — same
     schedule (``_ring_schedule``), same skip set, no [b,h,nl,nl] score
-    block in HBM."""
+    block in HBM.
+
+    ``stride``: ring over groups of ``stride`` axis members (USP,
+    parallel/usp.py) — inputs are the POST-all_to_all group chunks and
+    positions/liveness are group-level."""
     p_size = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = jax.lax.axis_index(axis_name) // stride  # chunk (group) index
     b, h, nl, d = q.shape
 
     def kpm_chunk(src):
@@ -169,7 +181,8 @@ def ring_attention(
             jnp.full((b, h, nl), NEG_INF, jnp.float32),
         )
         (o, _), n_done = _ring_schedule(
-            k, v, init, attend, axis_name=axis_name, causal=causal
+            k, v, init, attend, axis_name=axis_name, causal=causal,
+            stride=stride,
         )
         out = o.astype(q.dtype)
         return (out, n_done) if return_stats else out
@@ -200,7 +213,8 @@ def ring_attention(
         jnp.zeros((b, h, nl, d), jnp.float32),
     )
     (m, l, acc), n_done = _ring_schedule(
-        k, v, init, attend, axis_name=axis_name, causal=causal
+        k, v, init, attend, axis_name=axis_name, causal=causal,
+        stride=stride,
     )
     out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
     return (out, n_done) if return_stats else out

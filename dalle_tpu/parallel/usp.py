@@ -1,0 +1,121 @@
+"""USP: hybrid Ulysses x Ring sequence parallelism over ONE mesh axis.
+
+The two pure schemes trade off differently (docs/SCALING.md): Ulysses is
+two all_to_alls total but needs tp-local heads divisible by the sp
+degree; ring has no head constraint but pays P-1 latency-exposed hops.
+USP (the "unified sequence parallelism" recipe; PAPERS.md FastUSP) takes
+both: the sp axis factors as ``ulysses x ring`` — consecutive groups of
+``ulysses`` devices run the all_to_all head<->sequence re-shard INSIDE
+the group (the high-bandwidth neighbors), and the groups ring their K/V
+chunks around with stride-``ulysses`` ppermutes.  sp can then scale past
+the head count (ring handles the rest), while most traffic stays in the
+cheap intra-group all_to_all.
+
+No new mesh axis: the grouping is expressed with ``axis_index_groups``
+on the existing ``sp`` axis, and the group-level ring reuses the shared
+``_ring_schedule`` driver via its ``stride`` parameter
+(parallel/ring.py) — the causal skip set stays defined in exactly one
+place.  The reference has no sequence parallelism at all (SURVEY.md
+§5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dalle_tpu.parallel.ring import ring_attention
+
+
+def usp_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    key_pad_mask: Optional[jnp.ndarray] = None,
+    *,
+    axis_name: str,
+    ulysses: int,
+    causal: bool = True,
+    use_flash: bool = False,
+) -> jnp.ndarray:
+    """Local view: q, k, v [b, h, n/P, d] with P = sp axis size; sequence
+    sharded over the whole axis; ``ulysses`` must divide P and the local
+    head count.  key_pad_mask: optional GLOBAL [b, n] (replicated)."""
+    p_size = jax.lax.axis_size(axis_name)
+    b, h, nl, d = q.shape
+    assert p_size % ulysses == 0, (
+        f"sp axis {p_size} not divisible by ulysses degree {ulysses}"
+    )
+    assert h % ulysses == 0, (
+        f"local heads {h} not divisible by ulysses degree {ulysses} "
+        "(lower --sp_ulysses or raise heads)"
+    )
+    groups = [
+        [g * ulysses + j for j in range(ulysses)]
+        for g in range(p_size // ulysses)
+    ]
+
+    def to_seq(x):  # [b, h, n/P, d] -> [b, h/U, n/R, d] within each group
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True,
+            axis_index_groups=groups,
+        )
+
+    def to_heads(x):  # inverse re-shard
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True,
+            axis_index_groups=groups,
+        )
+
+    qg, kg, vg = to_seq(q), to_seq(k), to_seq(v)
+    out = ring_attention(
+        qg, kg, vg, key_pad_mask, axis_name=axis_name, causal=causal,
+        use_flash=use_flash, stride=ulysses,
+    )
+    return to_heads(out.astype(q.dtype))
+
+
+def usp_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    key_pad_mask: Optional[jnp.ndarray] = None,
+    *,
+    sp_axis: str = "sp",
+    ulysses: int = 2,
+    causal: bool = True,
+    mesh=None,
+    use_flash: bool = False,
+):
+    """Global view under jit (sibling of ``ring_attention_sharded`` /
+    ``ulysses_attention_sharded``): batch over (dp, fsdp), heads over tp,
+    sequence over ``sp_axis``; pad mask batch-sharded and
+    sequence-replicated."""
+    if mesh is None:
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        mesh = get_ambient_mesh()
+    assert mesh is not None, (
+        "usp attention needs a mesh: pass mesh= or run the step under "
+        "dalle_tpu.parallel.mesh.ambient(mesh) (train_lib does this)"
+    )
+    spec = P(("dp", "fsdp"), "tp", sp_axis, None)
+    mspec = P(("dp", "fsdp"), None)
+    fn = functools.partial(
+        usp_attention, axis_name=sp_axis, ulysses=ulysses, causal=causal,
+        use_flash=use_flash,
+    )
+    if key_pad_mask is None:
+        return jax.shard_map(
+            lambda q, k, v: fn(q, k, v),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v, key_pad_mask)

@@ -1,0 +1,132 @@
+"""USP hybrid (Ulysses x Ring) sequence parallelism vs the dense oracle on
+the multi-device CPU mesh — real grouped all_to_alls + strided ppermutes
+(parallel/usp.py; the reference has no sequence parallelism, SURVEY.md
+§5.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.ops import attention as A
+from dalle_tpu.parallel import make_mesh
+from dalle_tpu.parallel.usp import usp_attention_sharded
+
+B, H, D = 2, 4, 16
+N = 32
+
+
+def qkv(key):
+    ks = jax.random.split(key, 3)
+    return [jax.random.normal(k, (B, H, N, D)) for k in ks]
+
+
+@pytest.mark.parametrize("ulysses", [2, 4])
+def test_usp_matches_full_causal(rng, devices, ulysses):
+    """sp=4 factored as ulysses x ring: U=2 -> 2 groups ringing; U=4 ->
+    pure-Ulysses degenerate (ring of one group)."""
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+    want = A.full_causal_attention(q, k, v)
+    got = jax.jit(
+        lambda q, k, v: usp_attention_sharded(
+            q, k, v, mesh=mesh, ulysses=ulysses
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_usp_pure_ring_degenerate(rng, devices):
+    """ulysses=1 must equal plain ring (stride-1 schedule)."""
+    from dalle_tpu.parallel.ring import ring_attention_sharded
+
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+    got = jax.jit(
+        lambda q, k, v: usp_attention_sharded(q, k, v, mesh=mesh, ulysses=1)
+    )(q, k, v)
+    want = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, mesh=mesh)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_usp_gradients_match_dense(rng, devices):
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+
+    def loss_usp(q, k, v):
+        return jnp.sum(
+            usp_attention_sharded(q, k, v, mesh=mesh, ulysses=2) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.full_causal_attention(q, k, v) ** 2)
+
+    gu = jax.grad(loss_usp, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gu, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_usp_pad_mask_and_flash(rng, devices):
+    """Ragged batch through USP, einsum and flash-chunk group rings."""
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q, k, v = qkv(rng)
+    kpm = jnp.ones((B, N), jnp.int32).at[0, 20:].set(0)
+    want = A.full_causal_attention(q, k, v, key_pad_mask=kpm)
+    valid = np.asarray(kpm, bool)[:, None, :, None]
+    for use_flash in (False, True):
+        got = jax.jit(
+            lambda q, k, v, _f=use_flash: usp_attention_sharded(
+                q, k, v, kpm, mesh=mesh, ulysses=2, use_flash=_f
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got) * valid, np.asarray(want) * valid, atol=2e-5,
+            err_msg=f"use_flash={use_flash}",
+        )
+
+
+def test_usp_composes_with_dp_tp(rng, devices):
+    """USP under a dp x tp x sp mesh: U=2 with tp-local heads 4/2=2."""
+    mesh = make_mesh(dp=2, fsdp=1, tp=2, sp=2)
+    q, k, v = qkv(rng)
+    want = A.full_causal_attention(q, k, v)
+    got = jax.jit(
+        lambda q, k, v: usp_attention_sharded(
+            q, k, v, mesh=mesh, ulysses=2
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_usp_dalle_train_step(rng, devices):
+    """Full flagship-style train step with --sp_mode usp on the sp mesh."""
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.parallel.mesh import ambient
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    cfg = DALLEConfig(
+        num_text_tokens=40, text_seq_len=8, num_image_tokens=16,
+        image_fmap_size=4, dim=32, depth=2, heads=4, dim_head=8,
+        attn_types=("full",), sp_axis="sp", sp_mode="usp", sp_ulysses=2,
+    )
+    model = DALLE(cfg)
+    text = jnp.ones((2, 8), jnp.int32)
+    codes = jnp.zeros((2, cfg.image_seq_len), jnp.int32)
+    tx = make_optimizer(1e-3)
+    with ambient(mesh):
+        params, opt = init_train_state(
+            model, tx, mesh, {"params": rng}, text, codes
+        )
+    step = make_dalle_train_step(model, tx, mesh)
+    _, _, loss = step(params, opt, None, text, codes, rng)
+    assert np.isfinite(float(loss))

@@ -170,12 +170,16 @@ def parse_args(argv=None):
                         help="sequence parallelism over mesh_sp (scheme "
                              "chosen by --sp_mode)")
     parser.add_argument("--sp_mode", type=str, default=None,
-                        choices=("ring", "ulysses"),
+                        choices=("ring", "ulysses", "usp"),
                         help="enables sequence parallelism with the given "
                              "scheme (implies --sp_ring): ring = ppermute "
                              "K/V rotation; ulysses = all_to_all head<->seq "
                              "re-shard (tp-local heads, i.e. heads/mesh_tp, "
                              "must divide by mesh_sp)")
+    parser.add_argument("--sp_ulysses", type=int, default=2,
+                        help="with --sp_mode usp: the all_to_all group "
+                             "size (mesh_sp = sp_ulysses x ring groups; "
+                             "tp-local heads must divide by it)")
     parser.add_argument("--sp_schedule", type=str, default="contiguous",
                         choices=("contiguous", "zigzag"),
                         help="ring schedule: contiguous skips fully-masked "
@@ -328,6 +332,7 @@ def main(argv=None):
             use_flash={"auto": None, "on": True, "off": False}[args.use_flash],
             sp_axis="sp" if (args.sp_ring or args.sp_mode) else None,
             sp_mode=args.sp_mode or "ring",
+            sp_ulysses=args.sp_ulysses,
             sp_schedule=args.sp_schedule,
             moe_experts=args.moe_experts,
             moe_every=args.moe_every,
