@@ -566,6 +566,15 @@ class JointAttention(nn.Module):
             # both SP schemes thread the pad mask through (ring slices it
             # per rotating chunk; ulysses hands it to the flash kernel)
             if self.attn_type == "full":
+                if c.sp_schedule == "zigzag" and c.sp_mode != "ring":
+                    import warnings
+
+                    warnings.warn(
+                        "--sp_schedule zigzag applies to the pure ring "
+                        f"only; sp_mode={c.sp_mode!r} runs its own "
+                        "schedule",
+                        stacklevel=2,
+                    )
                 if c.sp_mode == "ulysses":
                     from dalle_tpu.parallel.ulysses import (
                         ulysses_attention_sharded,
