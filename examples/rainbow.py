@@ -41,16 +41,9 @@ POS = {"top left": (0, 0), "top right": (0, 8),
 IMG, TEXT_LEN = 16, 24
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=400)
-    ap.add_argument("--vae_steps", type=int, default=200)
-    ap.add_argument("--out", type=str, default="rainbow_out")
-    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
-    args = ap.parse_args()
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-
+def run(steps: int = 400, vae_steps: int = 200, log=print) -> dict:
+    """The whole pipeline as a callable (bench.py's ``rainbow`` phase):
+    returns the accuracy metrics plus everything needed to render grids."""
     texts, images = [], []
     for (cn, c), (pn, (r, col)) in itertools.product(COLORS.items(), POS.items()):
         img = np.zeros((IMG, IMG, 3), np.float32)
@@ -63,7 +56,7 @@ def main():
     rng = jax.random.PRNGKey(0)
     mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=1)
 
-    print(f"dataset: {len(texts)} caption-image pairs")
+    log(f"dataset: {len(texts)} caption-image pairs")
     vcfg = DiscreteVAEConfig(image_size=IMG, num_tokens=24, codebook_dim=16,
                              num_layers=2, hidden_dim=32, straight_through=True)
     vae = DiscreteVAE(vcfg)
@@ -71,13 +64,14 @@ def main():
     vparams, vopt = init_train_state(
         vae, vtx, mesh, {"params": rng, "gumbel": rng}, imgs, return_loss=True
     )
+    assert steps > 0 and vae_steps > 0, "steps and vae_steps must be >= 1"
     vstep = make_vae_train_step(vae, vtx, mesh)
-    for i in range(args.vae_steps):
+    for i in range(vae_steps):
         temp = max(1.0 * 0.97**i, 0.1)
         vparams, vopt, vloss, _ = vstep(vparams, vopt, imgs, temp,
                                         jax.random.fold_in(rng, i))
         if i % 50 == 0:
-            print(f"  vae step {i}: loss {float(vloss):.5f}")
+            log(f"  vae step {i}: loss {float(vloss):.5f}")
 
     codes = vae.apply({"params": vparams}, imgs,
                       method=DiscreteVAE.get_codebook_indices)
@@ -89,18 +83,41 @@ def main():
     params, opt = init_train_state(model, tx, mesh, {"params": rng},
                                    text_ids, codes)
     step = make_dalle_train_step(model, tx, mesh)
-    for i in range(args.steps):
+    for i in range(steps):
         params, opt, loss = step(params, opt, None, text_ids, codes,
                                  jax.random.fold_in(rng, 10_000 + i))
         if i % 100 == 0:
-            print(f"  dalle step {i}: loss {float(loss):.5f}")
+            log(f"  dalle step {i}: loss {float(loss):.5f}")
 
     gen = generate_image_codes(model, params, text_ids,
                                jax.random.fold_in(rng, 99),
                                filter_thres=0.95, temperature=0.1)
     acc = float(jnp.mean(gen == codes))
     exact = float(jnp.mean(jnp.all(gen == codes, axis=1)))
-    print(f"token accuracy: per-position {acc:.3f}, exact-match {exact:.3f}")
+    log(f"token accuracy: per-position {acc:.3f}, exact-match {exact:.3f}")
+    return {
+        "per_position_acc": round(acc, 4),
+        "exact_match_acc": round(exact, 4),
+        "vae_loss": round(float(vloss), 5),
+        "dalle_loss": round(float(loss), 5),
+        "n_pairs": len(texts),
+        "steps": steps,
+        "vae_steps": vae_steps,
+        "_render": (vae, vparams, gen, imgs),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--vae_steps", type=int, default=200)
+    ap.add_argument("--out", type=str, default="rainbow_out")
+    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    res = run(steps=args.steps, vae_steps=args.vae_steps)
+    vae, vparams, gen, imgs = res.pop("_render")
 
     out = Path(args.out)
     out.mkdir(exist_ok=True)
