@@ -159,6 +159,22 @@ def main():
         np.asarray(jax.device_get(ring_out)), np.asarray(want_ring), atol=1e-5
     )
 
+    # USP hybrid across the process boundary: sp=4 with ulysses=2 puts
+    # each all_to_all GROUP inside one process (devices 0-1 / 2-3) and
+    # the stride-2 group ring's hops between the processes — the intended
+    # multi-host layout (cheap a2a on-host, ring across hosts)
+    from dalle_tpu.parallel.usp import usp_attention_sharded
+
+    usp_out = jax.jit(
+        lambda q, k, v: usp_attention_sharded(
+            q, k, v, mesh=mesh_sp, ulysses=2
+        ),
+        out_shardings=NamedSharding(mesh_sp, P()),
+    )(qg, kg, vg)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(usp_out)), np.asarray(want_ring), atol=1e-5
+    )
+
     backend.local_barrier()
     print(f"MP_WORKER_OK rank={proc_id}")
 
