@@ -68,7 +68,7 @@ CACHE_DIR = os.path.join(REPO, ".jax_cache")
 # needs_chip=False phases are host-side and still run/record when the chip
 # has wedged mid-run.
 PHASES = [
-    ("flash_probe", 1000, True),  # tools/flash_probe.py: kernel-only, per-case subprocesses (6 cases x 150s worst case incl. the int8-dequant kernel)
+    ("flash_probe", 1150, True),  # tools/flash_probe.py: kernel-only, per-case subprocesses (7 cases x 150s worst case incl. the int8-dequant and ring-lse kernels)
     ("train_tiny", 480, True),
     ("train", 1200, True),        # flagship, dense XLA attention (can't hang in Mosaic)
     ("train_fused", 900, True),   # flagship + fused range-split CE (ops/fused_ce.py)
@@ -415,7 +415,7 @@ def main():
     # still eat into the tail phases' budgets — the deadline bounds the
     # WHOLE run on purpose, trading tail evidence for a predictable
     # driver runtime
-    default_deadline = 9300 + (_TUNE_BUDGET_S if os.environ.get("BENCH_TUNE") else 0)
+    default_deadline = 9450 + (_TUNE_BUDGET_S if os.environ.get("BENCH_TUNE") else 0)
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", default_deadline))
     attempts = []
     info = None

@@ -46,6 +46,10 @@ CASES = [
     # the OTHER Pallas kernel: weight-only int8 in-VMEM dequant matmul
     # (ops/quant.py) at projection shapes — its own Mosaic moment of truth
     ("dequant_int8_512", 512, 512, "bfloat16", False, False),
+    # the ring/USP chunk path: flash_attention_lse at a ring-chunk shape
+    # (n=320 = flagship 1280 / sp4), causal diagonal + full off-diagonal
+    # variants, INCLUDING the dlse backward (the logsumexp-merge VJP)
+    ("ring_lse_bf16_320", 320, 64, "bfloat16", False, False),
     ("causal_bf16_4096", 4096, 64, "bfloat16", False, False),  # VQGAN-f8 scale
 ]
 
@@ -106,10 +110,73 @@ def _run_dequant_case(name: str) -> dict:
     }
 
 
+def _run_lse_case(name: str) -> dict:
+    """flash_attention_lse at a ring-chunk shape: both causal (diagonal
+    chunk) and non-causal (full chunk) compiles, with a loss that reads
+    BOTH outputs so the dlse backward (delta - dlse adjustment,
+    ops/flash.py) gets its own Mosaic moment of truth."""
+    jax, jnp, import_s = _import_jax_for_probe()
+
+    from dalle_tpu.ops.flash import flash_attention_lse
+
+    platform = jax.default_backend()
+    b, h, n, d = 1, 2, 320, 64
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, h, n, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, n, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, n, d), jnp.bfloat16)
+    g = jax.random.normal(kg, (b, h, n, d), jnp.float32)
+
+    def loss(q, k, v, causal):
+        o, lse = flash_attention_lse(q, k, v, causal=causal)
+        return jnp.sum(o.astype(jnp.float32) * g) + 0.1 * jnp.sum(lse)
+
+    def dense_loss(q, k, v, causal):
+        s_ = jnp.einsum(
+            "bhid,bhjd->bhij", q.astype(jnp.float32),
+            k.astype(jnp.float32),
+        ) * (d ** -0.5)
+        if causal:
+            i = jnp.arange(n)
+            s_ = jnp.where(
+                (i[None, :] <= i[:, None])[None, None], s_, -1e30
+            )
+        o = jnp.einsum(
+            "bhij,bhjd->bhid", jax.nn.softmax(s_, axis=-1),
+            v.astype(jnp.float32),
+        )
+        lse = jax.scipy.special.logsumexp(s_, axis=-1)
+        return jnp.sum(o * g) + 0.1 * jnp.sum(lse)
+
+    rec = {"case": name, "n": n, "d": d, "dtype": "bfloat16",
+           "platform": platform, "interpret": platform != "tpu",
+           "import_s": round(import_s, 1)}
+    worst = 0.0
+    for causal in (True, False):
+        tag = "causal" if causal else "full"
+        t0 = time.perf_counter()
+        grads = jax.jit(
+            jax.grad(loss, argnums=(0, 1, 2)), static_argnums=3
+        )(q, k, v, causal)
+        jax.block_until_ready(grads)
+        rec[f"{tag}_fwdbwd_compile_s"] = round(time.perf_counter() - t0, 2)
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v, causal)
+        worst = max(worst, max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b_)))
+            for a, b_ in zip(grads, gd)
+        ))
+    rec["bwd_max_err"] = round(worst, 6)
+    rec["numerics_ok"] = bool(worst < 0.3)  # bf16 grads incl. lse term
+    return rec
+
+
 def run_case(name: str) -> dict:
     """Child entry: compile+run fwd and bwd for one case, check numerics."""
     if name.startswith("dequant_int8"):
         return _run_dequant_case(name)
+    if name.startswith("ring_lse"):
+        return _run_lse_case(name)
     n, d, dtype_name, sparse, masked = next(
         (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
     )
