@@ -467,8 +467,15 @@ class JointAttention(nn.Module):
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
             if c.rotary_v:  # reference rotates v too (attention.py:32-35)
                 v = apply_rotary(v, ang)
-        k, v = self._expand_kv(k, v)
         t, f = c.text_seq_len, c.fmap_size
+        if c.causal and self.attn_type in ("sparse", "full"):
+            # grouped K/V ride into the 'full' SP schemes un-expanded (the
+            # collectives then move heads/kv_heads times fewer bytes);
+            # _full_or_sparse expands for every other consumer
+            out = self._full_or_sparse(q, k, v, key_pad_mask)
+            out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
+            return self.drop(self.to_out(out), deterministic=deterministic)
+        k, v = self._expand_kv(k, v)
         if not c.causal:
             # bidirectional (CLIP encoders): flash handles the ragged
             # key-pad mask in-kernel, so the masked text path stays fast
@@ -520,8 +527,6 @@ class JointAttention(nn.Module):
                 out = attn_ops.conv_like_attention(
                     q, k, v, t, f, c.kernel_size, c.dilation, key_pad_mask
                 )
-        elif self.attn_type in ("sparse", "full"):
-            out = self._full_or_sparse(q, k, v, key_pad_mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
         return self.drop(self.to_out(out), deterministic=deterministic)
 
@@ -566,6 +571,17 @@ class JointAttention(nn.Module):
             # both SP schemes thread the pad mask through (ring slices it
             # per rotating chunk; ulysses hands it to the flash kernel)
             if self.attn_type == "full":
+                if k.shape[1] < q.shape[1]:
+                    # grouped K/V transport needs the kv-head dim to shard
+                    # over tp like q's; otherwise expand up front
+                    from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+                    mesh = get_ambient_mesh()
+                    tp = (
+                        mesh.shape.get("tp", 1) if mesh is not None else 1
+                    )
+                    if k.shape[1] % tp:
+                        k, v = self._expand_kv(k, v)
                 if c.sp_schedule == "zigzag" and c.sp_mode != "ring":
                     import warnings
 
@@ -608,6 +624,8 @@ class JointAttention(nn.Module):
                 "their own sequence-sharded path)",
                 stacklevel=2,
             )
+        # single-device / 'sparse'-type paths consume full-head K/V
+        k, v = self._expand_kv(k, v)
         if use_flash:
             # the kernel applies an optional key-pad mask in-block, so a
             # ragged batch no longer forces the dense fallback

@@ -43,6 +43,17 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
+def expand_grouped_kv(x: jnp.ndarray, q_heads: int) -> jnp.ndarray:
+    """Broadcast grouped (GQA) K/V heads up to ``q_heads`` (consecutive-
+    block mapping, the transformer.py kv_heads convention).  THE one
+    definition of the head<->kv-head correspondence for every SP scheme —
+    a changed mapping cannot silently diverge between them."""
+    kv = x.shape[1]
+    assert q_heads % kv == 0, (q_heads, x.shape)
+    g = q_heads // kv
+    return jnp.repeat(x, g, axis=1) if g > 1 else x
+
+
 def _online_update(s_blk, v_blk, m, l, acc):
     """One online-softmax block update (shared by BOTH ring schedules so
     numerics can never drift between them): masked scores ``s_blk``
@@ -156,10 +167,19 @@ def ring_attention(
 
     ``stride``: ring over groups of ``stride`` axis members (USP,
     parallel/usp.py) — inputs are the POST-all_to_all group chunks and
-    positions/liveness are group-level."""
+    positions/liveness are group-level.
+
+    Grouped-query K/V: ``k``/``v`` may carry FEWER heads than ``q`` (a
+    divisor — GQA, transformer.py kv_heads).  The ppermute rotation then
+    moves the small grouped tensors and each chunk expands to full heads
+    only transiently inside its attend — SP interchip traffic shrinks by
+    the group factor, which is exactly the long-sequence regime GQA+SP
+    targets."""
     p_size = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name) // stride  # chunk (group) index
     b, h, nl, d = q.shape
+    def expand(x):  # grouped (GQA) K/V -> full heads, per chunk
+        return expand_grouped_kv(x, h)
 
     def kpm_chunk(src):
         if key_pad_mask is None:
@@ -172,7 +192,8 @@ def ring_attention(
         def attend(st, k_cur, v_cur, src, diag):
             o, lse = st
             o_s, lse_s = flash_attention_lse(
-                q, k_cur, v_cur, causal=diag, key_pad_mask=kpm_chunk(src)
+                q, expand(k_cur), expand(v_cur), causal=diag,
+                key_pad_mask=kpm_chunk(src),
             )
             return _merge_partial(o, lse, o_s, lse_s)
 
@@ -195,7 +216,7 @@ def ring_attention(
         del diag  # the global-position mask covers diagonal AND full chunks
         m, l, acc = st
         sblk = jnp.einsum(
-            "bhid,bhjd->bhij", qf, k_cur.astype(jnp.float32),
+            "bhid,bhjd->bhij", qf, expand(k_cur).astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
         if causal:
@@ -205,7 +226,7 @@ def ring_attention(
         kpm_blk = kpm_chunk(src)  # [b, nl] of the incoming chunk
         if kpm_blk is not None:
             sblk = jnp.where(kpm_blk[:, None, None, :] > 0, sblk, NEG_INF)
-        return _online_update(sblk, v_cur, m, l, acc)
+        return _online_update(sblk, expand(v_cur), m, l, acc)
 
     init = (
         jnp.full((b, h, nl, 1), NEG_INF, jnp.float32),
@@ -306,7 +327,11 @@ def zigzag_ring_attention(
     (asserted balanced in tests/test_ring.py).
 
     ``use_flash``: flash-kernel quadrants + logsumexp merge — same live
-    set (one shared driver), no materialized score blocks."""
+    set (one shared driver), no materialized score blocks.
+
+    Grouped-query K/V supported as in :func:`ring_attention`: the
+    rotation moves the small grouped tensors; quadrants expand
+    transiently."""
     p_size = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, nl, d = q.shape
@@ -314,6 +339,8 @@ def zigzag_ring_attention(
     c = nl // 2
     ar = jnp.arange(c)
     qpos = {"A": idx * c + ar, "B": (2 * p_size - 1 - idx) * c + ar}
+    def expand(x):  # grouped (GQA) K/V -> full heads, per quadrant
+        return expand_grouped_kv(x, h)
 
     def half(x, which):
         return x[:, :, :c] if which == "A" else x[:, :, c:]
@@ -330,7 +357,8 @@ def zigzag_ring_attention(
         def quadrant(st, qhalf, khalf, k_cur, v_cur, kpos, diag):
             o, lse = st
             o_s, lse_s = flash_attention_lse(
-                half(q, qhalf), half(k_cur, khalf), half(v_cur, khalf),
+                half(q, qhalf), expand(half(k_cur, khalf)),
+                expand(half(v_cur, khalf)),
                 causal=diag, key_pad_mask=kpm_at(kpos),
             )
             return _merge_partial(o, lse, o_s, lse_s)
@@ -353,8 +381,8 @@ def zigzag_ring_attention(
         """Masked online-softmax update of one c×c quadrant."""
         del diag  # the global-position mask covers diagonal AND full
         m, l, acc = st
-        kc = half(k_cur, khalf)
-        vc = half(v_cur, khalf)
+        kc = expand(half(k_cur, khalf))
+        vc = expand(half(v_cur, khalf))
         s_blk = jnp.einsum(
             "bhid,bhjd->bhij", qh[qhalf], kc.astype(jnp.float32),
             preferred_element_type=jnp.float32,

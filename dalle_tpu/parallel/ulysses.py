@@ -60,6 +60,14 @@ def ulysses_attention(
             x, axis_name, split_axis=2, concat_axis=1, tiled=True
         )
 
+    if k.shape[1] != q.shape[1]:
+        # grouped (GQA) K/V: pure ulysses re-shards the head dim itself,
+        # so grouped transport doesn't map — expand up front (ring/usp
+        # keep the grouped saving; this keeps ulysses CORRECT)
+        from dalle_tpu.parallel.ring import expand_grouped_kv
+
+        k = expand_grouped_kv(k, q.shape[1])
+        v = expand_grouped_kv(v, q.shape[1])
     qg, kg, vg = to_seq(q), to_seq(k), to_seq(v)
     if use_flash is None:  # the shared auto convention (transformer.py)
         use_flash = jax.default_backend() == "tpu"

@@ -71,6 +71,13 @@ def usp_attention(
             axis_index_groups=groups,
         )
 
+    if k.shape[1] % ulysses:
+        # grouped K/V heads not divisible by the a2a degree: expand up
+        # front (correct, loses the grouped-transport saving for k/v)
+        from dalle_tpu.parallel.ring import expand_grouped_kv
+
+        k = expand_grouped_kv(k, h)
+        v = expand_grouped_kv(v, h)
     qg, kg, vg = to_seq(q), to_seq(k), to_seq(v)
     out = ring_attention(
         qg, kg, vg, key_pad_mask, axis_name=axis_name, causal=causal,
