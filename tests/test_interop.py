@@ -370,3 +370,58 @@ def test_reverse_export_roundtrips_through_our_loader(tmp_path):
             np.asarray(got), np.asarray(leaf), atol=1e-6,
             err_msg=jtu.keystr(path),
         )
+
+
+def test_reverse_roundtrip_random_config_sweep(tmp_path):
+    """Property-style sweep: N seeded-random configs (layer-cycle, dims,
+    shift/sandwich/stable toggles) round-trip ours → reference .pt → ours
+    losslessly — a single fixed config can hide a mapping bug that only a
+    shape/flag combination exposes."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.interop import load_reference_pt, save_reference_pt
+
+    rnd = random.Random(7)
+    for trial in range(4):
+        heads = rnd.choice([2, 4])
+        cfg = DALLEConfig(
+            num_text_tokens=rnd.choice([40, 60]),
+            text_seq_len=rnd.choice([6, 8]),
+            num_image_tokens=rnd.choice([16, 32]),
+            image_fmap_size=rnd.choice([3, 4]),
+            dim=rnd.choice([24, 32]),
+            depth=rnd.choice([1, 2, 3]),
+            heads=heads,
+            dim_head=8,
+            attn_types=tuple(rnd.choice([
+                ("full",), ("full", "axial_row"),
+                ("full", "axial_col", "conv_like"), ("full", "mlp"),
+            ])),
+            shift_tokens=rnd.random() < 0.5,
+            sandwich_norm=rnd.random() < 0.5,
+            stable=rnd.random() < 0.5,
+        )
+        model = DALLE(cfg)
+        k = jax.random.PRNGKey(100 + trial)
+        text = jnp.ones((1, cfg.text_seq_len), jnp.int32)
+        codes = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+        params = model.init(k, text, codes)["params"]
+        pt = tmp_path / f"sweep{trial}.pt"
+        save_reference_pt(pt, cfg, params)
+        loaded = load_reference_pt(
+            str(pt), expect="dalle", fmap_hint=cfg.image_fmap_size
+        )
+        flat_a = jax.tree_util.tree_leaves_with_path(params)
+        for path, leaf in flat_a:
+            got = loaded["params"]
+            for p in path:
+                got = got[p.key]
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(leaf), atol=1e-6,
+                err_msg=f"trial {trial} cfg={cfg.attn_types} "
+                        f"{jax.tree_util.keystr(path)}",
+            )
