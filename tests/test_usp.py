@@ -181,3 +181,45 @@ def test_usp_zigzag_request_warns(rng, devices):
     assert any("zigzag" in str(w.message) for w in rec), (
         [str(w.message) for w in rec]
     )
+
+
+def test_usp_gqa_fused_ce_train_step(rng, devices):
+    """The deepest production compose: GQA (grouped K/V transport) + USP
+    hybrid SP + fused range-split CE in one sharded train step."""
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.parallel.mesh import ambient
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    cfg = DALLEConfig(
+        num_text_tokens=40, text_seq_len=8, num_image_tokens=16,
+        image_fmap_size=4, dim=32, depth=2, heads=4, dim_head=8,
+        kv_heads=2, attn_types=("full",), sp_axis="sp", sp_mode="usp",
+        sp_ulysses=2, loss_chunk=8,
+    )
+    model = DALLE(cfg)
+    text = jnp.ones((2, 8), jnp.int32)
+    codes = jnp.zeros((2, cfg.image_seq_len), jnp.int32)
+    tx = make_optimizer(1e-3)
+    with ambient(mesh):
+        params, opt = init_train_state(
+            model, tx, mesh, {"params": rng}, text, codes
+        )
+    # the dense-loss single-device model's value, BEFORE the step donates
+    # (and thereby deletes) the param buffers
+    import dataclasses
+
+    plain = DALLE(dataclasses.replace(
+        cfg, sp_axis=None, loss_chunk=None
+    ))
+    loss_plain = float(
+        plain.apply({"params": params}, text, codes, return_loss=True)
+    )
+    step = make_dalle_train_step(model, tx, mesh)
+    _, _, loss = step(params, opt, None, text, codes, rng)
+    assert np.isfinite(float(loss))
+    np.testing.assert_allclose(float(loss), loss_plain, atol=1e-5)
