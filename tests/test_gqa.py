@@ -265,3 +265,46 @@ def test_gqa_ulysses_and_usp_model_parity(rng, devices):
         np.testing.assert_allclose(
             float(loss_sp), float(loss_1d), atol=1e-5, err_msg=mode
         )
+
+
+def test_gqa_ring_ppermute_carries_grouped_shapes(rng, devices):
+    """Structural pin of the grouped-transport claim: every ppermute in
+    the traced ring program moves K/V at their GROUPED head count, not
+    the expanded one — the bytes-per-hop saving is in the program, not
+    just the docs."""
+    from jax._src import core as jcore
+
+    from dalle_tpu.parallel import make_mesh
+    from dalle_tpu.parallel.ring import ring_attention_sharded
+
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
+    q = jnp.zeros((2, 4, 32, 8))
+    kg = jnp.zeros((2, 2, 32, 8))  # 2 grouped kv heads
+
+    def subjaxprs(x):
+        if isinstance(x, jcore.Jaxpr):
+            yield x
+        elif isinstance(x, jcore.ClosedJaxpr):
+            yield x.jaxpr
+        elif isinstance(x, (list, tuple)):
+            for i in x:
+                yield from subjaxprs(i)
+
+    def walk(jaxpr, out):
+        for eqn in jaxpr.eqns:
+            if "ppermute" in eqn.primitive.name:
+                out.extend(tuple(v.aval.shape) for v in eqn.invars)
+            for sub in eqn.params.values():
+                for j in subjaxprs(sub):
+                    walk(j, out)
+
+    cj = jax.make_jaxpr(
+        lambda q, k, v: ring_attention_sharded(q, k, v, mesh=mesh)
+    )(q, kg, kg)
+    shapes = []
+    walk(cj.jaxpr, shapes)
+    assert shapes, "no ppermute found in the traced ring program"
+    for shape in shapes:
+        assert shape[1] == 2, (
+            f"ppermute moves head dim {shape[1]} — grouped transport lost"
+        )
