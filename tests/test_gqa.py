@@ -308,3 +308,43 @@ def test_gqa_ring_ppermute_carries_grouped_shapes(rng, devices):
         assert shape[1] == 2, (
             f"ppermute moves head dim {shape[1]} — grouped transport lost"
         )
+
+
+def test_gqa_scan_layers_train_and_decode(rng, devices):
+    """GQA under scan-over-layers: stacked grouped-qkv params train, and
+    the stacked checkpoint unstacks to the decode layout whose grouped
+    cache generates validly."""
+    from dalle_tpu.models.scan_params import unrolled_eval_setup, unstack_scan_params
+    from dalle_tpu.parallel import make_mesh
+    from dalle_tpu.parallel.mesh import ambient
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    cfg = _cfg(kv_heads=2, attn_types=("full",), depth=2, scan_layers=True)
+    model = DALLE(cfg)
+    k = jax.random.PRNGKey(9)
+    text = jax.random.randint(jax.random.fold_in(k, 1), (2, cfg.text_seq_len), 1, 40)
+    codes = jax.random.randint(
+        jax.random.fold_in(k, 2), (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=1)
+    tx = make_optimizer(1e-3)
+    with ambient(mesh):
+        params, opt = init_train_state(
+            model, tx, mesh, {"params": k}, text, codes
+        )
+    step = make_dalle_train_step(model, tx, mesh)
+    params, _, loss = step(params, opt, None, text, codes, k)
+    assert np.isfinite(float(loss))
+
+    eval_cfg, unstack = unrolled_eval_setup(cfg)
+    eval_model = DALLE(eval_cfg)
+    assert eval_cfg.kv_heads == 2
+    out = generate_image_codes(
+        eval_model, unstack(params), text, jax.random.PRNGKey(4)
+    )
+    assert out.shape == (2, cfg.image_seq_len)
+    assert (np.asarray(out) >= 0).all()
