@@ -409,13 +409,11 @@ def main():
     import atexit
 
     atexit.register(_release_busy, busy_file)
-    # default covers the sum of phase budgets (8500s incl. the flash_probe,
-    # train_fused, train_flash_fused, generate_int8 and rainbow rungs)
-    # plus slack; a worst-case preflight (2x300s) or repeated reprobes can
-    # still eat into the tail phases' budgets — the deadline bounds the
-    # WHOLE run on purpose, trading tail evidence for a predictable
-    # driver runtime
-    default_deadline = 9450 + (_TUNE_BUDGET_S if os.environ.get("BENCH_TUNE") else 0)
+    # default covers the sum of phase budgets (8650s across the 11 rungs)
+    # plus the worst-case preflight (2x300s) and reprobe slack — the
+    # deadline bounds the WHOLE run on purpose, trading tail evidence for
+    # a predictable driver runtime
+    default_deadline = 9600 + (_TUNE_BUDGET_S if os.environ.get("BENCH_TUNE") else 0)
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", default_deadline))
     attempts = []
     info = None
