@@ -240,3 +240,27 @@ def test_generate_with_top_p(rng):
         model, params, text, rng, filter_thres=0.0, temperature=1e-8
     )
     np.testing.assert_array_equal(np.asarray(greedy_p), np.asarray(greedy_k))
+
+
+def test_image_only_bitwise_under_kv_int8(rng):
+    """The image-slice head claim must survive the int8 cache: with
+    kv_int8 on, image_only=True and =False still see the identical cache
+    and must sample bitwise-identically."""
+    from dalle_tpu.models.generate import _build_forced, scan_decode
+    from dalle_tpu.models.quantize import kv_int8_model
+
+    model, params, text, _ = build(rng)
+    qmodel = kv_int8_model(model)
+    c = qmodel.cfg
+    forced, mask = _build_forced(qmodel, params, text)
+    kw = dict(
+        num_steps=c.image_seq_len, start=c.text_seq_len,
+        prefill_text=text.astype(jnp.int32), filter_thres=0.9,
+    )
+    sliced = scan_decode(
+        qmodel, params, forced, mask, rng, image_only=True, **kw
+    )
+    full = scan_decode(
+        qmodel, params, forced, mask, rng, image_only=False, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(sliced), np.asarray(full))
