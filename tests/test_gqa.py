@@ -348,3 +348,17 @@ def test_gqa_scan_layers_train_and_decode(rng, devices):
     )
     assert out.shape == (2, cfg.image_seq_len)
     assert (np.asarray(out) >= 0).all()
+
+
+def test_gqa_generate_texts(rng, devices):
+    """Text completion (reference: dalle_pytorch.py:405-451) through the
+    grouped decode cache."""
+    from dalle_tpu.models.generate import generate_texts
+
+    model, params, _, _ = _init(_cfg(kv_heads=2, attn_types=("full",)))
+    out = generate_texts(
+        model, params, jax.random.PRNGKey(8), batch=2
+    )
+    out = np.asarray(out)
+    assert out.shape == (2, model.cfg.text_seq_len)
+    assert (out >= 0).all() and (out < model.cfg.total_text_tokens).all()
