@@ -425,3 +425,32 @@ def test_reverse_roundtrip_random_config_sweep(tmp_path):
                 err_msg=f"trial {trial} cfg={cfg.attn_types} "
                         f"{jax.tree_util.keystr(path)}",
             )
+
+
+def test_gqa_configs_rejected_by_interop(tmp_path):
+    """Grouped-query configs have no reference equivalent: BOTH interop
+    directions must refuse loudly instead of writing/reading a silently
+    misshapen qkv."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.interop import (
+        convert_ref_dalle_state,
+        save_reference_pt,
+    )
+
+    cfg = DALLEConfig(
+        num_text_tokens=40, text_seq_len=6, num_image_tokens=16,
+        image_fmap_size=3, dim=16, depth=1, heads=4, dim_head=4,
+        kv_heads=2,
+    )
+    model = DALLE(cfg)
+    text = jnp.ones((1, 6), jnp.int32)
+    codes = jnp.zeros((1, 9), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), text, codes)["params"]
+    with pytest.raises(AssertionError, match="no reference equivalent"):
+        save_reference_pt(tmp_path / "g.pt", cfg, params)
+    with pytest.raises(AssertionError, match="no reference equivalent"):
+        convert_ref_dalle_state({}, cfg)
