@@ -187,13 +187,19 @@ class DALLEConfig:
 
     def to_dict(self):
         d = dataclasses.asdict(self)
+        # dtype and use_flash are compute policy, not hparams: they pick an
+        # execution path (precision / Pallas-vs-dense kernel), never the
+        # function the params parameterize — checkpoints must not pin them
         d.pop("dtype")
+        d.pop("use_flash")
         d["attn_types"] = list(self.attn_types)
         return d
 
     @classmethod
     def from_dict(cls, d):
         d = dict(d)
+        # pre-r5 checkpoints serialized use_flash; it is compute policy now
+        d.pop("use_flash", None)
         d["attn_types"] = tuple(d.get("attn_types", ("full",)))
         return cls(**d)
 

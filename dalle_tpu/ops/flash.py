@@ -71,8 +71,14 @@ def env_block_default(var: str, fallback: int) -> int:
     raw = os.environ.get(var)
     if not raw:
         return fallback
-    val = int(raw)
-    assert val > 0, f"{var}={raw!r}: block size must be a positive integer"
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var}={raw!r}: block size must be a positive integer"
+        ) from None
+    if val <= 0:
+        raise ValueError(f"{var}={raw!r}: block size must be a positive integer")
     return val
 
 

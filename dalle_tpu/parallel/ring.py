@@ -101,6 +101,14 @@ def _ring_schedule(k, v, init, attend, *, axis_name, causal, stride=1):
     exchanges with its same-rank peer in the neighbor group, and
     ``src``/liveness are group indices."""
     p_size = jax.lax.axis_size(axis_name)
+    if p_size % stride != 0:
+        # hard error, not assert: under python -O a non-dividing stride
+        # would silently truncate the schedule and the rotation would never
+        # return chunks to their owners
+        raise ValueError(
+            f"ring stride {stride} must divide the '{axis_name}' axis "
+            f"size {p_size}"
+        )
     idx = jax.lax.axis_index(axis_name) // stride  # group index
     n_steps = p_size // stride
 

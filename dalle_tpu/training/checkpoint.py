@@ -134,6 +134,26 @@ class AsyncCheckpointWriter:
         self._thread = None
         self._error = None
 
+    def _report_pending_error(self) -> None:
+        # atexit net: a normal exit after an in-loop save joins the thread
+        # (non-daemon) but nothing re-raises a stored failure — without
+        # this, a failed final async write exits 0 silently.  Registered in
+        # save() / unregistered once wait() drains, so the bound-method
+        # strong ref pins the writer ONLY while a write is unawaited (a
+        # weak registry would be collected before atexit handlers run:
+        # non-daemon threads are joined first, dropping the last ref).
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            import sys
+
+            print(
+                "ERROR: async checkpoint write failed and was never "
+                f"awaited: {self._error!r}",
+                file=sys.stderr,
+            )
+
     def save(self, path: str, **kwargs) -> None:
         """Same signature as :func:`save_checkpoint`; returns immediately
         after the host snapshot."""
@@ -160,13 +180,19 @@ class AsyncCheckpointWriter:
         self._thread = threading.Thread(
             target=work, name="ckpt-writer", daemon=False
         )
+        import atexit
+
+        atexit.register(self._report_pending_error)
         self._thread.start()
 
     def wait(self) -> None:
         """Join the in-flight write (if any); re-raise its failure."""
+        import atexit
+
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        atexit.unregister(self._report_pending_error)
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError("async checkpoint write failed") from err

@@ -295,3 +295,20 @@ def test_eval_load_strips_sequence_parallelism(tmp_path, rng):
     assert emodel.cfg.sp_axis is None
     out = generate_image_codes(emodel, eparams, text, jax.random.PRNGKey(1))
     assert out.shape == (1, c.image_seq_len)
+
+
+def test_compute_policy_not_serialized():
+    """dtype AND use_flash are compute policy (execution path, not the
+    function the params parameterize) — to_dict pops both, so a resumed
+    run's --use_flash/--bf16 flags always win over the checkpoint, and a
+    pre-r5 checkpoint that DID serialize use_flash still loads."""
+    import dataclasses
+
+    c = cfg()
+    d = dataclasses.replace(c, use_flash=True).to_dict()
+    assert "use_flash" not in d and "dtype" not in d
+    # legacy checkpoints carried use_flash in hparams: tolerated, dropped
+    legacy = dict(d, use_flash=False)
+    c2 = DALLEConfig.from_dict(legacy)
+    assert c2.use_flash is None  # back at the auto default
+

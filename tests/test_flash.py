@@ -250,3 +250,14 @@ def test_asymmetric_blocks_match_dense(rng):
             np.asarray(got_grad), np.asarray(want_grad), atol=2e-5,
             err_msg=f"grad bq={bq} bk={bk}",
         )
+
+
+def test_flash_block_env_knob_errors_name_the_var(monkeypatch):
+    from dalle_tpu.ops.flash import env_block_default
+
+    monkeypatch.setenv("DALLE_TPU_FLASH_BLOCK_Q", "banana")
+    with pytest.raises(ValueError, match="DALLE_TPU_FLASH_BLOCK_Q"):
+        env_block_default("DALLE_TPU_FLASH_BLOCK_Q", 128)
+    monkeypatch.setenv("DALLE_TPU_FLASH_BLOCK_Q", "-64")
+    with pytest.raises(ValueError, match="DALLE_TPU_FLASH_BLOCK_Q"):
+        env_block_default("DALLE_TPU_FLASH_BLOCK_Q", 128)

@@ -267,5 +267,5 @@ def test_weight_only_block_env_knobs(monkeypatch):
     got = np.asarray(weight_only_matmul(x, q, scale, force_kernel=True))
     np.testing.assert_allclose(got, want, atol=1e-5)
     monkeypatch.setenv("DALLE_TPU_WO_BLOCK_M", "0")
-    with pytest.raises(AssertionError, match="WO_BLOCK_M"):
+    with pytest.raises(ValueError, match="WO_BLOCK_M"):
         weight_only_matmul(x, q, scale, force_kernel=True)

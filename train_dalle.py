@@ -291,14 +291,15 @@ def main(argv=None):
 
     vae, vae_params, vae_cfg = resolve_vae(args, resume_meta, distr.mesh)
 
+    # compute policy (not hparams — to_dict pops both): applied identically
+    # on fresh start and resume, so the flags always win over the checkpoint
+    use_flash = {"auto": None, "on": True, "off": False}[args.use_flash]
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+
     if resume_meta is not None:
         cfg = DALLEConfig.from_dict(resume_meta["hparams"])
-        # dtype is compute policy, not an hparam (to_dict pops it):
-        # re-apply the flag so --bf16 survives a resume
         import dataclasses as _dc
-        cfg = _dc.replace(
-            cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
-        )
+        cfg = _dc.replace(cfg, dtype=dtype, use_flash=use_flash)
     else:
         num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
         cfg = DALLEConfig(
@@ -329,7 +330,7 @@ def main(argv=None):
             pp_microbatches=args.pp_microbatches,
             # --sp_mode alone enables SP too: asking for a scheme means
             # asking for sequence parallelism
-            use_flash={"auto": None, "on": True, "off": False}[args.use_flash],
+            use_flash=use_flash,
             sp_axis="sp" if (args.sp_ring or args.sp_mode) else None,
             sp_mode=args.sp_mode or "ring",
             sp_ulysses=args.sp_ulysses,
@@ -339,7 +340,7 @@ def main(argv=None):
             moe_top_k=args.moe_top_k,
             moe_capacity_factor=args.moe_capacity_factor,
             moe_aux_weight=args.moe_aux_weight,
-            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            dtype=dtype,
         )
     model = DALLE(cfg)
     image_size = vae_cfg.image_size
