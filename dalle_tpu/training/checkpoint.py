@@ -153,6 +153,11 @@ class AsyncCheckpointWriter:
                 f"awaited: {self._error!r}",
                 file=sys.stderr,
             )
+            # re-raise so the interpreter exits nonzero — a scheduler/CI
+            # job gating on exit status must not see a lost checkpoint as
+            # success ('a crashed save is an error, not a silent gap')
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
 
     def save(self, path: str, **kwargs) -> None:
         """Same signature as :func:`save_checkpoint`; returns immediately
@@ -413,7 +418,8 @@ def is_checkpoint(path: str) -> bool:
     return (Path(path) / "meta.json").exists()
 
 
-def load_dalle_for_eval(path: str, *, prefer_ema: bool = True):
+def load_dalle_for_eval(path: str, *, prefer_ema: bool = True,
+                        use_flash=None):
     """Decode-ready (model, params, meta, notes) from a DALLE checkpoint.
 
     One shared implementation of the eval-load dance used by generate.py
@@ -422,7 +428,11 @@ def load_dalle_for_eval(path: str, *, prefer_ema: bool = True):
     unrolled layout decode wants, prefer the EMA subtree when the trainer
     kept one, and restore onto a single device.  ``notes`` is a list of
     human-readable decisions (EMA use, layout flattening) for CLIs to
-    print."""
+    print.
+
+    ``use_flash`` is compute policy (not serialized in checkpoints):
+    None = auto (flash on TPU), True/False force — the eval-side
+    counterpart of the trainers' ``--use_flash`` kernel-isolation knob."""
     import jax
     import jax.numpy as jnp
 
@@ -431,6 +441,10 @@ def load_dalle_for_eval(path: str, *, prefer_ema: bool = True):
     single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     meta = load_meta(path)
     cfg = DALLEConfig.from_dict(meta["hparams"])
+    if use_flash is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, use_flash=use_flash)
     if cfg.sp_axis is not None:
         # sequence parallelism is a TRAIN-time sharding choice with no
         # param footprint; decode re-shards via generate's --mesh_* flags.

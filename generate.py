@@ -78,6 +78,11 @@ def parse_args(argv=None):
     parser.add_argument("--vqgan_model_path", type=str, default=None)
     parser.add_argument("--vqgan_config_path", type=str, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--use_flash", type=str, default="auto",
+                        choices=("auto", "on", "off"),
+                        help="Pallas flash kernel policy at decode (compute "
+                             "policy, never read from the checkpoint): auto "
+                             "= on for TPU; off isolates kernel issues")
     parser.add_argument("--no_ema", action="store_true",
                         help="use raw training params even when the "
                              "checkpoint carries an ema_params subtree")
@@ -149,7 +154,8 @@ def main(argv=None):
     # kept them (--ema_decay) unless --no_ema (shared eval-load dance:
     # training/checkpoint.py:load_dalle_for_eval)
     model, params, meta, notes = load_dalle_for_eval(
-        args.dalle_path, prefer_ema=not args.no_ema
+        args.dalle_path, prefer_ema=not args.no_ema,
+        use_flash={"auto": None, "on": True, "off": False}[args.use_flash],
     )
     for note in notes:
         print(note)

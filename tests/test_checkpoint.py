@@ -312,3 +312,24 @@ def test_compute_policy_not_serialized():
     c2 = DALLEConfig.from_dict(legacy)
     assert c2.use_flash is None  # back at the auto default
 
+
+
+def test_eval_load_use_flash_policy(tmp_path):
+    """--use_flash reaches decode: the checkpoint never pins the kernel
+    choice, the eval loader's argument does."""
+    from dalle_tpu.training.checkpoint import load_dalle_for_eval
+
+    c = cfg()
+    model = DALLE(c)
+    text = jnp.zeros((1, c.text_seq_len), jnp.int32)
+    codes = jnp.zeros((1, c.image_seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), text, codes)["params"]
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, params=params, hparams=c.to_dict())
+
+    m_auto, _, _, _ = load_dalle_for_eval(path)
+    assert m_auto.cfg.use_flash is None
+    m_off, _, _, _ = load_dalle_for_eval(path, use_flash=False)
+    assert m_off.cfg.use_flash is False
+    m_on, _, _, _ = load_dalle_for_eval(path, use_flash=True)
+    assert m_on.cfg.use_flash is True

@@ -1,0 +1,104 @@
+"""Committed external-library golden dumps vs our TPU implementations.
+
+VERDICT round-4 weak #5: the rotary/gMLP differentials previously pinned
+our code against LIVE stand-ins of rotary-embedding-torch / g-mlp-pytorch —
+a shared misunderstanding between the stand-in and the model would pass.
+``tools/gen_lib_goldens.py`` freezes the numbers into committed fixtures
+(``tests/goldens/*.npz``), generated from the REAL packages when importable
+(``provenance == 'real'``) and the stand-ins otherwise: even at stand-in
+provenance the goldens are static — the stand-in drifting later can no
+longer mask a model regression, and regenerating in an env with the real
+libs upgrades the evidence without touching these tests.
+
+Reference construction sites: transformer.py:202-228 (hybrid rotary table),
+transformer.py:174-182 (gMLPBlock), attention.py:32-35 (v rotated too).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.models.transformer import CausalSGU, TransformerConfig
+from dalle_tpu.ops.rotary import apply_rotary, dalle_rotary_angles
+
+GOLD = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+def _load(name):
+    path = os.path.join(GOLD, name)
+    if not os.path.exists(path):
+        pytest.skip(f"{name} not generated (run tools/gen_lib_goldens.py)")
+    return np.load(path, allow_pickle=False)
+
+
+def test_rotary_table_matches_golden():
+    """Our static angle table IS the library's freqs table: angle column j
+    covers the interleaved channel pair (2j, 2j+1)."""
+    g = _load("rotary_golden.npz")
+    angles = dalle_rotary_angles(
+        int(g["text_seq_len"]), int(g["fmap_size"]), int(g["dim_head"])
+    )
+    pos_emb = g["pos_emb"]  # [n, 2R] interleaved
+    assert pos_emb.shape == (angles.shape[0], 2 * angles.shape[1])
+    np.testing.assert_allclose(angles, pos_emb[:, 0::2], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(angles, pos_emb[:, 1::2], rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("which", ["q", "k", "v"])
+def test_rotary_application_matches_golden(which):
+    g = _load("rotary_golden.npz")
+    angles = jnp.asarray(
+        dalle_rotary_angles(
+            int(g["text_seq_len"]), int(g["fmap_size"]), int(g["dim_head"])
+        )
+    )
+    out = apply_rotary(jnp.asarray(g[f"{which}_in"]), angles)
+    np.testing.assert_allclose(
+        np.asarray(out), g[f"{which}_out"], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_gmlp_block_matches_golden():
+    """CausalSGU reproduces the library gMLPBlock bit-for-bit (fp32 tol)
+    under the interop weight mapping (transposed Linears, heads-axis
+    squeeze on the spatial weight/bias — models/interop.py:233-255)."""
+    g = _load("gmlp_golden.npz")
+    dim, seq_len = int(g["dim"]), int(g["seq_len"])
+    fmap = 4
+    cfg = TransformerConfig(
+        dim=dim, heads=1, dim_head=dim, ff_mult=4, causal=True,
+        text_seq_len=seq_len - fmap * fmap, fmap_size=fmap,
+    )
+    assert cfg.seq_len == seq_len
+    params = {
+        "proj_in": {
+            "kernel": g["sd.proj_in.0.weight"].T,
+            "bias": g["sd.proj_in.0.bias"],
+        },
+        "proj_out": {
+            "kernel": g["sd.proj_out.weight"].T,
+            "bias": g["sd.proj_out.bias"],
+        },
+        "sgu_norm": {
+            "scale": g["sd.sgu.norm.weight"],
+            "bias": g["sd.sgu.norm.bias"],
+        },
+        "spatial_w": g["sd.sgu.weight"][0],
+        "spatial_b": g["sd.sgu.bias"][0],
+    }
+    y = CausalSGU(cfg).apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        jnp.asarray(g["x"]),
+    )
+    np.testing.assert_allclose(np.asarray(y), g["y"], rtol=2e-5, atol=2e-5)
+
+
+def test_goldens_record_provenance():
+    """The npz says which library produced it — 'real' once regenerated in
+    an env with rotary-embedding-torch / g-mlp-pytorch installed."""
+    for name in ("rotary_golden.npz", "gmlp_golden.npz"):
+        prov = str(_load(name)["provenance"])
+        assert prov in ("real", "standin"), prov

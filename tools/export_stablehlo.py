@@ -163,6 +163,10 @@ def main():
                          "StableHLO quant/dequant ops; transformer.py "
                          "kv_int8)")
     ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--use_flash", type=str, default="auto",
+                    choices=("auto", "on", "off"),
+                    help="flash-kernel compute policy for the exported "
+                         "graphs (auto = on for TPU)")
     args = ap.parse_args()
     import dalle_tpu
 
@@ -179,7 +183,8 @@ def main():
     from dalle_tpu.training.checkpoint import load_dalle_for_eval
 
     model, params, _, notes = load_dalle_for_eval(
-        args.dalle_path, prefer_ema=not args.no_ema
+        args.dalle_path, prefer_ema=not args.no_ema,
+        use_flash={"auto": None, "on": True, "off": False}[args.use_flash],
     )
     for n in notes:
         print(n, file=sys.stderr)

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Generate exact state-dict key/shape manifests of the released pretrained
+VAE artifacts the reference consumes (reference: dalle_pytorch/vae.py:29-33,
+107-120, 154-170):
+
+  * OpenAI dVAE ``encoder.pkl`` / ``decoder.pkl``  (cdn.openai.com/dall-e) —
+    layouts from the public openai/DALL-E package (encoder.py/decoder.py):
+    group_count=4, n_hid=256, n_blk_per_group=2, vocab=8192, decoder
+    n_init=128, custom Conv2d params named ``w``/``b``.
+  * taming VQGAN f16-1024 ImageNet checkpoint (the reference's default VQGAN,
+    heibox; config: ch=128, ch_mult 1,1,2,2,4, num_res_blocks=2,
+    attn_resolutions [16], z=256, n_embed=1024, embed_dim=256).
+  * taming GumbelVQ f8-8192 checkpoint (ch_mult 1,1,2,4, attn [32],
+    n_embed=8192; GumbelQuantize proj/embed layout).
+
+This derivation is INDEPENDENT of tests/torch_refs.py (no torch import): the
+shapes are computed from the published module definitions, so a drift in
+either the replicas or the converter rules is caught when the two are
+compared (tests/test_artifact_manifests.py).  Shapes are torch-native
+(OIHW conv, [out] bias, [num, dim] embedding) — exactly what
+``torch.load(...).state_dict()`` / ``ckpt["state_dict"]`` yields and what
+``models/convert.py`` consumes.
+
+Run from the repo root to (re)write tests/fixtures/*.json:
+
+    python tools/gen_vae_manifests.py
+"""
+
+import json
+import os
+
+
+# --------------------------- OpenAI dVAE ----------------------------------
+
+def openai_encoder_manifest(n_hid=256, n_blk_per_group=2, input_channels=3,
+                            vocab_size=8192):
+    """openai/DALL-E encoder.py: blocks.input conv7; 4 groups of
+    EncoderBlocks (widths 1,1,2,4,8 x n_hid; hidden = n_out//4; res_path
+    conv_1..conv_3 are 3x3, conv_4 is 1x1; id_path 1x1 only when
+    n_in != n_out); blocks.output.conv 1x1 -> vocab."""
+    m = {}
+    m["blocks.input.w"] = [n_hid, input_channels, 7, 7]
+    m["blocks.input.b"] = [n_hid]
+    widths = [1, 2, 4, 8]
+    prev = 1 * n_hid
+    for g, w in enumerate(widths, start=1):
+        n_out = w * n_hid
+        hid = n_out // 4
+        for b in range(1, n_blk_per_group + 1):
+            n_in = prev if b == 1 else n_out
+            pre = f"blocks.group_{g}.block_{b}"
+            if n_in != n_out:
+                m[f"{pre}.id_path.w"] = [n_out, n_in, 1, 1]
+                m[f"{pre}.id_path.b"] = [n_out]
+            for i, (kw, cout) in enumerate(
+                zip((3, 3, 3, 1), (hid, hid, hid, n_out)), start=1
+            ):
+                cin = n_in if i == 1 else hid
+                m[f"{pre}.res_path.conv_{i}.w"] = [cout, cin, kw, kw]
+                m[f"{pre}.res_path.conv_{i}.b"] = [cout]
+        prev = n_out
+    m["blocks.output.conv.w"] = [vocab_size, prev, 1, 1]
+    m["blocks.output.conv.b"] = [vocab_size]
+    return m
+
+
+def openai_decoder_manifest(n_init=128, n_hid=256, n_blk_per_group=2,
+                            output_channels=3, vocab_size=8192):
+    """openai/DALL-E decoder.py: blocks.input conv1 from vocab one-hots;
+    4 groups of DecoderBlocks (widths 8,4,2,1 x n_hid; res_path conv_1 is
+    1x1, conv_2..conv_4 are 3x3); blocks.output.conv 1x1 ->
+    2*output_channels."""
+    m = {}
+    m["blocks.input.w"] = [n_init, vocab_size, 1, 1]
+    m["blocks.input.b"] = [n_init]
+    widths = [8, 4, 2, 1]
+    prev = n_init
+    for g, w in enumerate(widths, start=1):
+        n_out = w * n_hid
+        hid = n_out // 4
+        for b in range(1, n_blk_per_group + 1):
+            n_in = prev if b == 1 else n_out
+            pre = f"blocks.group_{g}.block_{b}"
+            if n_in != n_out:
+                m[f"{pre}.id_path.w"] = [n_out, n_in, 1, 1]
+                m[f"{pre}.id_path.b"] = [n_out]
+            for i, (kw, cout) in enumerate(
+                zip((1, 3, 3, 3), (hid, hid, hid, n_out)), start=1
+            ):
+                cin = n_in if i == 1 else hid
+                m[f"{pre}.res_path.conv_{i}.w"] = [cout, cin, kw, kw]
+                m[f"{pre}.res_path.conv_{i}.b"] = [cout]
+        prev = n_out
+    m["blocks.output.conv.w"] = [2 * output_channels, prev, 1, 1]
+    m["blocks.output.conv.b"] = [2 * output_channels]
+    return m
+
+
+# ----------------------------- taming VQGAN --------------------------------
+
+def _resnet_block(m, prefix, cin, cout):
+    m[f"{prefix}.norm1.weight"] = [cin]
+    m[f"{prefix}.norm1.bias"] = [cin]
+    m[f"{prefix}.conv1.weight"] = [cout, cin, 3, 3]
+    m[f"{prefix}.conv1.bias"] = [cout]
+    m[f"{prefix}.norm2.weight"] = [cout]
+    m[f"{prefix}.norm2.bias"] = [cout]
+    m[f"{prefix}.conv2.weight"] = [cout, cout, 3, 3]
+    m[f"{prefix}.conv2.bias"] = [cout]
+    if cin != cout:
+        m[f"{prefix}.nin_shortcut.weight"] = [cout, cin, 1, 1]
+        m[f"{prefix}.nin_shortcut.bias"] = [cout]
+
+
+def _attn_block(m, prefix, c):
+    m[f"{prefix}.norm.weight"] = [c]
+    m[f"{prefix}.norm.bias"] = [c]
+    for p in ("q", "k", "v", "proj_out"):
+        m[f"{prefix}.{p}.weight"] = [c, c, 1, 1]
+        m[f"{prefix}.{p}.bias"] = [c]
+
+
+def vqgan_manifest(ch=128, ch_mult=(1, 1, 2, 2, 4), num_res_blocks=2,
+                   attn_resolutions=(16,), resolution=256, in_channels=3,
+                   out_ch=3, z_channels=256, n_embed=1024, embed_dim=256,
+                   gumbel=False):
+    """taming/modules/diffusionmodules/model.py Encoder/Decoder +
+    taming/models/vqgan.py VQModel/GumbelVQ state-dict layout (double_z
+    false, temb_channels 0 so no temb_proj; decoder runs
+    num_res_blocks + 1 blocks per level and indexes ``up`` by level)."""
+    m = {}
+    n_levels = len(ch_mult)
+    # encoder
+    m["encoder.conv_in.weight"] = [ch, in_channels, 3, 3]
+    m["encoder.conv_in.bias"] = [ch]
+    in_mult = (1,) + tuple(ch_mult)
+    res = resolution
+    for i in range(n_levels):
+        cin, cout = ch * in_mult[i], ch * ch_mult[i]
+        for j in range(num_res_blocks):
+            _resnet_block(m, f"encoder.down.{i}.block.{j}", cin, cout)
+            cin = cout
+            if res in attn_resolutions:
+                _attn_block(m, f"encoder.down.{i}.attn.{j}", cout)
+        if i != n_levels - 1:
+            m[f"encoder.down.{i}.downsample.conv.weight"] = [cout, cout, 3, 3]
+            m[f"encoder.down.{i}.downsample.conv.bias"] = [cout]
+            res //= 2
+    blk = ch * ch_mult[-1]
+    _resnet_block(m, "encoder.mid.block_1", blk, blk)
+    _attn_block(m, "encoder.mid.attn_1", blk)
+    _resnet_block(m, "encoder.mid.block_2", blk, blk)
+    m["encoder.norm_out.weight"] = [blk]
+    m["encoder.norm_out.bias"] = [blk]
+    m["encoder.conv_out.weight"] = [z_channels, blk, 3, 3]
+    m["encoder.conv_out.bias"] = [z_channels]
+    # decoder
+    m["decoder.conv_in.weight"] = [blk, z_channels, 3, 3]
+    m["decoder.conv_in.bias"] = [blk]
+    _resnet_block(m, "decoder.mid.block_1", blk, blk)
+    _attn_block(m, "decoder.mid.attn_1", blk)
+    _resnet_block(m, "decoder.mid.block_2", blk, blk)
+    cin = blk
+    res = resolution // 2 ** (n_levels - 1)
+    for i in reversed(range(n_levels)):
+        cout = ch * ch_mult[i]
+        for j in range(num_res_blocks + 1):
+            _resnet_block(m, f"decoder.up.{i}.block.{j}", cin, cout)
+            cin = cout
+            if res in attn_resolutions:
+                _attn_block(m, f"decoder.up.{i}.attn.{j}", cout)
+        if i != 0:
+            m[f"decoder.up.{i}.upsample.conv.weight"] = [cin, cin, 3, 3]
+            m[f"decoder.up.{i}.upsample.conv.bias"] = [cin]
+            res *= 2
+    m["decoder.norm_out.weight"] = [cin]
+    m["decoder.norm_out.bias"] = [cin]
+    m["decoder.conv_out.weight"] = [out_ch, cin, 3, 3]
+    m["decoder.conv_out.bias"] = [out_ch]
+    # quantizer + (post_)quant convs
+    if gumbel:
+        m["quantize.proj.weight"] = [n_embed, embed_dim, 1, 1]
+        m["quantize.proj.bias"] = [n_embed]
+        m["quantize.embed.weight"] = [n_embed, embed_dim]
+    else:
+        m["quantize.embedding.weight"] = [n_embed, embed_dim]
+    m["quant_conv.weight"] = [embed_dim, z_channels, 1, 1]
+    m["quant_conv.bias"] = [embed_dim]
+    m["post_quant_conv.weight"] = [z_channels, embed_dim, 1, 1]
+    m["post_quant_conv.bias"] = [z_channels]
+    return m
+
+
+# representative non-model keys present in the released taming checkpoints
+# (GAN discriminator + LPIPS perceptual net under ``loss.``) — the reference
+# drops them via strict=False; our converter must route them to ``ignore``
+VQGAN_IGNORED_EXAMPLES = [
+    "loss.discriminator.main.0.weight",
+    "loss.discriminator.main.0.bias",
+    "loss.perceptual_loss.net.slice1.0.weight",
+    "loss.perceptual_loss.lin0.model.1.weight",
+    "loss.logvar",
+]
+
+
+MANIFESTS = {
+    "openai_dvae_encoder": (openai_encoder_manifest, {}),
+    "openai_dvae_decoder": (openai_decoder_manifest, {}),
+    "vqgan_f16_1024": (vqgan_manifest, {}),
+    "vqgan_gumbel_f8_8192": (
+        vqgan_manifest,
+        dict(ch_mult=(1, 1, 2, 4), attn_resolutions=(32,), n_embed=8192,
+             gumbel=True),
+    ),
+}
+
+
+def main():
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures")
+    os.makedirs(out_dir, exist_ok=True)
+    for name, (fn, kw) in MANIFESTS.items():
+        manifest = fn(**kw)
+        n_params = 0
+        for shape in manifest.values():
+            n = 1
+            for d in shape:
+                n *= d
+            n_params += n
+        doc = {
+            "artifact": name,
+            "derived_from": "public module definitions (see module docstring)",
+            "n_keys": len(manifest),
+            "n_params": n_params,
+            "keys": manifest,
+        }
+        if name.startswith("vqgan"):
+            doc["ignored_examples"] = VQGAN_IGNORED_EXAMPLES
+        path = os.path.join(out_dir, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=False)
+            f.write("\n")
+        print(f"{path}: {len(manifest)} keys, {n_params:,} params")
+
+
+if __name__ == "__main__":
+    main()
