@@ -1,0 +1,80 @@
+"""Multi-step loss-trajectory parity harness.
+
+VERDICT round-4 weak #6: every multichip dryrun mesh ran ONE optimizer step
+— single-step loss equality can miss collectives that are wrong by a
+factor (e.g. a gradient averaged twice across dp, a psum where a pmean
+belongs): the first loss is computed on identical initial params, so only
+the SECOND step onward sees the corrupted update.  Running the same tiny
+config for several steps on a sharded mesh and on a single device, and
+asserting the whole loss trajectory matches, catches exactly that class.
+
+Determinism contract: same config + same seed ⇒ same data, same init, same
+per-step dropout keys, regardless of mesh — the only difference between
+two runs is sharding, so any trajectory divergence beyond float
+reassociation noise is a collective bug.  (The reference has no analogous
+check; its DP correctness rests on torch.distributed itself.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def loss_trajectory(cfg, mesh, *, steps=6, seed=0, vae=None, vae_params=None,
+                    batch=4, lr=1e-3):
+    """Train ``steps`` steps of ``DALLE(cfg)`` on ``mesh`` with fully
+    deterministic data/init/dropout; returns the list of float losses.
+
+    ``vae``/``vae_params`` may be shared across calls so the sharded and
+    single-device runs consume identical codes."""
+    from dalle_tpu.models.dalle import DALLE
+    from dalle_tpu.training import (
+        init_train_state,
+        make_dalle_train_step,
+        make_optimizer,
+    )
+
+    rng = jax.random.PRNGKey(seed)
+    model = DALLE(cfg)
+    text = jax.random.randint(
+        rng, (batch, cfg.text_seq_len), 0, cfg.num_text_tokens
+    )
+    codes0 = jnp.zeros((batch, cfg.image_seq_len), jnp.int32)
+    if vae is not None:
+        size = vae.cfg.image_size
+        images = jax.random.uniform(rng, (batch, size, size, 3))
+    else:
+        images = jax.random.randint(
+            rng, (batch, cfg.image_seq_len), 0, cfg.num_image_tokens
+        )
+
+    tx = make_optimizer(lr, clip_grad_norm=0.5)
+    params, opt_state = init_train_state(
+        model, tx, mesh, {"params": rng}, text, codes0
+    )
+    step = make_dalle_train_step(model, tx, mesh, vae=vae)
+    losses = []
+    for s in range(steps):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed + 1), s)
+        params, opt_state, loss = step(
+            params, opt_state, vae_params, text, images, key
+        )
+        losses.append(float(loss))
+    return losses
+
+
+def assert_trajectory_parity(sharded, single, *, rtol=2e-3, label=""):
+    """Whole-trajectory comparison: the first step agreeing while a later
+    step diverges is precisely the wrong-by-a-factor collective signature,
+    so every step is checked, not just the last."""
+    assert len(sharded) == len(single)
+    for s, (a, b) in enumerate(zip(sharded, single)):
+        assert a == a and b == b, f"{label} step {s}: NaN loss ({a}, {b})"
+        denom = max(abs(b), 1e-8)
+        rel = abs(a - b) / denom
+        assert rel <= rtol, (
+            f"{label} trajectory diverged at step {s}: sharded {a:.6f} vs "
+            f"single-device {b:.6f} (rel {rel:.2e} > {rtol:.0e}) — "
+            f"full: sharded={sharded} single={single}"
+        )

@@ -1,0 +1,109 @@
+"""Multi-step loss-trajectory parity: sharded meshes vs single device.
+
+VERDICT round-4 weak #6: single-step dryrun loss equality cannot catch a
+collective that corrupts the UPDATE (gradient averaged twice over dp, a
+psum/pmean mixup) — the first loss is computed on identical init params.
+These tests train the same deterministic tiny config for several steps on
+a sharded mesh and on one device and require the whole loss trajectory to
+match (dalle_tpu/training/trajectory.py).
+"""
+
+import dataclasses
+
+import jax
+import pytest
+
+from dalle_tpu.models.dalle import DALLEConfig
+from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+from dalle_tpu.parallel import make_mesh
+from dalle_tpu.training.trajectory import (
+    assert_trajectory_parity,
+    loss_trajectory,
+)
+
+STEPS = 5
+
+VCFG = DiscreteVAEConfig(
+    image_size=16, num_tokens=64, codebook_dim=16, num_layers=2, hidden_dim=8
+)
+
+BASE = DALLEConfig(
+    num_text_tokens=64,
+    text_seq_len=8,
+    num_image_tokens=VCFG.num_tokens,
+    image_fmap_size=VCFG.fmap_size,
+    dim=32,
+    depth=2,
+    heads=2,
+    dim_head=16,
+)
+
+
+@pytest.fixture(scope="module")
+def vae_and_params():
+    vae = DiscreteVAE(VCFG)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (2, 16, 16, 3))
+    vparams = vae.init(
+        {"params": rng, "gumbel": rng}, images, return_loss=True
+    )["params"]
+    return vae, vparams
+
+
+@pytest.fixture(scope="module")
+def single_trajectories(vae_and_params):
+    """Single-device baselines, computed once per config variant."""
+    vae, vparams = vae_and_params
+    mesh1 = make_mesh(dp=1, devices=[jax.devices()[0]])
+    cache = {}
+
+    def get(cfg):
+        # sequence parallelism is a sharding choice with no param footprint
+        # (checkpoint.py:load_dalle_for_eval drops it the same way): the
+        # single-device baseline runs the identical math unsharded
+        key = dataclasses.replace(cfg, sp_axis=None)
+        if key not in cache:
+            cache[key] = loss_trajectory(
+                key, mesh1, steps=STEPS, vae=vae, vae_params=vparams
+            )
+        return cache[key]
+
+    return get
+
+
+MESH_CASES = {
+    # the flagship dp/fsdp/tp data+param sharding (gradient pmean over
+    # dp/fsdp, TP head sharding)
+    "base_dp_fsdp_tp": (
+        lambda: make_mesh(dp=2, fsdp=2, tp=2), BASE,
+    ),
+    # USP hybrid sequence parallelism: ulysses groups of 2 x 2 real ring
+    # groups — all_to_alls + strided K/V rotation every layer (heads=4 so
+    # the tp=2 local head count is divisible by the ulysses degree)
+    "sp_usp": (
+        lambda: make_mesh(dp=1, fsdp=1, tp=2, sp=4),
+        dataclasses.replace(BASE, heads=4, sp_axis="sp", sp_mode="usp",
+                            sp_ulysses=2),
+    ),
+    # GPipe pipeline: 2 stages x 2 microbatches + dp/tp
+    "pp": (
+        lambda: make_mesh(pp=2, dp=2, fsdp=1, tp=2),
+        dataclasses.replace(BASE, pp_stages=2, pp_microbatches=2),
+    ),
+}
+
+
+@pytest.mark.parametrize("name", list(MESH_CASES))
+def test_multi_step_trajectory_matches_single_device(
+    name, vae_and_params, single_trajectories
+):
+    vae, vparams = vae_and_params
+    mesh_fn, cfg = MESH_CASES[name]
+    sharded = loss_trajectory(
+        cfg, mesh_fn(), steps=STEPS, vae=vae, vae_params=vparams
+    )
+    single = single_trajectories(cfg)
+    assert_trajectory_parity(sharded, single, label=name)
+    # the trajectory must actually train (any collective that zeroes or
+    # explodes gradients shows up here even if both runs agree)
+    assert sharded[-1] < sharded[0], f"{name}: loss did not decrease"
