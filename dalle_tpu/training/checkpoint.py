@@ -58,6 +58,7 @@ def save_checkpoint(
     epoch: int = 0,
     step: int = 0,
     scheduler_state: Optional[dict] = None,
+    optimizer_meta: Optional[dict] = None,
     keep_n: Optional[int] = None,
 ) -> str:
     path = Path(path).absolute()
@@ -93,6 +94,11 @@ def save_checkpoint(
             "epoch": epoch,
             "step": step,
             "scheduler_state": scheduler_state,
+            # optimizer-state POLICY (e.g. mu_bf16): the opt_state restore
+            # is dtype-typed, so trainers must rebuild the same optimizer —
+            # recorded here so resume can enforce it instead of silently
+            # casting moments on a flag mismatch
+            "optimizer": optimizer_meta,
             "subtrees": [n for n in _SUBTREES if trees[n] is not None],
         }
         (tmp / "meta.json").write_text(json.dumps(meta, indent=2))

@@ -333,3 +333,42 @@ def test_eval_load_use_flash_policy(tmp_path):
     assert m_off.cfg.use_flash is False
     m_on, _, _, _ = load_dalle_for_eval(path, use_flash=True)
     assert m_on.cfg.use_flash is True
+
+
+def test_mu_bf16_trains_and_restores(tmp_path, rng, devices):
+    """--mu_bf16 stores adam's first moment in bfloat16 (HBM stream lever,
+    tools/mfu_breakdown.py round-5 table); the typed checkpoint restore
+    must preserve the dtype so resume continues with the same policy."""
+    from dalle_tpu.training import make_dalle_train_step
+    from dalle_tpu.training.checkpoint import load_subtree, shape_dtype_of
+
+    c = cfg()
+    model = DALLE(c)
+    text = jnp.zeros((2, c.text_seq_len), jnp.int32)
+    codes = jnp.zeros((2, c.image_seq_len), jnp.int32)
+    mesh = make_mesh(dp=2, fsdp=1, tp=1)
+    tx = make_optimizer(1e-3, mu_bf16=True)
+    params, opt_state = init_train_state(model, tx, mesh, {"params": rng}, text, codes)
+    mus = [
+        leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]
+        if any(getattr(p, "name", "") == "mu" for p in path)
+    ]
+    assert mus and all(m.dtype == jnp.bfloat16 for m in mus)
+
+    step = make_dalle_train_step(model, tx, mesh)
+    params, opt_state, loss = step(params, opt_state, None, text, codes,
+                                   jax.random.PRNGKey(1))
+    assert float(loss) == float(loss)
+
+    p = save_checkpoint(str(tmp_path / "ck"), params=params,
+                        opt_state=opt_state, hparams=c.to_dict())
+    restored = load_subtree(
+        p, "opt_state", shape_dtype_of(jax.eval_shape(lambda: opt_state))
+    )
+    rmus = [
+        leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
+        if any(getattr(p, "name", "") == "mu" for p in path)
+    ]
+    assert rmus and all(m.dtype == jnp.bfloat16 for m in rmus)

@@ -731,3 +731,33 @@ def test_crash_and_auto_resume(tiny_data, tmp_path, capsys):
     final = out / "dalle-final"
     assert is_checkpoint(str(final))
     assert load_meta(str(final))["step"] > killed_step
+
+
+def test_mu_bf16_resume_mismatch_fails_loudly(tmp_path, tiny_data):
+    """A moment-dtype flag mismatch on resume must error, not silently
+    cast the restored adam moments (the opt_state restore is typed)."""
+    import train_vae
+
+    vae_out = str(tmp_path / "vae_ckpt")
+    train_vae.main([
+        "--image_folder", tiny_data, "--image_size", "16",
+        "--batch_size", "4", "--epochs", "1", "--num_tokens", "32",
+        "--num_layers", "2", "--num_resnet_blocks", "0",
+        "--emb_dim", "16", "--hidden_dim", "16",
+        "--output_path", vae_out, "--no_wandb", "--mesh_dp", "4",
+    ])
+
+    import train_dalle
+
+    out = str(tmp_path / "dalle_ckpt")
+    common = [
+        "--image_text_folder", tiny_data,
+        "--vae_path", vae_out + "/vae-final",
+        "--batch_size", "4", "--dim", "32", "--depth", "2",
+        "--heads", "2", "--dim_head", "16", "--text_seq_len", "16",
+        "--truncate_captions", "--no_wandb", "--output_path", out,
+        "--mesh_dp", "2", "--mesh_tp", "2",
+    ]
+    train_dalle.main(common + ["--mu_bf16", "--epochs", "1"])
+    with pytest.raises(SystemExit, match="mu_bf16"):
+        train_dalle.main(common + ["--auto_resume", "--epochs", "2"])

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Per-component cost breakdown of the flagship train step.
+
+VERDICT round-4 next #2: the MFU plateau had been re-measured for ~20 runs
+without a published per-op breakdown to attack.  This tool compiles the
+flagship step AND its components separately and tabulates the XLA cost
+model's flops / bytes-accessed per component (substrate-independent — the
+same table is the TPU roofline conversation), plus optional wall timing:
+
+    python tools/mfu_breakdown.py                   # cost model only
+    python tools/mfu_breakdown.py --time --batch 4  # + wall times
+    BENCH_PLATFORM=cpu python tools/mfu_breakdown.py ...
+
+Components:
+  step            full train step (fwd + bwd + adam)
+  loss_fwd        loss forward only
+  fwd_bwd         value_and_grad (no optimizer)
+  optimizer       adam update alone (precomputed grads)
+  attn_layer      one JointAttention block fwd+bwd at flagship shapes
+  ff_layer        one FF block fwd+bwd
+  head_ce_dense   [b,n,dim] @ W_vocab + masked CE, dense
+  head_ce_fused   same via the range-split chunked loss (ops/fused_ce.py)
+
+The "x12"-scaled attn/ff rows + head + optimizer reconstruct the step
+within a few percent, which validates reading the table as a budget.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (flagship config + platform forcing live there)
+
+
+def _timeit(fn, *args, reps=3):
+    import jax
+
+    out = fn(*args)  # compile + warmup
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--time", action="store_true",
+                    help="also wall-time each component (slow on CPU)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="depth-2 smoke shapes instead of the flagship")
+    ap.add_argument("--json_out", type=str, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    # BENCH_PLATFORM=cpu forces CPU even under the axon site hook (which
+    # re-exports JAX_PLATFORMS=axon) — same dance as bench.run_phase_child
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import jax.numpy as jnp
+    import optax
+
+    from dalle_tpu.models.dalle import DALLE
+    from dalle_tpu.models.transformer import FeedForward, JointAttention
+    from dalle_tpu.training import make_optimizer
+    from dalle_tpu.training.profiler import (
+        dalle_train_flops,
+        xla_cost_analysis,
+    )
+
+    cfg = bench._flagship_cfg(args.smoke)
+    model = DALLE(cfg)
+    b = args.batch
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (b, cfg.text_seq_len), 1, cfg.num_text_tokens)
+    codes = jax.random.randint(rng, (b, cfg.image_seq_len), 0, cfg.num_image_tokens)
+    params = model.init({"params": rng}, text, codes)["params"]
+    tx = make_optimizer(1e-3, clip_grad_norm=0.5)
+    opt_state = tx.init(params)
+
+    def loss_fn(p):
+        return model.apply({"params": p}, text, codes, return_loss=True,
+                           deterministic=False, rngs={"dropout": rng})
+
+    def fwd_bwd(p):
+        return jax.value_and_grad(loss_fn)(p)
+
+    def full_step(p, o):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o2 = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o2, loss
+
+    _, grads0 = jax.jit(fwd_bwd)(params)
+
+    def opt_only(g, o, p):
+        updates, o2 = tx.update(g, o, p)
+        return optax.apply_updates(p, updates), o2
+
+    # --- isolated blocks at flagship shapes --------------------------------
+    n = cfg.text_seq_len + cfg.image_seq_len
+    tcfg = model.transformer_config() if hasattr(model, "transformer_config") else None
+    from dalle_tpu.models.transformer import TransformerConfig
+
+    tc = tcfg or TransformerConfig(
+        dim=cfg.dim, depth=cfg.depth, heads=cfg.heads, dim_head=cfg.dim_head,
+        text_seq_len=cfg.text_seq_len, fmap_size=cfg.image_fmap_size,
+        attn_types=cfg.attn_types, ff_mult=cfg.ff_mult,
+        use_flash=cfg.use_flash, dtype=cfg.dtype,
+    )
+    x = jax.random.normal(rng, (b, n, cfg.dim), cfg.dtype)
+    attn = JointAttention(tc, attn_type="full")
+    ap_ = attn.init({"params": rng}, x)["params"]
+
+    def attn_fb(p, xx):
+        def f(pp):
+            return jnp.sum(attn.apply({"params": pp}, xx) ** 2)
+        return jax.value_and_grad(f)(p)
+
+    ff = FeedForward(tc)
+    fp_ = ff.init({"params": rng}, x)["params"]
+
+    def ff_fb(p, xx):
+        def f(pp):
+            return jnp.sum(ff.apply({"params": pp}, xx) ** 2)
+        return jax.value_and_grad(f)(p)
+
+    # --- head + CE, dense vs fused ----------------------------------------
+    V = cfg.num_text_tokens + cfg.num_image_tokens
+    W = jax.random.normal(rng, (cfg.dim, V), jnp.float32) * 0.02
+    labels = jax.random.randint(rng, (b, n), 0, V)
+
+    def head_dense(w):
+        def f(ww):
+            logits = (x.astype(jnp.float32) @ ww)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, labels[..., None], axis=-1)
+            )
+        return jax.value_and_grad(f)(w)
+
+    rows = {}
+
+    def add(name, fn, *fargs):
+        ca = xla_cost_analysis(jax.jit(fn), *fargs)
+        rows[name] = {
+            "gflops": round(ca.get("flops", 0.0) / 1e9, 2),
+            "gbytes": round(ca.get("bytes accessed", 0.0) / 1e9, 3),
+            "intensity": round(
+                ca.get("flops", 0.0) / max(ca.get("bytes accessed", 1.0), 1.0), 1
+            ),
+        }
+        if args.time:
+            rows[name]["wall_s"] = round(_timeit(jax.jit(fn), *fargs), 3)
+
+    add("step", full_step, params, opt_state)
+    add("loss_fwd", loss_fn, params)
+    add("fwd_bwd", fwd_bwd, params)
+    add("optimizer", opt_only, grads0, opt_state, params)
+    add("attn_layer", attn_fb, ap_, x)
+    add("ff_layer", ff_fb, fp_, x)
+    add("head_ce_dense", head_dense, W)
+
+    analytic = dalle_train_flops(cfg, b)
+    depth = cfg.depth
+    recon = (
+        rows["attn_layer"]["gflops"] * depth
+        + rows["ff_layer"]["gflops"] * depth
+        + rows["head_ce_dense"]["gflops"]
+        + rows["optimizer"]["gflops"]
+    )
+    out = {
+        "config": {"depth": depth, "dim": cfg.dim, "n": n, "vocab": V,
+                   "batch": b, "platform": jax.default_backend()},
+        "analytic_train_gflops": round(analytic / 1e9, 2),
+        "reconstructed_gflops": round(recon, 2),
+        "rows": rows,
+    }
+    print(json.dumps(out, indent=1))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

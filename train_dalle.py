@@ -98,6 +98,8 @@ def parse_args(argv=None):
     parser.add_argument("--ga_steps", type=int, default=1)
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
+    parser.add_argument("--mu_bf16", action="store_true",
+                        help="store adam's first moment in bfloat16 (halves the mu HBM stream; keep the flag consistent across resume — the optimizer state restore is dtype-typed)")
     parser.add_argument("--lr_decay", action="store_true")
     parser.add_argument("--auto_resume", action="store_true",
                         help="resume from the newest checkpoint in "
@@ -386,7 +388,20 @@ def main(argv=None):
 
     # --- model/optimizer/train step ----------------------------------------
     rng = jax.random.PRNGKey(args.seed)
-    tx = make_optimizer(args.learning_rate, clip_grad_norm=args.clip_grad_norm)
+    if resume_meta is not None:
+        # the opt_state restore is dtype-typed: a moment-dtype flag
+        # mismatch would silently cast the restored moments — enforce
+        # consistency instead (old checkpoints recorded no policy = f32)
+        saved_mu = (resume_meta.get("optimizer") or {}).get("mu_bf16", False)
+        if saved_mu != args.mu_bf16:
+            raise SystemExit(
+                f"--mu_bf16={args.mu_bf16} but the checkpoint was trained "
+                f"with mu_bf16={saved_mu}: pass the matching flag (the "
+                "typed optimizer-state restore would otherwise silently "
+                "cast the adam moments)"
+            )
+    tx = make_optimizer(args.learning_rate, clip_grad_norm=args.clip_grad_norm,
+                        mu_bf16=args.mu_bf16)
     if args.ga_steps > 1:  # (reference: --ga_steps, train_dalle.py:103,464)
         tx = optax.MultiSteps(tx, every_k_schedule=args.ga_steps)
     text0 = jnp.zeros((args.batch_size // world, cfg.text_seq_len), jnp.int32)
@@ -502,6 +517,7 @@ def main(argv=None):
             epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict() if sched else None,
+            optimizer_meta={"mu_bf16": args.mu_bf16},
             keep_n=args.keep_n_checkpoints,
         )
         path = str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}")
