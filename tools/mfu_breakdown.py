@@ -145,6 +145,24 @@ def main():
             )
         return jax.value_and_grad(f)(w)
 
+    def head_fused(w):
+        # as the model runs it (dalle.py loss_chunk path): text rows only
+        # multiply W[:, :Vt], image rows W[:, Vt:], seq-chunk scanned
+        from dalle_tpu.ops.fused_ce import range_ce
+
+        t = cfg.text_seq_len
+        vt = cfg.num_text_tokens
+        lt = jnp.clip(labels[:, :t], 0, vt - 1)
+        li = jnp.clip(labels[:, t:], 0, cfg.num_image_tokens - 1)
+
+        def f(ww):
+            nt = range_ce(x[:, :t], ww[:, :vt], None, lt, chunk=256,
+                          compute_dtype=cfg.dtype)
+            ni = range_ce(x[:, t:], ww[:, vt:], None, li, chunk=256,
+                          compute_dtype=cfg.dtype)
+            return jnp.mean(nt) + jnp.mean(ni)
+        return jax.value_and_grad(f)(w)
+
     rows = {}
 
     def add(name, fn, *fargs):
@@ -166,6 +184,7 @@ def main():
     add("attn_layer", attn_fb, ap_, x)
     add("ff_layer", ff_fb, fp_, x)
     add("head_ce_dense", head_dense, W)
+    add("head_ce_fused", head_fused, W)
 
     analytic = dalle_train_flops(cfg, b)
     depth = cfg.depth
