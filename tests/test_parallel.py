@@ -3,6 +3,8 @@ collectives (SURVEY.md §4's upgrade over the reference's DummyBackend mock)."""
 
 import argparse
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -206,3 +208,46 @@ def test_dalle_train_step_with_sequence_parallelism(rng, devices):
     step1 = make_dalle_train_step(model_plain, tx, mesh1)
     _, _, loss1 = step1(params1, opt1, None, text, codes, key)
     np.testing.assert_allclose(float(loss_sp), float(loss1), rtol=1e-5)
+
+
+class TestFusedClipAdam:
+    """make_optimizer fuses global-norm clipping into the inner update
+    (train_lib._fused_clip_into): must be semantically identical to
+    optax.chain(clip_by_global_norm, adam) AND keep its exact opt_state
+    tree structure (old checkpoints restore unchanged)."""
+
+    def _tree(self, seed, scale):
+        k = jax.random.PRNGKey(seed)
+        return {
+            "a": jax.random.normal(k, (16, 8)) * scale,
+            "b": {"w": jax.random.normal(jax.random.fold_in(k, 1), (8,)) * scale},
+        }
+
+    @pytest.mark.parametrize("gscale", [1e-3, 10.0], ids=["below", "above"])
+    def test_matches_explicit_chain(self, gscale):
+        import optax
+
+        params = self._tree(0, 0.1)
+        grads = self._tree(1, gscale)  # below / above the 0.5 clip norm
+        fused = make_optimizer(1e-3)
+        chain = optax.chain(
+            optax.clip_by_global_norm(0.5),
+            optax.inject_hyperparams(optax.adam)(learning_rate=1e-3),
+        )
+        sf, sc = fused.init(params), chain.init(params)
+        assert jax.tree_util.tree_structure(sf) == jax.tree_util.tree_structure(sc)
+        for _ in range(3):
+            uf, sf = fused.update(grads, sf, params)
+            uc, sc = chain.update(grads, sc, params)
+            for lf, lc in zip(jax.tree_util.tree_leaves(uf),
+                              jax.tree_util.tree_leaves(uc)):
+                np.testing.assert_allclose(
+                    np.asarray(lf), np.asarray(lc), rtol=1e-6, atol=1e-7
+                )
+
+    def test_lr_injection_still_reaches_state(self):
+        params = self._tree(0, 0.1)
+        tx = make_optimizer(1e-3)
+        state = tx.init(params)
+        state = set_learning_rate(state, 7e-4)
+        assert abs(get_learning_rate(state) - 7e-4) < 1e-9
