@@ -50,7 +50,20 @@ def masked_attention(q, k, v, mask, key_pad_mask=None):
     return _sdpa(q, k, v, m)
 
 
-def full_causal_attention(q, k, v, key_pad_mask=None, *, block_chunks=4):
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _default_block_chunks() -> int:
+    """``DALLE_TPU_BLOCK_CAUSAL_CHUNKS`` overrides the built-in 4 (1
+    disables the block-causal path); validated by the shared env helper
+    (ops/flash.py) so a typo'd export names the variable."""
+    from dalle_tpu.ops.flash import env_block_default
+
+    return env_block_default("DALLE_TPU_BLOCK_CAUSAL_CHUNKS", 4)
+
+
+def full_causal_attention(q, k, v, key_pad_mask=None, *, block_chunks=None):
     """Standard causal self-attention (reference: attention.py:39-86).
 
     Dense-causal wastes almost half its MXU work on positions the mask
@@ -63,6 +76,8 @@ def full_causal_attention(q, k, v, key_pad_mask=None, *, block_chunks=4):
     causal span equals softmax over the -inf-masked full row.
     """
     n = q.shape[-2]
+    if block_chunks is None:
+        block_chunks = _default_block_chunks()
     if block_chunks > 1 and n >= 256 and n % block_chunks == 0:
         return _block_causal_attention(q, k, v, key_pad_mask, block_chunks)
     i = jnp.arange(n)

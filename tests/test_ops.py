@@ -241,3 +241,26 @@ class TestBlockCausal:
             np.asarray(self._oracle(q2, k2, v2)),
             atol=1e-5,
         )
+
+
+def test_block_causal_chunks_env_knob(rng, monkeypatch):
+    """DALLE_TPU_BLOCK_CAUSAL_CHUNKS tunes (or disables) the block-causal
+    path; typos name the variable (shared env helper)."""
+    q, k, v = [
+        jax.random.normal(jax.random.fold_in(rng, i), (1, 2, 256, 8))
+        for i in range(3)
+    ]
+    base = np.asarray(A.full_causal_attention(q, k, v, block_chunks=1))
+    monkeypatch.setenv("DALLE_TPU_BLOCK_CAUSAL_CHUNKS", "8")
+    A._default_block_chunks.cache_clear()
+    try:
+        np.testing.assert_allclose(
+            np.asarray(A.full_causal_attention(q, k, v)), base, atol=1e-5
+        )
+        monkeypatch.setenv("DALLE_TPU_BLOCK_CAUSAL_CHUNKS", "zero")
+        A._default_block_chunks.cache_clear()
+        with pytest.raises(ValueError, match="DALLE_TPU_BLOCK_CAUSAL_CHUNKS"):
+            A.full_causal_attention(q, k, v)
+    finally:
+        monkeypatch.delenv("DALLE_TPU_BLOCK_CAUSAL_CHUNKS")
+        A._default_block_chunks.cache_clear()

@@ -47,6 +47,9 @@ def parse_args(argv=None):
     parser.add_argument("--epochs", type=int, default=20)
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
+    parser.add_argument("--mu_bf16", action="store_true",
+                        help="adam first moment in bfloat16 (HBM stream "
+                             "lever; keep consistent across resume)")
     parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
                         action="store_true",
                         help="bf16 compute for both encoders (2x MXU rate "
@@ -179,7 +182,8 @@ def main(argv=None):
     img0 = np.zeros(
         (args.batch_size // world, args.image_size, args.image_size, 3), np.float32
     )
-    tx = make_optimizer(args.learning_rate, clip_grad_norm=args.clip_grad_norm)
+    tx = make_optimizer(args.learning_rate, clip_grad_norm=args.clip_grad_norm,
+                        mu_bf16=args.mu_bf16)
     params, opt_state = init_train_state(
         clip, tx, distr.mesh, {"params": rng}, text0, img0
     )

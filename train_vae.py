@@ -69,6 +69,9 @@ def parse_args(argv=None):
                              "training/checkpoint.py AsyncCheckpointWriter)")
     parser.add_argument("--wandb_name", type=str, default="dalle_tpu_train_vae")
     parser.add_argument("--no_wandb", action="store_true")
+    parser.add_argument("--mu_bf16", action="store_true",
+                        help="adam first moment in bfloat16 (HBM stream "
+                             "lever; keep consistent across resume)")
     parser.add_argument("--config_json", type=str, default=None,
                         help="JSON file of {flag: value} overriding the "
                              "command line (file wins, warns per override)")
@@ -155,7 +158,8 @@ def main(argv=None):
 
     rng = jax.random.PRNGKey(args.seed)
     sample = jnp.zeros((args.batch_size, args.image_size, args.image_size, 3))
-    tx = make_optimizer(args.learning_rate, clip_grad_norm=None)
+    tx = make_optimizer(args.learning_rate, clip_grad_norm=None,
+                        mu_bf16=args.mu_bf16)
     params, opt_state = init_train_state(
         vae, tx, distr.mesh, {"params": rng, "gumbel": rng}, sample, return_loss=True
     )
