@@ -175,6 +175,44 @@ def main():
         np.asarray(jax.device_get(usp_out)), np.asarray(want_ring), atol=1e-5
     )
 
+    # multi-step loss-trajectory parity ACROSS processes: 5 optimizer
+    # steps on the dp2 x tp2 mesh spanning both processes must track a
+    # single-LOCAL-device run of the identical config/data/init/keys —
+    # the cross-process edition of tests/test_trajectory_parity.py (a
+    # collective that corrupts the update, e.g. a double-averaged dp
+    # gradient, agrees on step 1 and diverges from step 2)
+    from dalle_tpu.training.trajectory import assert_trajectory_parity
+
+    # materialize the assembled GLOBAL batch on every host so the local
+    # baseline consumes byte-identical data in the same dp row order
+    text_full = np.asarray(multihost_utils.process_allgather(text_g, tiled=True))
+    codes_full = np.asarray(multihost_utils.process_allgather(codes_g, tiled=True))
+    assert text_full.shape == (gb, cfg.text_seq_len), text_full.shape
+
+    def trajectory(mesh_t, text_in, codes_in):
+        p_t, o_t = init_train_state(
+            model, tx, mesh_t, {"params": rng}, text_in, codes_in
+        )
+        step_t = make_dalle_train_step(model, tx, mesh_t)
+        losses = []
+        for s in range(5):
+            key = jax.random.fold_in(jax.random.PRNGKey(1), s)
+            p_t, o_t, l = step_t(p_t, o_t, None, text_in, codes_in, key)
+            losses.append(float(l))
+        return losses
+
+    shard_losses = trajectory(mesh_c, text_g, codes_g)
+    mesh_local = make_mesh(dp=1, devices=[jax.local_devices()[0]])
+    base_losses = trajectory(mesh_local, text_full, codes_full)
+    assert_trajectory_parity(
+        shard_losses, base_losses, rtol=2e-3, label="mp-trajectory"
+    )
+    # every process must have seen the same trajectory (psum-reduced loss)
+    all_last = np.asarray(
+        multihost_utils.process_allgather(np.float32(shard_losses[-1]))
+    ).reshape(-1)
+    np.testing.assert_allclose(all_last, shard_losses[-1], rtol=1e-6)
+
     backend.local_barrier()
     print(f"MP_WORKER_OK rank={proc_id}")
 
