@@ -567,8 +567,10 @@ def main():
         # fallback headline gets no gap note against a target it never had
         if result["mfu"] < 0.45 and not result.get("tiny"):
             result["mfu_gap_note"] = (
-                "below 0.45 target — see training/profiler.py trace window for "
-                "per-op breakdown; rerun bench to extend mfu_history trend"
+                "below 0.45 target — per-component budget: "
+                "tools/mfu_breakdown.py + docs/PERF.md (flagship step is "
+                "~10x HBM-bound on v5e at intensity 25.6 fl/B; the target "
+                "is TPU-defined, CPU MFU tracks flops not bytes)"
             )
     _emit(result, 0)
 
