@@ -482,3 +482,51 @@ def test_layerscale_init_thresholds_match_reference():
         for j in (0, 1):  # attn and ff branches share the layer's init
             got = float(sd[f"layers.layers.{i}.{j}.scale"].reshape(-1)[0])
             assert got == pytest.approx(_layer_scale_init(i), rel=1e-6), (i, j, got)
+
+
+def test_dalle_long_seq_block_causal_matches_reference(rng):
+    """Differential at n=288 (text 32 + image 16x16): the first golden
+    case long enough for the block-causal dense-attention fast path
+    (ops/attention.py, n >= 256) to engage INSIDE the full model — logits
+    must still match the actual reference at 2e-4."""
+    import jax.numpy as jnp
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    RefDALLE, RefVAE = _install_reference()
+    torch.manual_seed(0)
+    rvae = RefVAE(
+        image_size=32, num_layers=1, num_tokens=32, codebook_dim=16,
+        hidden_dim=8,
+    )
+    ref = RefDALLE(
+        dim=32, vae=rvae, num_text_tokens=50, text_seq_len=32, depth=1,
+        heads=2, dim_head=16, attn_types=("full",), loss_img_weight=7,
+        shift_tokens=False, rotary_emb=False,
+    ).eval()
+
+    cfg = DALLEConfig(
+        num_text_tokens=50, text_seq_len=32, num_image_tokens=32,
+        image_fmap_size=16, dim=32, depth=1, heads=2, dim_head=16,
+        attn_types=("full",), loss_img_weight=7.0,
+    )
+    assert cfg.text_seq_len + cfg.image_seq_len >= 256  # block path live
+    model = DALLE(cfg)
+    params = _ref_to_ours(ref, cfg)
+
+    rs = np.random.RandomState(0)
+    text = rs.randint(0, 50, (2, 32))
+    text[:, 20:] = 0
+    codes = rs.randint(0, 32, (2, cfg.image_seq_len))
+
+    with torch.no_grad():
+        ref_logits = ref(
+            torch.from_numpy(text).long(), torch.from_numpy(codes).long()
+        ).numpy()
+    our_logits = np.asarray(
+        model.apply({"params": params}, jnp.asarray(text), jnp.asarray(codes))
+    )
+    allowed = our_logits > -1e29
+    np.testing.assert_allclose(
+        our_logits[allowed], ref_logits[allowed], atol=2e-4, rtol=1e-4
+    )
