@@ -55,12 +55,24 @@ import functools
 
 @functools.lru_cache(maxsize=None)
 def _default_block_chunks() -> int:
-    """``DALLE_TPU_BLOCK_CAUSAL_CHUNKS`` overrides the built-in 4 (1
-    disables the block-causal path); validated by the shared env helper
-    (ops/flash.py) so a typo'd export names the variable."""
+    """``DALLE_TPU_BLOCK_CAUSAL_CHUNKS`` overrides the platform default
+    (1 disables the block-causal path); validated by the shared env
+    helper (ops/flash.py) so a typo'd export names the variable.
+
+    Platform default: 4 on accelerators (the skipped upper-triangle work
+    is MXU flops), 1 on CPU — measured at full flagship scale, XLA:CPU
+    fuses the single [n, n] einsum better than the 4-way split (round-5
+    notes: 156.9 vs 163.8 s/step), and the byte savings the split offers
+    don't matter on a flop-bound substrate."""
+    import os
+
     from dalle_tpu.ops.flash import env_block_default
 
-    return env_block_default("DALLE_TPU_BLOCK_CAUSAL_CHUNKS", 4)
+    if os.environ.get("DALLE_TPU_BLOCK_CAUSAL_CHUNKS"):
+        return env_block_default("DALLE_TPU_BLOCK_CAUSAL_CHUNKS", 4)
+    import jax
+
+    return 1 if jax.default_backend() == "cpu" else 4
 
 
 def full_causal_attention(q, k, v, key_pad_mask=None, *, block_chunks=None):

@@ -484,12 +484,21 @@ def test_layerscale_init_thresholds_match_reference():
             assert got == pytest.approx(_layer_scale_init(i), rel=1e-6), (i, j, got)
 
 
-def test_dalle_long_seq_block_causal_matches_reference(rng):
+def test_dalle_long_seq_block_causal_matches_reference(rng, monkeypatch, request):
     """Differential at n=288 (text 32 + image 16x16): the first golden
     case long enough for the block-causal dense-attention fast path
     (ops/attention.py, n >= 256) to engage INSIDE the full model — logits
-    must still match the actual reference at 2e-4."""
+    must still match the actual reference at 2e-4.  The split is forced
+    via the env knob (the platform default is 1 on CPU)."""
     import jax.numpy as jnp
+
+    from dalle_tpu.ops import attention as A_ops
+
+    monkeypatch.setenv("DALLE_TPU_BLOCK_CAUSAL_CHUNKS", "4")
+    A_ops._default_block_chunks.cache_clear()
+    # monkeypatch reverts the env at teardown; the memoized default must
+    # be re-derived then too
+    request.addfinalizer(A_ops._default_block_chunks.cache_clear)
 
     from dalle_tpu.models.dalle import DALLE, DALLEConfig
 

@@ -193,7 +193,7 @@ class TestBlockCausal:
             jax.random.normal(jax.random.fold_in(rng, i), (2, 2, 512, 16), dtype)
             for i in range(3)
         ]
-        got = A.full_causal_attention(q, k, v)
+        got = A.full_causal_attention(q, k, v, block_chunks=4)
         want = self._oracle(q, k, v)
         atol = 1e-5 if dtype == jnp.float32 else 2e-2
         np.testing.assert_allclose(
@@ -210,7 +210,7 @@ class TestBlockCausal:
         def f(path):
             def loss(qq):
                 out = (
-                    A.full_causal_attention(qq, k, v, kpm)
+                    A.full_causal_attention(qq, k, v, kpm, block_chunks=4)
                     if path == "block"
                     else self._oracle(qq, k, v, kpm)
                 )
@@ -228,7 +228,7 @@ class TestBlockCausal:
             for i in range(3)
         ]
         np.testing.assert_allclose(
-            np.asarray(A.full_causal_attention(q, k, v)),
+            np.asarray(A.full_causal_attention(q, k, v, block_chunks=4)),
             np.asarray(self._oracle(q, k, v)),
             atol=1e-6,
         )
@@ -237,7 +237,7 @@ class TestBlockCausal:
             for i in range(3)
         ]
         np.testing.assert_allclose(
-            np.asarray(A.full_causal_attention(q2, k2, v2)),
+            np.asarray(A.full_causal_attention(q2, k2, v2, block_chunks=4)),
             np.asarray(self._oracle(q2, k2, v2)),
             atol=1e-5,
         )
