@@ -38,7 +38,13 @@ class CLIPConfig:
     visual_patch_size: int = 32
     channels: int = 3
     scan_layers: bool = False  # lax.scan over stacked encoder layers
+    use_remat: bool = False  # jax.checkpoint each encoder block
+    remat_policy: str = "full"  # transformer.py REMAT_POLICIES names
+    fused_ff: bool = False  # fused GEGLU FF (ops/fused_ff.py); compute policy
     dtype: Any = jnp.float32
+    # residual-stream wire dtype (training/precision.py "bf16_stream");
+    # compute policy like dtype
+    stream_dtype: Any = None
 
     @property
     def num_patches(self) -> int:
@@ -46,15 +52,21 @@ class CLIPConfig:
 
     def to_dict(self):
         d = dataclasses.asdict(self)
+        # compute policy, not hparams (same contract as DALLEConfig)
         d.pop("dtype")
+        d.pop("stream_dtype")
+        d.pop("fused_ff")
         return d
 
     @classmethod
     def from_dict(cls, d):
-        return cls(**dict(d))
+        d = dict(d)
+        d.pop("fused_ff", None)
+        d.pop("stream_dtype", None)
+        return cls(**d)
 
 
-def _enc_config(dim, depth, heads, seq_len, dtype, scan=False) -> TransformerConfig:
+def _enc_config(c: "CLIPConfig", dim, depth, heads, seq_len) -> TransformerConfig:
     return TransformerConfig(
         dim=dim,
         depth=depth,
@@ -64,8 +76,12 @@ def _enc_config(dim, depth, heads, seq_len, dtype, scan=False) -> TransformerCon
         fmap_size=0,
         attn_types=("full",),
         causal=False,
-        scan_layers=scan,
-        dtype=dtype,
+        scan_layers=c.scan_layers,
+        use_remat=c.use_remat,
+        remat_policy=c.remat_policy,
+        fused_ff=c.fused_ff,
+        dtype=c.dtype,
+        stream_dtype=c.stream_dtype,
     )
 
 
@@ -78,16 +94,16 @@ class CLIP(nn.Module):
         self.text_emb = nn.Embed(c.num_text_tokens, c.dim_text, embedding_init=init)
         self.text_pos_emb = nn.Embed(c.text_seq_len, c.dim_text, embedding_init=init)
         self.text_transformer = Transformer(
-            _enc_config(c.dim_text, c.text_enc_depth, c.text_heads,
-                        c.text_seq_len, c.dtype, scan=c.scan_layers)
+            _enc_config(c, c.dim_text, c.text_enc_depth, c.text_heads,
+                        c.text_seq_len)
         )
         self.to_text_latent = nn.Dense(c.dim_latent, use_bias=False, dtype=c.dtype)
 
         self.patch_emb = nn.Dense(c.dim_image, dtype=c.dtype)
         self.image_pos_emb = nn.Embed(c.num_patches, c.dim_image, embedding_init=init)
         self.visual_transformer = Transformer(
-            _enc_config(c.dim_image, c.visual_enc_depth, c.visual_heads,
-                        c.num_patches, c.dtype, scan=c.scan_layers)
+            _enc_config(c, c.dim_image, c.visual_enc_depth, c.visual_heads,
+                        c.num_patches)
         )
         self.to_visual_latent = nn.Dense(c.dim_latent, use_bias=False, dtype=c.dtype)
 

@@ -92,7 +92,9 @@ class DALLEConfig:
     rotary_v: bool = True  # reference rotates v too (attention.py:32-35)
     reversible: bool = False
     use_remat: bool = False
-    remat_policy: str = "full"  # "full" | "dots" | "dots_no_batch"
+    # transformer.py REMAT_POLICIES: "full" | "nothing" | "dots" |
+    # "dots_saveable" | "dots_no_batch" | "attn_only" | "ff_only"
+    remat_policy: str = "full"
     scan_layers: bool = False  # lax.scan over stacked layers (O(1) compile)
     kernel_size: int = 5
     dilation: int = 1
@@ -119,7 +121,12 @@ class DALLEConfig:
     # decode-only int8 KV cache (transformer.py kv_int8): no extra params,
     # orthogonal to quant_int8
     kv_int8: bool = False
+    # fused GEGLU FF (ops/fused_ff.py) — compute policy like use_flash
+    fused_ff: bool = False
     dtype: Any = jnp.float32
+    # residual-stream wire dtype (training/precision.py "bf16_stream");
+    # compute policy like dtype — never an hparam
+    stream_dtype: Any = None
 
     # --- derived (reference: dalle_pytorch.py:336-342) ---------------------
     @property
@@ -182,16 +189,21 @@ class DALLEConfig:
             quant_int8=self.quant_int8,
             quant_mode=self.quant_mode,
             kv_int8=self.kv_int8,
+            fused_ff=self.fused_ff,
             dtype=self.dtype,
+            stream_dtype=self.stream_dtype,
         )
 
     def to_dict(self):
         d = dataclasses.asdict(self)
-        # dtype and use_flash are compute policy, not hparams: they pick an
-        # execution path (precision / Pallas-vs-dense kernel), never the
-        # function the params parameterize — checkpoints must not pin them
+        # dtype, stream_dtype, use_flash and fused_ff are compute policy,
+        # not hparams: they pick an execution path (precision /
+        # Pallas-vs-dense kernel), never the function the params
+        # parameterize — checkpoints must not pin them
         d.pop("dtype")
+        d.pop("stream_dtype")
         d.pop("use_flash")
+        d.pop("fused_ff")
         d["attn_types"] = list(self.attn_types)
         return d
 
@@ -200,6 +212,8 @@ class DALLEConfig:
         d = dict(d)
         # pre-r5 checkpoints serialized use_flash; it is compute policy now
         d.pop("use_flash", None)
+        d.pop("fused_ff", None)
+        d.pop("stream_dtype", None)
         d["attn_types"] = tuple(d.get("attn_types", ("full",)))
         return cls(**d)
 

@@ -73,8 +73,13 @@ class TransformerConfig:
     use_remat: bool = False  # jax.checkpoint each block (memory lever)
     # what the checkpointed blocks may KEEP instead of recomputing:
     #   "full"          — save nothing (max memory savings, 2x flops in bwd)
+    #   "nothing"       — explicit nothing_saveable (alias of "full")
     #   "dots"          — save matmul outputs, recompute elementwise only
+    #   "dots_saveable" — explicit dots_saveable (alias of "dots")
     #   "dots_no_batch" — save only batch-free matmuls (the usual TP choice)
+    #   "attn_only"     — per-layer-type: remat attention sublayers only
+    #   "ff_only"       — per-layer-type: remat feed-forward sublayers only
+    # (see REMAT_POLICIES; trainers expose this as --remat_policy)
     remat_policy: str = "full"
     rotary: bool = False
     # rotate v with the same table, as the reference does
@@ -144,7 +149,20 @@ class TransformerConfig:
     # checkpoint works.  Beyond-reference (its decode has no cache at all,
     # reference: dalle_pytorch.py:483-498).
     kv_int8: bool = False
+    # fused GEGLU feed-forward (ops/fused_ff.py): the two [n, 4d]-class FF
+    # pre-activations never round-trip HBM (Pallas kernel on TPU, chunked
+    # XLA elsewhere).  Compute policy like use_flash — never an hparam.
+    # Requires ff_dropout inactive; the unfused path serves dropout.
+    fused_ff: bool = False
     dtype: Any = jnp.float32
+    # residual-stream wire dtype (training/precision.py "bf16_stream"):
+    # the [b, n, d] stream itself is cast to this at stack entry, so the
+    # per-layer residual adds and inter-layer traffic run at this width.
+    # None keeps the stream at the input dtype (f32 embeddings) even when
+    # dtype=bf16 casts the matmul operands — the pre-existing --bf16
+    # behavior.  Softmax and CE still accumulate in f32 either way
+    # (ops/attention.py preferred_element_type, ops/fused_ce.py).
+    stream_dtype: Any = None
 
     @property
     def num_kv_heads(self) -> int:
@@ -231,27 +249,65 @@ def _warn_constraint_skipped_once(shape, wanted, used, sp_dropped):
     )
 
 
-def _remat_policy(c: "TransformerConfig"):
-    """Map config remat_policy name to a jax.checkpoint policy (or None)."""
+# the registry doubles as the --remat_policy CLI choices in the trainers.
+# "full"/"nothing" and "dots"/"dots_saveable" are alias pairs (nn.remat's
+# default policy IS save-nothing; jax.checkpoint_policies.dots_saveable is
+# checkpoint_dots) kept so both the historical and the jax-official names
+# work.  "attn_only"/"ff_only" are per-layer-TYPE selectivity: only that
+# sublayer kind is checkpointed (save-nothing), the other keeps its
+# activations — attention is the recompute-cheap/byte-heavy half, so
+# "attn_only" buys most of the memory for half the recompute flops.
+REMAT_POLICIES = (
+    "full", "nothing", "dots", "dots_saveable", "dots_no_batch",
+    "attn_only", "ff_only",
+)
+
+
+def resolve_remat_policy(name: str):
+    """Map a remat policy name to a jax.checkpoint policy (or None =
+    save nothing).  Shared with the conv models (models/vae.py)."""
     policies = {
         "full": None,
+        "nothing": jax.checkpoint_policies.nothing_saveable,
         "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
         "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # per-layer-type names carry no jax policy of their own: the
+        # selected sublayer kind gets a plain (save-nothing) remat
+        "attn_only": None,
+        "ff_only": None,
     }
-    assert c.remat_policy in policies, (
-        f"unknown remat_policy {c.remat_policy!r}; options: {sorted(policies)}"
+    assert name in policies, (
+        f"unknown remat_policy {name!r}; options: {sorted(policies)}"
     )
-    return policies[c.remat_policy]
+    return policies[name]
 
 
-def _layer_cls(c: "TransformerConfig"):
+def _remat_policy(c: "TransformerConfig"):
+    return resolve_remat_policy(c.remat_policy)
+
+
+def _remat_applies(c: "TransformerConfig", kind: str) -> bool:
+    """Does remat wrap a sublayer of this kind ("attn" | "ff")?"""
+    if not c.use_remat:
+        return False
+    if c.remat_policy == "attn_only":
+        return kind == "attn"
+    if c.remat_policy == "ff_only":
+        return kind == "ff"
+    return True
+
+
+def _layer_cls(c: "TransformerConfig", kind: str = "attn", prevent_cse: bool = True):
     """SubLayer, optionally wrapped in nn.remat with the configured
     rematerialization policy (SURVEY.md §7 stage 7: remat is the idiomatic
-    memory lever next to true reversibility)."""
-    if not c.use_remat:
+    memory lever next to true reversibility).  ``kind`` routes the
+    per-layer-type policies; ``prevent_cse=False`` is the scan-body setting
+    (nn.scan already isolates iterations, flax's documented pairing)."""
+    if not _remat_applies(c, kind):
         return SubLayer
-    policy = _remat_policy(c)
-    return nn.remat(SubLayer, policy=policy) if policy else nn.remat(SubLayer)
+    kw = {} if prevent_cse else {"prevent_cse": False}
+    return nn.remat(SubLayer, policy=_remat_policy(c), **kw)
 
 
 def _sum_sown_losses(mut) -> jnp.ndarray:
@@ -393,20 +449,75 @@ def _proj(cfg, features, name, use_bias=True):
     return nn.Dense(features, use_bias=use_bias, dtype=cfg.dtype, name=name)
 
 
+class DenseParams(nn.Module):
+    """``nn.Dense`` drop-in (same ``kernel``/``bias`` names, shapes and
+    init, so checkpoints are unchanged) that exposes the arrays as
+    attributes for fused ops — the VocabHead pattern (models/dalle.py)
+    applied to the FF projections."""
+
+    in_features: int
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.in_features, self.features),
+        )
+        if self.use_bias:
+            self.bias = self.param("bias", nn.initializers.zeros, (self.features,))
+
+    def __call__(self, x):
+        if not self.use_bias:
+            x, kernel = nn.dtypes.promote_dtype(x, self.kernel, dtype=self.dtype)
+            return x @ kernel
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, self.kernel, self.bias, dtype=self.dtype
+        )
+        return x @ kernel + bias
+
+
 class FeedForward(nn.Module):
-    """GEGLU MLP (reference: transformer.py:72-88)."""
+    """GEGLU MLP (reference: transformer.py:72-88).
+
+    ``cfg.fused_ff`` routes through ops/fused_ff.py (Pallas on TPU,
+    chunked XLA elsewhere): same ``wi``/``wo`` params, but the
+    ``[n, 2*inner]`` pre-activations and the ``[n, inner]`` gated product
+    never materialize to HBM.  Active dropout (ff_dropout > 0 and not
+    deterministic) and the decode-only int8 path keep the unfused math —
+    dropout sits between the activation and ``wo``, inside what the
+    kernel fuses."""
 
     cfg: TransformerConfig
 
-    @nn.compact
-    def __call__(self, x, deterministic=True):
+    def setup(self):
         c = self.cfg
         inner = c.dim * c.ff_mult
-        y = _proj(c, inner * 2, "wi")(x)
+        if c.quant_int8:
+            self.wi = _proj(c, inner * 2, "wi")
+            self.wo = _proj(c, c.dim, "wo")
+        else:
+            self.wi = DenseParams(c.dim, inner * 2, dtype=c.dtype, name="wi")
+            self.wo = DenseParams(inner, c.dim, dtype=c.dtype, name="wo")
+        self.drop = nn.Dropout(c.ff_dropout)
+
+    def __call__(self, x, deterministic=True):
+        c = self.cfg
+        dropout_active = c.ff_dropout > 0.0 and not deterministic
+        if c.fused_ff and not c.quant_int8 and not dropout_active:
+            from dalle_tpu.ops.fused_ff import geglu_ff
+
+            x, wi_k, wi_b, wo_k, wo_b = nn.dtypes.promote_dtype(
+                x, self.wi.kernel, self.wi.bias,
+                self.wo.kernel, self.wo.bias, dtype=c.dtype,
+            )
+            return geglu_ff(x, wi_k, wi_b, wo_k, wo_b)
+        y = self.wi(x)
         y, gate = jnp.split(y, 2, axis=-1)
         y = y * jax.nn.gelu(gate, approximate=False)  # exact erf (torch F.gelu parity)
-        y = nn.Dropout(c.ff_dropout)(y, deterministic=deterministic)
-        return _proj(c, c.dim, "wo")(y)
+        y = self.drop(y, deterministic=deterministic)
+        return self.wo(y)
 
 
 class JointAttention(nn.Module):
@@ -962,18 +1073,15 @@ class ScanGroup(nn.Module):
 
     def setup(self):
         c = self.cfg
-        layer_cls = (
-            nn.remat(SubLayer, prevent_cse=False, policy=_remat_policy(c))
-            if c.use_remat
-            else SubLayer
-        )
+        attn_cls = _layer_cls(c, "attn", prevent_cse=False)
+        ff_cls = _layer_cls(c, "ff", prevent_cse=False)
         pairs = []
         for j, atype in enumerate(c.attn_types):
             pairs.append(
                 (
-                    layer_cls(c, 0, f"attn:{atype}", scale_init=1.0,
-                              name=f"pair{j}_attn"),
-                    layer_cls(c, 0, "ff", scale_init=1.0, name=f"pair{j}_ff"),
+                    attn_cls(c, 0, f"attn:{atype}", scale_init=1.0,
+                             name=f"pair{j}_attn"),
+                    ff_cls(c, 0, "ff", scale_init=1.0, name=f"pair{j}_ff"),
                 )
             )
         self.pairs = pairs
@@ -1036,15 +1144,16 @@ class TransformerStage(nn.Module):
     def setup(self):
         c = self.cfg
         per = c.depth // c.pp_stages
-        layer_cls = _layer_cls(c)
+        attn_cls = _layer_cls(c, "attn")
+        ff_cls = _layer_cls(c, "ff")
         pairs = []
         for j in range(per):
             gi = self.stage_ind * per + j  # global index (LayerScale init)
             atype = c.attn_type_for_layer(gi)
             pairs.append(
                 (
-                    layer_cls(c, gi, f"attn:{atype}", name=f"layer_{j}_attn"),
-                    layer_cls(c, gi, "ff", name=f"layer_{j}_ff"),
+                    attn_cls(c, gi, f"attn:{atype}", name=f"layer_{j}_attn"),
+                    ff_cls(c, gi, "ff", name=f"layer_{j}_ff"),
                 )
             )
         self.pairs = pairs
@@ -1125,20 +1234,28 @@ class Transformer(nn.Module):
         # use_remat: recompute each sublayer in backward instead of storing
         # activations — the idiomatic JAX stand-in for the reference's
         # reversible autograd trick (reference: reversible.py:108-124).
-        layer_cls = _layer_cls(c)
+        attn_cls = _layer_cls(c, "attn")
+        ff_cls = _layer_cls(c, "ff")
         pairs = []
         for i in range(c.depth):
             atype = c.attn_type_for_layer(i)
             pairs.append(
                 (
-                    layer_cls(c, i, f"attn:{atype}", name=f"layer_{i}_attn"),
-                    layer_cls(c, i, "ff", name=f"layer_{i}_ff"),
+                    attn_cls(c, i, f"attn:{atype}", name=f"layer_{i}_attn"),
+                    ff_cls(c, i, "ff", name=f"layer_{i}_ff"),
                 )
             )
         self.pairs = pairs
 
     def __call__(self, x, key_pad_mask=None, deterministic=True):
         c = self.cfg
+        if c.stream_dtype is not None:
+            # bf16 activation streaming (training/precision.py): the
+            # residual stream itself rides at the wire dtype, so every
+            # residual add and inter-layer HBM round-trip is half-width —
+            # without this, f32 embeddings keep promoting the stream back
+            # to f32 even under dtype=bf16
+            x = x.astype(c.stream_dtype)
         if c.scan_layers:
             return self.scan_stack(x, key_pad_mask, deterministic)
         if c.pp_stages > 1:

@@ -42,6 +42,12 @@ class DiscreteVAEConfig:
     # channelwise normalization (mean, std), e.g. ImageNet stats
     # (reference: dalle_pytorch.py:154-162)
     normalization: Optional[Tuple[Tuple[float, ...], Tuple[float, ...]]] = None
+    # jax.checkpoint the conv encoder/decoder stacks (memory lever).
+    # remat_policy takes the transformer.py REMAT_POLICIES names; the
+    # dot-saving policies are near-no-ops for a conv stack (convs are not
+    # dot_general), so "full"/"nothing" is the meaningful setting here.
+    use_remat: bool = False
+    remat_policy: str = "full"
     dtype: Any = jnp.float32
 
     @property
@@ -114,8 +120,15 @@ class DiscreteVAE(nn.Module):
 
     def setup(self):
         c = self.cfg
-        self.encoder = Encoder(c, name="encoder")
-        self.decoder = Decoder(c, name="decoder")
+        enc_cls, dec_cls = Encoder, Decoder
+        if c.use_remat:
+            from dalle_tpu.models.transformer import resolve_remat_policy
+
+            policy = resolve_remat_policy(c.remat_policy)
+            enc_cls = nn.remat(Encoder, policy=policy)
+            dec_cls = nn.remat(Decoder, policy=policy)
+        self.encoder = enc_cls(c, name="encoder")
+        self.decoder = dec_cls(c, name="decoder")
         self.codebook = nn.Embed(c.num_tokens, c.codebook_dim, name="codebook")
 
     # --- helpers ----------------------------------------------------------

@@ -107,10 +107,14 @@ def _layout_or_causal(layout, nqb, nkb, bq, bk, causal):
     return np.asarray(layout, dtype=np.bool_)
 
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
 def _compiler_params():
     # batch*heads and q-blocks are independent; the k-block dim carries
     # the online-softmax recurrence and must run in order
-    return pltpu.CompilerParams(
+    return _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 

@@ -1,0 +1,433 @@
+"""Fused GEGLU feed-forward: wo(u * gelu(g)) without HBM pre-activations.
+
+The unfused FeedForward (models/transformer.py) round-trips two
+``[n, 4d]``-class intermediates through HBM per layer: the ``wi`` output
+(``[n, 2*inner]`` pre-activations, split into value/gate) and the gated
+product (``[n, inner]``) that feeds ``wo``.  docs/PERF.md measures the FF
+stack at 44.9 GB of the 138.6 GB flagship step — the single biggest
+component — while the step sits at intensity ~25.6 flops/byte against a
+v5e ridge of ~240.  Keeping those intermediates out of HBM is therefore
+worth real step time on TPU.
+
+Two implementations behind one dispatcher (mirroring ops/flash.py +
+ops/fused_ce.py):
+
+  * ``geglu_ff_pallas`` — a Pallas TPU kernel.  Grid =
+    (row_blocks, inner_blocks); ``wi``/``wo`` column/row blocks STREAM
+    through VMEM via the innermost grid dimension while a ``[bm, d]`` f32
+    accumulator persists in VMEM scratch (init at inner-block 0, emit at
+    the last), so the value/gate/product blocks never touch HBM.
+    Backward = two kernels recomputing the per-block pre-activations from
+    x (dx over row blocks; dW/db over inner blocks with output-block
+    revisiting as the accumulator), wrapped in ``jax.custom_vjp``.
+    Falls back to interpreter mode off-TPU so the same tests pin it to
+    the unfused oracle on CPU (the flash.py pattern).
+
+  * ``geglu_ff_chunked`` — an XLA fallback in the ops/fused_ce.py style:
+    a ``jax.checkpoint``-ed chunk over the inner dimension, accumulated
+    with a plain add chain.  Backward recomputes the chunk
+    pre-activations instead of saving them, so peak residency is
+    O(n * chunk) instead of O(n * 4d).  This is what the model uses
+    off-TPU (and what the XLA cost model / memory_analysis can verify on
+    CPU today).
+
+All math inside either path runs in f32 (dots take
+``preferred_element_type=jnp.float32``, gelu is the exact erf form for
+torch ``F.gelu`` parity) and the result is cast back to the compute
+dtype, matching the f32-accumulation invariant of the attention and CE
+paths (training/precision.py).
+
+Checkpoint compatibility: this op consumes the *same* ``wi``/``wo``
+kernels as the unfused path — value half ``wi[:, :inner]``, gate half
+``wi[:, inner:]`` (the ``jnp.split`` order) — so switching the fused
+flag never touches param names or shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dalle_tpu.ops.flash import (
+    _CompilerParams,
+    _interpret,
+    env_block_default,
+    pick_block,
+)
+
+_INV_SQRT2 = 0.7071067811865476
+_INV_SQRT_2PI = 0.3989422804014327
+
+
+def _gelu(g):
+    """Exact-erf gelu (torch F.gelu parity; transformer.py uses
+    approximate=False)."""
+    return 0.5 * g * (1.0 + jax.lax.erf(g * _INV_SQRT2))
+
+
+def _dgelu(g):
+    """d/dg of exact gelu: Phi(g) + g * phi(g)."""
+    return 0.5 * (1.0 + jax.lax.erf(g * _INV_SQRT2)) + g * _INV_SQRT_2PI * jnp.exp(
+        -0.5 * g * g
+    )
+
+
+def default_ff_block(which: str) -> int:
+    """``DALLE_TPU_FF_BLOCK_M`` / ``_F`` override the built-in 256/512
+    (same env-knob contract as the flash kernel)."""
+    assert which in ("m", "f"), which
+    fallback = {"m": 256, "f": 512}[which]
+    return env_block_default(f"DALLE_TPU_FF_BLOCK_{which.upper()}", fallback)
+
+
+def _compiler_params(order):
+    return _CompilerParams(dimension_semantics=order)
+
+
+def _f32(ref):
+    return ref[...].astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernels
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, w1_ref, w2_ref, b1_ref, b2_ref, wo_ref, o_ref, acc_scr, *, nf):
+    fb = pl.program_id(1)
+
+    @pl.when(fb == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    x = _f32(x_ref)  # [bm, d]
+    u = (
+        jax.lax.dot_general(
+            x, _f32(w1_ref), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        + _f32(b1_ref)
+    )  # [bm, bf]
+    g = (
+        jax.lax.dot_general(
+            x, _f32(w2_ref), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        + _f32(b2_ref)
+    )
+    h = u * _gelu(g)
+    acc_scr[...] += jax.lax.dot_general(
+        h, _f32(wo_ref), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bm, d]
+
+    @pl.when(fb == nf - 1)
+    def _emit():
+        o_ref[...] = acc_scr[...].astype(o_ref.dtype)
+
+
+def _recompute_block(x, w1_ref, w2_ref, b1_ref, b2_ref):
+    """Shared fwd recompute for both backward kernels: f32 (u, g)."""
+    u = (
+        jax.lax.dot_general(
+            x, _f32(w1_ref), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        + _f32(b1_ref)
+    )
+    g = (
+        jax.lax.dot_general(
+            x, _f32(w2_ref), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        + _f32(b2_ref)
+    )
+    return u, g
+
+
+def _bwd_dx_kernel(
+    x_ref, w1_ref, w2_ref, b1_ref, b2_ref, wo_ref, do_ref, dx_ref, acc_scr, *, nf
+):
+    fb = pl.program_id(1)
+
+    @pl.when(fb == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    x = _f32(x_ref)
+    u, g = _recompute_block(x, w1_ref, w2_ref, b1_ref, b2_ref)
+    dh = jax.lax.dot_general(
+        _f32(do_ref), _f32(wo_ref), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bm, d] x [bf, d] -> [bm, bf]
+    du = dh * _gelu(g)
+    dg = dh * u * _dgelu(g)
+    # du @ w1^T + dg @ w2^T — contract the inner-block dim
+    acc_scr[...] += jax.lax.dot_general(
+        du, _f32(w1_ref), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[...] += jax.lax.dot_general(
+        dg, _f32(w2_ref), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(fb == nf - 1)
+    def _emit():
+        dx_ref[...] = acc_scr[...].astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(
+    x_ref, w1_ref, w2_ref, b1_ref, b2_ref, wo_ref, do_ref,
+    dw1_ref, dw2_ref, db1_ref, db2_ref, dwo_ref, *, nm
+):
+    # grid = (inner_blocks parallel, row_blocks sequential); the five output
+    # blocks are indexed by the inner-block dim only, so they stay resident
+    # in VMEM across the row sweep and accumulate in place (init at row 0)
+    mb = pl.program_id(1)
+
+    @pl.when(mb == 0)
+    def _init():
+        dw1_ref[...] = jnp.zeros_like(dw1_ref)
+        dw2_ref[...] = jnp.zeros_like(dw2_ref)
+        db1_ref[...] = jnp.zeros_like(db1_ref)
+        db2_ref[...] = jnp.zeros_like(db2_ref)
+        dwo_ref[...] = jnp.zeros_like(dwo_ref)
+
+    x = _f32(x_ref)
+    u, g = _recompute_block(x, w1_ref, w2_ref, b1_ref, b2_ref)
+    h = u * _gelu(g)
+    do = _f32(do_ref)
+    dh = jax.lax.dot_general(
+        do, _f32(wo_ref), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    du = dh * _gelu(g)
+    dg = dh * u * _dgelu(g)
+    dw1_ref[...] += jax.lax.dot_general(
+        x, du, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [d, bf]
+    dw2_ref[...] += jax.lax.dot_general(
+        x, dg, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dwo_ref[...] += jax.lax.dot_general(
+        h, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bf, d]
+    db1_ref[...] += jnp.sum(du, axis=0, keepdims=True)
+    db2_ref[...] += jnp.sum(dg, axis=0, keepdims=True)
+
+
+# --------------------------------------------------------------------------
+# custom_vjp core over the flattened [M, d] view
+# --------------------------------------------------------------------------
+
+
+def _fwd_call(x2, w1, w2, b1, b2, wo, bm, bf):
+    M, d = x2.shape
+    inner = wo.shape[0]
+    nm, nf = M // bm, inner // bf
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, nf=nf),
+        grid=(nm, nf),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda m, f: (m, 0)),
+            pl.BlockSpec((d, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((d, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((1, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((1, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((bf, d), lambda m, f: (f, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, d), lambda m, f: (m, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, d), x2.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, d), jnp.float32)],
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(x2, w1, w2, b1, b2, wo)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _geglu_core(x2, w1, w2, b1, b2, wo, bm, bf):
+    return _fwd_call(x2, w1, w2, b1, b2, wo, bm, bf)
+
+
+def _geglu_core_fwd(x2, w1, w2, b1, b2, wo, bm, bf):
+    out = _fwd_call(x2, w1, w2, b1, b2, wo, bm, bf)
+    return out, (x2, w1, w2, b1, b2, wo)
+
+
+def _geglu_core_bwd(bm, bf, res, do):
+    x2, w1, w2, b1, b2, wo = res
+    M, d = x2.shape
+    inner = wo.shape[0]
+    nm, nf = M // bm, inner // bf
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, nf=nf),
+        grid=(nm, nf),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda m, f: (m, 0)),
+            pl.BlockSpec((d, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((d, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((1, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((1, bf), lambda m, f: (0, f)),
+            pl.BlockSpec((bf, d), lambda m, f: (f, 0)),
+            pl.BlockSpec((bm, d), lambda m, f: (m, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, d), lambda m, f: (m, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, d), jnp.float32)],
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(x2, w1, w2, b1, b2, wo, do)
+    dw1, dw2, db1, db2, dwo = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, nm=nm),
+        grid=(nf, nm),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda f, m: (m, 0)),
+            pl.BlockSpec((d, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((d, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((1, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((1, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((bf, d), lambda f, m: (f, 0)),
+            pl.BlockSpec((bm, d), lambda f, m: (m, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((d, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((1, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((1, bf), lambda f, m: (0, f)),
+            pl.BlockSpec((bf, d), lambda f, m: (f, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, inner), jnp.float32),
+            jax.ShapeDtypeStruct((d, inner), jnp.float32),
+            jax.ShapeDtypeStruct((1, inner), jnp.float32),
+            jax.ShapeDtypeStruct((1, inner), jnp.float32),
+            jax.ShapeDtypeStruct((inner, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(x2, w1, w2, b1, b2, wo, do)
+    return (
+        dx.astype(x2.dtype),
+        dw1.astype(w1.dtype),
+        dw2.astype(w2.dtype),
+        db1.astype(b1.dtype),
+        db2.astype(b2.dtype),
+        dwo.astype(wo.dtype),
+    )
+
+
+_geglu_core.defvjp(_geglu_core_fwd, _geglu_core_bwd)
+
+
+# --------------------------------------------------------------------------
+# public entry points
+# --------------------------------------------------------------------------
+
+
+def _check_shapes(x, wi, bi, wo, bo):
+    d = x.shape[-1]
+    inner = wo.shape[0]
+    assert wi.shape == (d, 2 * inner), (
+        f"wi {wi.shape} must be [{d}, 2*{inner}] (value half first, gate "
+        "half second — the jnp.split order)"
+    )
+    assert wo.shape == (inner, d), f"wo {wo.shape} vs inner {inner}, d {d}"
+    assert bi.shape == (2 * inner,), f"bi {bi.shape}"
+    assert bo is None or bo.shape == (d,), f"bo {bo.shape}"
+    return d, inner
+
+
+def geglu_ff_pallas(x, wi, bi, wo, bo=None, *, block_m=None, block_f=None):
+    """Fused GEGLU FF via the Pallas kernel (interpret mode off-TPU).
+
+    x: [..., d]; wi: [d, 2*inner]; bi: [2*inner]; wo: [inner, d]; bo: [d].
+    Returns [..., d] in x.dtype.
+    """
+    d, inner = _check_shapes(x, wi, bi, wo, bo)
+    lead = x.shape[:-1]
+    M = math.prod(lead) if lead else 1
+    x2 = x.reshape(M, d)
+    bm = pick_block(M, block_m or default_ff_block("m"))
+    bf = pick_block(inner, block_f or default_ff_block("f"))
+    w1, w2 = wi[:, :inner], wi[:, inner:]
+    b1 = bi[:inner].reshape(1, inner)
+    b2 = bi[inner:].reshape(1, inner)
+    out = _geglu_core(x2, w1, w2, b1, b2, wo, bm, bf)
+    if bo is not None:
+        out = out + bo.astype(out.dtype)
+    return out.reshape(*lead, d)
+
+
+def default_ff_chunk() -> int:
+    return env_block_default("DALLE_TPU_FF_CHUNK", 512)
+
+
+def geglu_ff_chunked(x, wi, bi, wo, bo=None, *, chunk=None):
+    """XLA fallback: checkpointed inner-dim chunks, add-chain accumulated.
+
+    Each chunk computes its [M, chunk] value/gate/product and folds it
+    into a [M, d] f32 accumulator; ``jax.checkpoint`` makes backward
+    recompute the chunk instead of saving it, so nothing of size
+    [M, 4d] is ever live (the fused_ce.py range-split idea applied to
+    the FF inner dimension).  A static Python loop (not lax.scan) keeps
+    the accumulator an add chain — backward needs no per-step carries.
+    """
+    d, inner = _check_shapes(x, wi, bi, wo, bo)
+    lead = x.shape[:-1]
+    M = math.prod(lead) if lead else 1
+    x2 = x.reshape(M, d)
+    ck = pick_block(inner, chunk or default_ff_chunk())
+    nf = inner // ck
+
+    @jax.checkpoint
+    def chunk_fn(xx, w1j, w2j, b1j, b2j, woj):
+        u = xx @ w1j + b1j
+        g = xx @ w2j + b2j
+        h = (u * _gelu(g)).astype(xx.dtype)
+        return jax.lax.dot_general(
+            h, woj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc = jnp.zeros((M, d), jnp.float32)
+    for j in range(nf):
+        sl = slice(j * ck, (j + 1) * ck)
+        acc = acc + chunk_fn(
+            x2, wi[:, sl], wi[:, inner + sl.start:inner + sl.stop],
+            bi[sl], bi[inner + sl.start:inner + sl.stop], wo[sl],
+        )
+    out = acc.astype(x.dtype)
+    if bo is not None:
+        out = out + bo.astype(out.dtype)
+    return out.reshape(*lead, d)
+
+
+def geglu_ff(x, wi, bi, wo, bo=None, *, impl=None, block_m=None, block_f=None,
+             chunk=None):
+    """Dispatcher: ``impl`` None = auto (Pallas on TPU, chunked XLA
+    elsewhere — the use_flash auto convention), or force "pallas" /
+    "chunked"."""
+    if impl is None:
+        lead = x.shape[:-1]
+        M = math.prod(lead) if lead else 1
+        # tiny-M calls (decode steps) take the chunked path: sub-8-row
+        # Pallas blocks are not worth a Mosaic compile
+        impl = "pallas" if jax.default_backend() == "tpu" and M >= 8 else "chunked"
+    if impl == "pallas":
+        return geglu_ff_pallas(x, wi, bi, wo, bo, block_m=block_m, block_f=block_f)
+    assert impl == "chunked", f"unknown fused-FF impl {impl!r}"
+    return geglu_ff_chunked(x, wi, bi, wo, bo, chunk=chunk)
+
+
+def geglu_ff_reference(x, wi, bi, wo, bo):
+    """Unfused oracle (the FeedForward math verbatim) for tests."""
+    y = x @ wi + bi
+    u, g = jnp.split(y, 2, axis=-1)
+    h = u * jax.nn.gelu(g, approximate=False)
+    return h @ wo + bo

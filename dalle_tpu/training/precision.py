@@ -1,0 +1,114 @@
+"""Structured activation-precision policies (the --bf16 flag, grown up).
+
+The pre-existing ``--bf16`` flag set one knob, ``cfg.dtype`` — flax
+modules then cast their (f32 master) params and inputs to bf16 for the
+matmuls.  That alone leaves the residual stream f32: embeddings come out
+f32, every ``x + sublayer(x)`` promotes back to f32, and the
+inter-layer [b, n, d] HBM traffic stays full-width.  This module names
+the complete policies and owns the invariants:
+
+  ================  =============  ==============  =======================
+  policy            compute dtype  stream dtype    notes
+  ================  =============  ==============  =======================
+  ``f32``           float32        —               everything full width
+  ``bf16``          bfloat16       —               legacy --bf16: matmuls
+                                                   bf16, residual stream
+                                                   still f32
+  ``bf16_stream``   bfloat16       bfloat16        activations bf16 on the
+                                                   wire end to end
+  ================  =============  ==============  =======================
+
+Invariants every policy preserves (asserted by tests, not re-implemented
+here — the point is that they are *named*):
+
+  * master params are f32; casts happen at the matmul boundary
+    (flax ``promote_dtype``), so the optimizer state and updates are
+    full precision (``mu_bf16`` is a separate, explicit optimizer knob);
+  * attention softmax accumulates in f32 — both paths: the dense/XLA op
+    (ops/attention.py ``preferred_element_type=jnp.float32`` + f32
+    softmax) and the Pallas flash kernel (f32 in-kernel state);
+  * the CE loss reduces in f32 — the dense head casts logits up
+    (models/dalle.py) and the fused range-split loss accumulates its
+    logsumexp in f32 (ops/fused_ce.py);
+  * the fused GEGLU FF computes in f32 inside the kernel/chunk and emits
+    the compute dtype (ops/fused_ff.py).
+
+``apply_policy`` maps a policy onto any of the model config dataclasses
+(DALLEConfig / TransformerConfig / CLIPConfig carry ``stream_dtype``;
+DiscreteVAEConfig is conv-only and takes just the compute dtype).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+PRECISION_CHOICES = ("f32", "bf16", "bf16_stream")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    name: str
+    compute_dtype: Any
+    stream_dtype: Any = None  # None = leave the residual stream alone
+    # documented invariants (informational — consumers hardcode f32 where
+    # it matters; these fields exist so the policy is self-describing)
+    param_dtype: Any = jnp.float32
+    softmax_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+
+_POLICIES = {
+    "f32": PrecisionPolicy("f32", jnp.float32),
+    "bf16": PrecisionPolicy("bf16", jnp.bfloat16),
+    "bf16_stream": PrecisionPolicy("bf16_stream", jnp.bfloat16, jnp.bfloat16),
+}
+
+
+def resolve_precision(name: str) -> PrecisionPolicy:
+    if name not in _POLICIES:
+        raise ValueError(
+            f"unknown precision policy {name!r}; options: {sorted(_POLICIES)}"
+        )
+    return _POLICIES[name]
+
+
+def policy_from_flags(precision: Optional[str], bf16: bool) -> PrecisionPolicy:
+    """Combine the structured ``--precision`` flag with the legacy
+    ``--bf16`` boolean.  ``--precision`` wins when given; contradicting
+    the two (--precision f32 --bf16) is an error rather than a silent
+    pick."""
+    if precision is None:
+        return resolve_precision("bf16" if bf16 else "f32")
+    pol = resolve_precision(precision)
+    if bf16 and pol.compute_dtype != jnp.bfloat16:
+        raise SystemExit(
+            f"--precision {precision} contradicts --bf16: pick one "
+            "(--precision bf16_stream is the superset of --bf16)"
+        )
+    return pol
+
+
+def apply_policy(cfg, policy: PrecisionPolicy):
+    """Return ``cfg`` with the policy's dtypes applied.  Works on any
+    frozen config dataclass with a ``dtype`` field; ``stream_dtype`` is
+    set only where the config has one (the conv VAE does not)."""
+    fields = {f.name for f in dataclasses.fields(cfg)}
+    assert "dtype" in fields, f"{type(cfg).__name__} has no dtype field"
+    repl = {"dtype": policy.compute_dtype}
+    if "stream_dtype" in fields:
+        repl["stream_dtype"] = policy.stream_dtype
+    return dataclasses.replace(cfg, **repl)
+
+
+def add_precision_args(parser):
+    """The shared trainer flag (next to the legacy --bf16 alias)."""
+    parser.add_argument(
+        "--precision", type=str, default=None, choices=PRECISION_CHOICES,
+        help="activation precision policy (training/precision.py): f32, "
+             "bf16 (matmul casts only, = --bf16), or bf16_stream "
+             "(+ the residual stream bf16 on the wire; softmax/CE still "
+             "accumulate f32)",
+    )

@@ -99,6 +99,121 @@ def clip_train_flops(cfg, batch: int) -> float:
     return 3.0 * fwd  # fwd + 2x bwd
 
 
+def dalle_step_wire_bytes(cfg, batch: int) -> dict:
+    """Analytic HBM wire bytes per train step, honoring the config's
+    precision/remat/fused-FF policy (the byte-side sibling of
+    ``dalle_train_flops``).
+
+    Why analytic and not ``cost_analysis()``: XLA:CPU *emulates* bf16
+    dots by inserting f32 converts, so on the CPU backend the cost model
+    reports bf16 programs as accessing MORE bytes than f32 — the exact
+    inverse of what the same program streams on TPU, where bf16 operands
+    move at native width and Pallas kernels (flash, fused FF) keep their
+    intermediates in VMEM.  This function counts the tensors a TPU
+    actually moves, term by term:
+
+      * activations at the policy width — residual stream at
+        ``stream_dtype`` (f32 unless bf16_stream), intra-layer tensors at
+        the compute ``dtype``;
+      * attention scores and CE statistics in f32 (softmax/reduce
+        invariants, training/precision.py);
+      * f32 master params read once fwd + once bwd, grads written f32,
+        adam state read+written f32;
+      * backward activation traffic = 2x forward (roofline convention);
+        remat ADDS recompute traffic (full: +1x fwd of the block,
+        dots-saving: +0.5x, attn_only/ff_only: that sublayer only) — remat
+        is a peak-memory lever, it raises bytes accessed (docs/PERF.md);
+      * ``fused_ff`` drops the [b,n,2F]/[b,n,F] GEGLU round-trips (the
+        kernel streams x, W, out); ``use_flash`` drops the [b,h,n,n]
+        score round-trips; ``loss_chunk`` never materializes [b,n,V].
+
+    Returns {embed, attn, ff, head_ce, optimizer, total} in bytes.
+    """
+    d, L = cfg.dim, cfg.depth
+    n = cfg.total_seq_len
+    b = batch
+    h, dh = cfg.heads, cfg.dim_head
+    inner = h * dh
+    kv_inner = (getattr(cfg, "kv_heads", None) or h) * dh
+    F = d * cfg.ff_mult
+    vt = cfg.total_text_tokens
+    vi = cfg.num_image_tokens
+    s_res = 2 if getattr(cfg, "stream_dtype", None) is not None else 4
+    import jax.numpy as jnp
+
+    s_act = 2 if cfg.dtype == jnp.bfloat16 else 4
+    bn = b * n
+
+    # per-layer f32 param bytes (masters; head/embeds counted separately)
+    p_attn = (d * (inner + 2 * kv_inner) + inner * d) * 4
+    p_ff = (d * 2 * F + F * d) * 4
+
+    # --- forward activation terms, per layer -------------------------------
+    attn_fwd = (s_res + s_act) * bn * d          # pre-norm read+write
+    attn_fwd += (1 + 3) * bn * d * s_act          # qkv proj in/out
+    if not getattr(cfg, "use_flash", None):
+        attn_fwd += 2 * bn * d * s_act            # q,k read by scores
+        attn_fwd += 4 * (b * h * n * n * 4)       # scores w, softmax rw, read
+        attn_fwd += bn * d * s_act                # v read
+    else:
+        attn_fwd += 3 * bn * d * s_act            # flash reads q,k,v once
+    attn_fwd += bn * d * s_act                    # attn out write
+    attn_fwd += 2 * bn * d * s_act                # out proj in/out
+    attn_fwd += 3 * bn * d * s_res                # residual add r/w
+
+    ff_fwd = (s_res + s_act) * bn * d             # pre-norm
+    if getattr(cfg, "fused_ff", False):
+        ff_fwd += 2 * bn * d * s_act              # kernel streams x in, out
+    else:
+        ff_fwd += bn * d * s_act                  # wi reads xn
+        ff_fwd += 2 * (bn * 2 * F * s_act)        # [b,n,2F] pre w + r
+        ff_fwd += 2 * (bn * F * s_act)            # gated h w + r
+        ff_fwd += bn * d * s_act                  # wo out
+    ff_fwd += 3 * bn * d * s_res                  # residual add r/w
+
+    # --- remat recompute multiplier (policy-dependent) ---------------------
+    extra_attn = extra_ff = 0.0
+    if getattr(cfg, "use_remat", False):
+        pol = getattr(cfg, "remat_policy", "full")
+        frac = 0.5 if pol in ("dots", "dots_saveable", "dots_no_batch") else 1.0
+        if pol != "ff_only":
+            extra_attn = frac
+        if pol != "attn_only":
+            extra_ff = frac
+
+    # fwd + 2x bwd (+ recompute), params fwd read + bwd read + grad write
+    attn_bytes = L * ((3.0 + extra_attn) * attn_fwd + 3 * p_attn)
+    ff_bytes = L * ((3.0 + extra_ff) * ff_fwd + 3 * p_ff)
+
+    # --- embeddings / head+CE / optimizer ----------------------------------
+    embed = 2 * bn * d * s_res + bn * d * 4       # tok+pos gather, sum write
+    p_head = d * (vt + vi) * 4
+    if getattr(cfg, "loss_chunk", None):
+        # range-split chunked CE: logits never hit HBM; bwd recomputes the
+        # chunk matmul once (x and W stream twice more)
+        head = 3 * (bn * d * 4 + p_head) + 2 * bn * 4
+        head += 2 * p_head  # grad write + one extra W stream in bwd
+    else:
+        logits = bn * (vt + vi) * 4
+        head = 3 * (bn * d * 4) + 3 * logits + 3 * p_head + 2 * bn * 4
+    n_params = (
+        L * (p_attn + p_ff) // 4 + (vt + vi) * d  # blocks + head
+        + (cfg.num_text_tokens + cfg.text_seq_len) * d
+        + (vi + cfg.image_seq_len) * d            # embeddings
+    )
+    optimizer = 7 * n_params * 4                  # p,m,v read + p,m,v write + g
+
+    out = {
+        "embed": float(embed),
+        "attn": float(attn_bytes),
+        "ff": float(ff_bytes),
+        "head_ce": float(head),
+        "optimizer": float(optimizer),
+    }
+    out["total"] = sum(out.values())
+    return out
+
+
 def compiled_cost_analysis(compiled) -> dict:
     """Normalize an executable's ``cost_analysis()`` (list-or-dict across
     JAX versions) to a plain dict."""

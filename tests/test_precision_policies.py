@@ -1,0 +1,249 @@
+"""Activation-precision policies (training/precision.py), selective remat
+(--remat_policy) and the fused GEGLU FF as TRAINING policies: every
+combination must produce the same 5-step loss trajectory as the f32
+no-remat baseline within the repo's existing parity tolerance (rtol
+2e-3, trajectory.py).  Measured drift: remat/fused variants ~2e-7 (math
+is reassociated, not changed), bf16 variants ~1e-3 (rounding only).
+
+Plus unit coverage of the policy plumbing itself: flag resolution, the
+config mapper, the remat-policy registry, and the checkpoint
+optimizer-meta guard (satellite: mu_bf16 resume mismatch)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.models.dalle import DALLEConfig
+from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+from dalle_tpu.parallel import make_mesh
+from dalle_tpu.training.trajectory import (
+    assert_trajectory_parity,
+    loss_trajectory,
+)
+
+STEPS = 5
+
+VCFG = DiscreteVAEConfig(
+    image_size=16, num_tokens=64, codebook_dim=16, num_layers=2, hidden_dim=8
+)
+
+BASE = DALLEConfig(
+    num_text_tokens=64,
+    text_seq_len=8,
+    num_image_tokens=VCFG.num_tokens,
+    image_fmap_size=VCFG.fmap_size,
+    dim=32,
+    depth=2,
+    heads=2,
+    dim_head=16,
+)
+
+POLICY_CASES = {
+    # every REMAT_POLICIES name (transformer.py) ...
+    "remat_nothing": dict(use_remat=True, remat_policy="nothing"),
+    "remat_dots": dict(use_remat=True, remat_policy="dots"),
+    "remat_dots_saveable": dict(use_remat=True, remat_policy="dots_saveable"),
+    "remat_dots_no_batch": dict(use_remat=True, remat_policy="dots_no_batch"),
+    "remat_attn_only": dict(use_remat=True, remat_policy="attn_only"),
+    "remat_ff_only": dict(use_remat=True, remat_policy="ff_only"),
+    # ... the fused FF as a train-step policy ...
+    "fused_ff": dict(fused_ff=True),
+    # ... the precision ladder, and the full combination
+    "bf16": dict(dtype=jnp.bfloat16),
+    "bf16_stream": dict(dtype=jnp.bfloat16, stream_dtype=jnp.bfloat16),
+    "bf16_stream_fused_remat": dict(
+        dtype=jnp.bfloat16, stream_dtype=jnp.bfloat16, fused_ff=True,
+        use_remat=True, remat_policy="dots_saveable",
+    ),
+    # policies must compose with the structured execution paths too
+    "scan_remat_ff_only": dict(
+        scan_layers=True, use_remat=True, remat_policy="ff_only"
+    ),
+    "reversible_remat_dots": dict(
+        reversible=True, use_remat=True, remat_policy="dots_saveable"
+    ),
+}
+
+
+@pytest.fixture(scope="module")
+def vae_and_params():
+    vae = DiscreteVAE(VCFG)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (2, 16, 16, 3))
+    vparams = vae.init(
+        {"params": rng, "gumbel": rng}, images, return_loss=True
+    )["params"]
+    return vae, vparams
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(dp=1, devices=[jax.devices()[0]])
+
+
+@pytest.fixture(scope="module")
+def baselines(vae_and_params, mesh1):
+    """f32 no-remat trajectories, one per structural execution path (a
+    scan-trained model folds init RNG differently, so scan variants get a
+    scan baseline — the policy under test is remat/precision, not scan)."""
+    vae, vparams = vae_and_params
+    cache = {}
+
+    def get(scan):
+        if scan not in cache:
+            cfg = dataclasses.replace(BASE, scan_layers=scan)
+            cache[scan] = loss_trajectory(
+                cfg, mesh1, steps=STEPS, vae=vae, vae_params=vparams
+            )
+        return cache[scan]
+
+    return get
+
+
+@pytest.mark.parametrize("name", list(POLICY_CASES))
+def test_policy_trajectory_matches_f32_baseline(
+    name, vae_and_params, mesh1, baselines
+):
+    vae, vparams = vae_and_params
+    case = POLICY_CASES[name]
+    cfg = dataclasses.replace(BASE, **case)
+    traj = loss_trajectory(cfg, mesh1, steps=STEPS, vae=vae, vae_params=vparams)
+    if case.get("reversible"):
+        # reversible runs genuinely different math (coupled stream halves,
+        # dalle.py doubles dim internally) — same as the existing dryrun
+        # suite, only require real learning, not parity
+        assert traj[-1] < traj[0], f"{name}: loss did not decrease"
+        return
+    assert_trajectory_parity(
+        traj, baselines(bool(case.get("scan_layers"))), label=name
+    )
+    assert traj[-1] < traj[0], f"{name}: loss did not decrease"
+
+
+# --------------------------------------------------------------------------
+# unit coverage: precision flag plumbing
+# --------------------------------------------------------------------------
+
+
+def test_policy_from_flags_resolution():
+    from dalle_tpu.training.precision import policy_from_flags
+
+    assert policy_from_flags(None, False).name == "f32"
+    assert policy_from_flags(None, True).name == "bf16"  # legacy alias
+    pol = policy_from_flags("bf16_stream", False)
+    assert pol.compute_dtype == jnp.bfloat16
+    assert pol.stream_dtype == jnp.bfloat16
+    # --precision bf16_stream --bf16 is consistent (superset), allowed
+    assert policy_from_flags("bf16_stream", True).name == "bf16_stream"
+    with pytest.raises(SystemExit):
+        policy_from_flags("f32", True)  # contradiction
+    with pytest.raises(ValueError):
+        policy_from_flags("fp8", False)
+
+
+def test_apply_policy_maps_onto_configs():
+    from dalle_tpu.models.clip import CLIPConfig
+    from dalle_tpu.training.precision import apply_policy, resolve_precision
+
+    pol = resolve_precision("bf16_stream")
+    d = apply_policy(BASE, pol)
+    assert d.dtype == jnp.bfloat16 and d.stream_dtype == jnp.bfloat16
+    c = apply_policy(CLIPConfig(), pol)
+    assert c.dtype == jnp.bfloat16 and c.stream_dtype == jnp.bfloat16
+    # the conv VAE has no residual stream: only the compute dtype applies
+    v = apply_policy(VCFG, pol)
+    assert v.dtype == jnp.bfloat16 and not hasattr(v, "stream_dtype")
+    # f32 round-trips back to a full-width config
+    d2 = apply_policy(d, resolve_precision("f32"))
+    assert d2.dtype == jnp.float32 and d2.stream_dtype is None
+
+
+def test_remat_policy_registry_resolves():
+    from dalle_tpu.models.transformer import REMAT_POLICIES, resolve_remat_policy
+
+    for name in REMAT_POLICIES:
+        resolve_remat_policy(name)  # must not raise
+    with pytest.raises(AssertionError):
+        resolve_remat_policy("everything")
+
+
+def test_stream_dtype_is_compute_policy_not_hparam():
+    """stream_dtype/fused_ff must never leak into checkpoint hparams —
+    resumes apply the policy from flags (train_dalle.py)."""
+    cfg = dataclasses.replace(
+        BASE, dtype=jnp.bfloat16, stream_dtype=jnp.bfloat16, fused_ff=True
+    )
+    d = cfg.to_dict()
+    assert "dtype" not in d and "stream_dtype" not in d and "fused_ff" not in d
+    rt = DALLEConfig.from_dict(d)
+    assert rt.dtype == jnp.float32 and rt.stream_dtype is None
+    assert not rt.fused_ff
+
+
+def test_bf16_stream_residual_is_bf16():
+    """The policy's point: under bf16_stream the residual stream really is
+    bf16 on the wire (legacy bf16 leaves it f32 via the f32 embeddings)."""
+    from dalle_tpu.models.transformer import Transformer
+
+    tc_args = dict(
+        dim=16, depth=1, heads=2, dim_head=8, text_seq_len=8, fmap_size=2,
+        attn_types=("full",), dtype=jnp.bfloat16,
+    )
+    from dalle_tpu.models.transformer import TransformerConfig
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 12, 16), jnp.float32)
+    for stream, want in ((None, jnp.float32), (jnp.bfloat16, jnp.bfloat16)):
+        tr = Transformer(TransformerConfig(stream_dtype=stream, **tc_args))
+        params = tr.init({"params": jax.random.PRNGKey(1)}, x)["params"]
+        out = tr.apply({"params": params}, x)
+        assert out.dtype == want, (stream, out.dtype)
+
+
+# --------------------------------------------------------------------------
+# satellite: optimizer-meta resume guard (shared across the trainers)
+# --------------------------------------------------------------------------
+
+
+def test_check_optimizer_meta_guard():
+    from dalle_tpu.training.checkpoint import (
+        check_optimizer_meta,
+        optimizer_meta_from_args,
+    )
+
+    check_optimizer_meta({"optimizer": {"mu_bf16": True}}, True)  # match
+    check_optimizer_meta({"optimizer": {"mu_bf16": False}}, False)
+    check_optimizer_meta(None, False)  # old checkpoint, no meta = f32
+    check_optimizer_meta({}, False)
+    with pytest.raises(SystemExit):
+        check_optimizer_meta({"optimizer": {"mu_bf16": True}}, False)
+    with pytest.raises(SystemExit):
+        check_optimizer_meta(None, True)  # old checkpoint + new flag
+
+    class A:
+        mu_bf16 = True
+
+    assert optimizer_meta_from_args(A()) == {"mu_bf16": True}
+    assert optimizer_meta_from_args(object()) == {"mu_bf16": False}
+
+
+def test_vae_remat_same_loss():
+    """DiscreteVAE use_remat (satellite): identical forward loss."""
+    vae = DiscreteVAE(VCFG)
+    rvae = DiscreteVAE(dataclasses.replace(VCFG, use_remat=True))
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (2, 16, 16, 3))
+    params = vae.init(
+        {"params": rng, "gumbel": rng}, images, return_loss=True
+    )["params"]
+    base = vae.apply(
+        {"params": params}, images, return_loss=True, rngs={"gumbel": rng}
+    )
+    remat = rvae.apply(
+        {"params": params}, images, return_loss=True, rngs={"gumbel": rng}
+    )
+    np.testing.assert_allclose(
+        np.asarray(remat), np.asarray(base), rtol=1e-6
+    )

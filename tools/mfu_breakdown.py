@@ -23,6 +23,14 @@ Components:
 
 The "x12"-scaled attn/ff rows + head + optimizer reconstruct the step
 within a few percent, which validates reading the table as a budget.
+
+``--policies`` instead emits the activation-precision / remat / fused-FF
+byte table (training/precision.py x --remat_policy x ops/fused_ff.py):
+each named policy combination compiled at the flagship shape, per-variant
+{step, fwd_bwd, attn_layer, ff_layer} flops+bytes plus the step-bytes
+reduction vs the f32 no-remat baseline.  Per-layer rows reflect the
+dtype/fused levers only (remat wrapping lives in the full Transformer),
+so read remat effects off the step/fwd_bwd rows.
 """
 
 import argparse
@@ -48,6 +56,147 @@ def _timeit(fn, *args, reps=3):
     return (time.perf_counter() - t0) / reps
 
 
+POLICY_VARIANTS = {
+    # name -> DALLEConfig field overrides (all compute policy, not hparams)
+    "f32": {},
+    "f32+remat_dots": {"use_remat": True, "remat_policy": "dots_saveable"},
+    "bf16": {"dtype": "bf16"},
+    "bf16_stream": {"dtype": "bf16", "stream_dtype": "bf16"},
+    "bf16_stream+remat_dots": {
+        "dtype": "bf16", "stream_dtype": "bf16",
+        "use_remat": True, "remat_policy": "dots_saveable",
+    },
+    "bf16_stream+fused_ff": {
+        "dtype": "bf16", "stream_dtype": "bf16", "fused_ff": True,
+    },
+}
+
+
+def policy_costs(base_cfg, b, *, variants=None, components=("step", "fwd_bwd",
+                                                           "attn_layer",
+                                                           "ff_layer")):
+    """Cost-model table for the named policy variants (no execution: each
+    component is lowered+compiled only).  Returns {variant: {component:
+    {gflops, gbytes}}}.  Params are initialized once (f32 masters shared
+    by every policy; the trees are structurally identical)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dalle_tpu.models.dalle import DALLE
+    from dalle_tpu.models.transformer import FeedForward, JointAttention
+    from dalle_tpu.training import make_optimizer
+    from dalle_tpu.training.profiler import xla_cost_analysis
+
+    dt = {"bf16": jnp.bfloat16, None: None}
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(
+        rng, (b, base_cfg.text_seq_len), 1, base_cfg.num_text_tokens
+    )
+    codes = jax.random.randint(
+        rng, (b, base_cfg.image_seq_len), 0, base_cfg.num_image_tokens
+    )
+    base = dataclasses.replace(
+        base_cfg, dtype=jnp.float32, stream_dtype=None, fused_ff=False,
+        use_remat=False, remat_policy="full",
+    )
+    params = DALLE(base).init({"params": rng}, text, codes)["params"]
+    tx = make_optimizer(1e-3, clip_grad_norm=0.5)
+    opt_state = tx.init(params)
+    n = base.text_seq_len + base.image_seq_len
+
+    table = {}
+    for name, over in (variants or POLICY_VARIANTS).items():
+        over = {
+            k: dt.get(v, v) if k in ("dtype", "stream_dtype") else v
+            for k, v in over.items()
+        }
+        cfg = dataclasses.replace(base, **over)
+        model = DALLE(cfg)
+
+        def loss_fn(p):
+            return model.apply({"params": p}, text, codes, return_loss=True)
+
+        def fwd_bwd(p):
+            return jax.value_and_grad(loss_fn)(p)
+
+        def full_step(p, o):
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            updates, o2 = tx.update(grads, o, p)
+            return optax.apply_updates(p, updates), o2, loss
+
+        tc = cfg.transformer_config()
+        x = jax.random.normal(
+            rng, (b, n, cfg.dim), tc.stream_dtype or jnp.float32
+        )
+        attn = JointAttention(tc, attn_type="full")
+        ff = FeedForward(tc)
+        ap_ = attn.init({"params": rng}, x)["params"]
+        fp_ = ff.init({"params": rng}, x)["params"]
+
+        def attn_fb(p, xx):
+            def f(pp):
+                return jnp.sum(
+                    attn.apply({"params": pp}, xx).astype(jnp.float32) ** 2
+                )
+            return jax.value_and_grad(f)(p)
+
+        def ff_fb(p, xx):
+            def f(pp):
+                return jnp.sum(
+                    ff.apply({"params": pp}, xx).astype(jnp.float32) ** 2
+                )
+            return jax.value_and_grad(f)(p)
+
+        fns = {
+            "step": (full_step, (params, opt_state)),
+            "fwd_bwd": (fwd_bwd, (params,)),
+            "attn_layer": (attn_fb, (ap_, x)),
+            "ff_layer": (ff_fb, (fp_, x)),
+        }
+        row = {}
+        for comp in components:
+            fn, fargs = fns[comp]
+            ca = xla_cost_analysis(jax.jit(fn), *fargs)
+            row[comp] = {
+                "gflops": round(ca.get("flops", 0.0) / 1e9, 2),
+                "gbytes": round(ca.get("bytes accessed", 0.0) / 1e9, 3),
+            }
+        from dalle_tpu.training.profiler import dalle_step_wire_bytes
+
+        wire = dalle_step_wire_bytes(cfg, b)
+        row["wire"] = {
+            k: round(v / 1e9, 3) for k, v in wire.items()
+        }
+        table[name] = row
+    return table
+
+
+def policy_report(table):
+    """Attach per-variant byte reductions vs the f32 baseline.
+
+    ``wire`` is the analytic TPU wire-byte model
+    (profiler.dalle_step_wire_bytes) — the dtype-faithful headline.
+    ``cost_model`` is the compiled program's own accounting: faithful on
+    TPU, but on the CPU backend XLA EMULATES bf16 dots via f32 converts,
+    so there bf16 variants report inflated bytes (the caveat is the whole
+    reason the wire column exists)."""
+    wire0 = table["f32"]["wire"]["total"]
+    cm0 = table["f32"]["step"]["gbytes"]
+    return {
+        "rows": table,
+        "step_bytes_reduction_vs_f32": {
+            name: {
+                "wire": round(1.0 - row["wire"]["total"] / wire0, 3),
+                "cost_model": round(1.0 - row["step"]["gbytes"] / cm0, 3),
+            }
+            for name, row in table.items()
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16)
@@ -55,6 +204,9 @@ def main():
                     help="also wall-time each component (slow on CPU)")
     ap.add_argument("--smoke", action="store_true",
                     help="depth-2 smoke shapes instead of the flagship")
+    ap.add_argument("--policies", action="store_true",
+                    help="emit the precision/remat/fused-FF policy byte "
+                         "table instead of the component breakdown")
     ap.add_argument("--json_out", type=str, default=None)
     args = ap.parse_args()
 
@@ -76,6 +228,19 @@ def main():
     )
 
     cfg = bench._flagship_cfg(args.smoke)
+
+    if args.policies:
+        out = policy_report(policy_costs(cfg, args.batch))
+        out["config"] = {
+            "depth": cfg.depth, "dim": cfg.dim, "batch": args.batch,
+            "platform": jax.default_backend(),
+        }
+        print(json.dumps(out, indent=1))
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(out, f, indent=1)
+        return
+
     model = DALLE(cfg)
     b = args.batch
     rng = jax.random.PRNGKey(0)

@@ -29,8 +29,13 @@ from dalle_tpu.training import (
     make_optimizer,
 )
 from dalle_tpu.training.config import apply_config_json
-from dalle_tpu.training.checkpoint import save_checkpoint
+from dalle_tpu.training.checkpoint import (
+    check_optimizer_meta,
+    optimizer_meta_from_args,
+    save_checkpoint,
+)
 from dalle_tpu.training.logging import Run
+from dalle_tpu.training.precision import add_precision_args, policy_from_flags
 from dalle_tpu.tokenizers import get_tokenizer
 
 
@@ -53,7 +58,9 @@ def parse_args(argv=None):
     parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
                         action="store_true",
                         help="bf16 compute for both encoders (2x MXU rate "
-                             "on TPU); params stay f32")
+                             "on TPU); params stay f32; alias for "
+                             "--precision bf16")
+    add_precision_args(parser)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="clip_ckpt")
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
@@ -80,6 +87,18 @@ def parse_args(argv=None):
                         help="lax.scan over stacked encoder layers (O(1) "
                              "compile in depth); CLIP is forward-only so "
                              "no layout conversion is ever needed")
+    from dalle_tpu.models.transformer import REMAT_POLICIES
+
+    parser.add_argument("--use_remat", action="store_true",
+                        help="rematerialize encoder block activations "
+                             "(memory lever)")
+    parser.add_argument("--remat_policy", type=str, default="full",
+                        choices=REMAT_POLICIES,
+                        help="with --use_remat: what checkpointed blocks "
+                             "keep (transformer.py REMAT_POLICIES)")
+    parser.add_argument("--fused_ff", action="store_true",
+                        help="fused GEGLU feed-forward in both encoders "
+                             "(ops/fused_ff.py)")
     for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
         parser.add_argument(f"--mesh_{ax}", type=int, default=None)
     parser.add_argument("--distributed_backend", "--distr_backend",
@@ -121,16 +140,20 @@ def main(argv=None):
         args.clip_resume_path, args.auto_resume, args.output_path, "clip",
         is_root=is_root,
     )
+    # compute policy, not hparams (to_dict pops these): applied the same
+    # way on fresh start and resume, so the flags always win
+    precision = policy_from_flags(args.precision, args.bf16)
+
     resume_meta = None
     if args.clip_resume_path:
         resume_meta = load_meta(args.clip_resume_path)
         cfg = CLIPConfig.from_dict(resume_meta["hparams"])
-        # dtype is compute policy, not an hparam (to_dict pops it):
-        # re-apply the flag so --bf16 survives a resume
         import dataclasses as _dc
         cfg = _dc.replace(
-            cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
+            cfg, dtype=precision.compute_dtype,
+            stream_dtype=precision.stream_dtype, fused_ff=args.fused_ff,
         )
+        check_optimizer_meta(resume_meta, args.mu_bf16)
         # the dataset and init dummies must match the checkpoint's model,
         # not whatever flags the restart command line happened to carry
         for flag, ckpt_val in (
@@ -159,7 +182,11 @@ def main(argv=None):
             visual_image_size=args.image_size,
             visual_patch_size=args.patch_size,
             scan_layers=args.scan_layers,
-            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            use_remat=args.use_remat,
+            remat_policy=args.remat_policy,
+            fused_ff=args.fused_ff,
+            dtype=precision.compute_dtype,
+            stream_dtype=precision.stream_dtype,
         )
 
     ds = TextImageDataset(
@@ -226,6 +253,7 @@ def main(argv=None):
             params=params, hparams=cfg.to_dict(),
             opt_state=opt_state, epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
+            optimizer_meta=optimizer_meta_from_args(args),
         )
         if ckpt_writer is not None:
             if in_loop:
@@ -242,34 +270,41 @@ def main(argv=None):
         tokens_per_step=args.batch_size * args.text_seq_len,
         samples_per_step=args.batch_size,
     )
-    for epoch in range(start_epoch, args.epochs):
-        resume_epoch = epoch
-        loader.set_epoch(epoch)
-        for text, images in device_prefetch(loader, batch_sharding(distr.mesh)):
-            params, opt_state, loss = step_fn(
-                params, opt_state, text, images, jax.random.fold_in(rng, global_step)
-            )
-            m = meter.step()
-            if m is not None:
-                loss_f = float(distr.average_all(loss))
-                if is_root:
-                    print(
-                        f"epoch {epoch} step {global_step} loss {loss_f:.5f} "
-                        f"({m['samples_per_sec']:.1f} samples/s, "
-                        f"MFU {m['mfu']:.1%})"
-                    )
-                    run.log(
-                        {"loss": loss_f, "epoch": epoch,
-                         "samples_per_sec": m["samples_per_sec"],
-                         "mfu": m["mfu"]},
-                        step=global_step,
-                    )
-            if global_step and global_step % args.save_every_n_steps == 0:
-                save(f"clip-step{global_step}", in_loop=True)
-            global_step += 1
-        resume_epoch = epoch + 1
-        save(f"clip-epoch{epoch}")
-    save("clip-final")
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            resume_epoch = epoch
+            loader.set_epoch(epoch)
+            for text, images in device_prefetch(loader, batch_sharding(distr.mesh)):
+                params, opt_state, loss = step_fn(
+                    params, opt_state, text, images, jax.random.fold_in(rng, global_step)
+                )
+                m = meter.step()
+                if m is not None:
+                    loss_f = float(distr.average_all(loss))
+                    if is_root:
+                        print(
+                            f"epoch {epoch} step {global_step} loss {loss_f:.5f} "
+                            f"({m['samples_per_sec']:.1f} samples/s, "
+                            f"MFU {m['mfu']:.1%})"
+                        )
+                        run.log(
+                            {"loss": loss_f, "epoch": epoch,
+                             "samples_per_sec": m["samples_per_sec"],
+                             "mfu": m["mfu"]},
+                            step=global_step,
+                        )
+                if global_step and global_step % args.save_every_n_steps == 0:
+                    save(f"clip-step{global_step}", in_loop=True)
+                global_step += 1
+            resume_epoch = epoch + 1
+            save(f"clip-epoch{epoch}")
+        save("clip-final")
+    finally:
+        # drain the async writer on EVERY exit path — interpreter
+        # shutdown tears down executors before the writer thread
+        # joins, killing in-flight saves (ADVICE.md)
+        if ckpt_writer is not None:
+            ckpt_writer.wait()
     if is_root:
         run.log_artifact(str(ckpt_dir / "clip-final"), name="trained-clip")
         run.finish()

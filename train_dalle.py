@@ -37,13 +37,16 @@ from dalle_tpu.training import (
 )
 from dalle_tpu.training.config import apply_config_json
 from dalle_tpu.training.checkpoint import (
+    check_optimizer_meta,
     is_checkpoint,
     load_meta,
     load_subtree,
+    optimizer_meta_from_args,
     save_checkpoint,
     shape_dtype_of,
 )
 from dalle_tpu.training.logging import Run
+from dalle_tpu.training.precision import add_precision_args, policy_from_flags
 from dalle_tpu.training.schedule import ReduceLROnPlateau
 from dalle_tpu.tokenizers import get_tokenizer
 
@@ -113,7 +116,9 @@ def parse_args(argv=None):
     parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
                         action="store_true",
                         help="bf16 compute (supersedes the reference's "
-                             "fp16/Apex-AMP, train_dalle.py:77-78,466-472)")
+                             "fp16/Apex-AMP, train_dalle.py:77-78,466-472); "
+                             "alias for --precision bf16")
+    add_precision_args(parser)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="dalle_ckpt")
     # --- model (reference: train_dalle.py:111-135)
@@ -135,17 +140,28 @@ def parse_args(argv=None):
                         help="lax.scan over stacked layers: O(1)-in-depth "
                              "compile time (MaxText/T5X idiom); requires "
                              "homogeneous layers — no reversible/pp/MoE")
+    from dalle_tpu.models.transformer import REMAT_POLICIES
+
     parser.add_argument("--remat_policy", type=str, default="full",
-                        choices=("full", "dots", "dots_no_batch"),
+                        choices=REMAT_POLICIES,
                         help="with --use_remat: what checkpointed blocks "
-                             "keep (full=save nothing; dots=save matmuls; "
-                             "dots_no_batch=save batch-free matmuls only)")
+                             "keep (full/nothing=save nothing; "
+                             "dots/dots_saveable=save matmul outputs; "
+                             "dots_no_batch=save batch-free matmuls only; "
+                             "attn_only/ff_only=remat just that sublayer "
+                             "kind, saving everything else)")
     parser.add_argument("--loss_img_weight", type=int, default=7)
     parser.add_argument("--loss_chunk", type=int, default=None,
                         help="fused range-split CE: chunk-scan the head so "
                              "the [b,n,V] logits tensor never materializes "
                              "and text/image rows only multiply their vocab "
                              "slice (~2x fewer head FLOPs; ops/fused_ce.py)")
+    parser.add_argument("--fused_ff", action="store_true",
+                        help="fused GEGLU feed-forward (ops/fused_ff.py): "
+                             "the [n, 4*dim] pre-activations never round-trip "
+                             "HBM (Pallas kernel on TPU, checkpointed chunk "
+                             "loop elsewhere); numerics match the unfused "
+                             "path to ~2e-4")
     parser.add_argument("--attn_types", type=str, default="full",
                         help="comma-sep cycle: full,axial_row,axial_col,conv_like,sparse,mlp")
     parser.add_argument("--shift_tokens", action="store_true")
@@ -186,7 +202,7 @@ def parse_args(argv=None):
                         choices=("contiguous", "zigzag"),
                         help="ring schedule: contiguous skips fully-masked "
                              "steps; zigzag balances load per step "
-                             "(parallel/ring.py; needs seq_len % 2*sp == 0)")
+                             "(parallel/ring.py; needs seq_len %% 2*sp == 0)")
     parser.add_argument("--moe_experts", type=int, default=0,
                         help=">0: every moe_every-th FF is a routed MoE "
                              "(expert weights shard over --mesh_ep)")
@@ -266,6 +282,19 @@ def main(argv=None):
 
     distr.initialize(**mesh_kwargs_from_args(args))
     distr.check_batch_size(args.batch_size)
+    if args.pp_stages > 1:
+        mesh = distr.mesh
+        pp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1)
+        if pp_size != args.pp_stages:
+            # the model would silently fall back to sequential stage
+            # execution (a UserWarning lost in startup noise) — a trainer
+            # asking for pipeline parallelism without the mesh axis is a
+            # config error, fail fast instead
+            raise SystemExit(
+                f"--pp_stages={args.pp_stages} but the mesh 'pp' axis has "
+                f"size {pp_size}: pipeline parallelism needs a matching "
+                f"--mesh_pp {args.pp_stages}"
+            )
     is_root = distr.is_root_worker()
     rank, world = distr.get_rank(), distr.get_world_size()
 
@@ -293,15 +322,20 @@ def main(argv=None):
 
     vae, vae_params, vae_cfg = resolve_vae(args, resume_meta, distr.mesh)
 
-    # compute policy (not hparams — to_dict pops both): applied identically
-    # on fresh start and resume, so the flags always win over the checkpoint
+    # compute policy (not hparams — to_dict pops all of these): applied
+    # identically on fresh start and resume, so the flags always win over
+    # the checkpoint
     use_flash = {"auto": None, "on": True, "off": False}[args.use_flash]
-    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    precision = policy_from_flags(args.precision, args.bf16)
 
     if resume_meta is not None:
         cfg = DALLEConfig.from_dict(resume_meta["hparams"])
         import dataclasses as _dc
-        cfg = _dc.replace(cfg, dtype=dtype, use_flash=use_flash)
+        cfg = _dc.replace(
+            cfg, dtype=precision.compute_dtype,
+            stream_dtype=precision.stream_dtype, use_flash=use_flash,
+            fused_ff=args.fused_ff,
+        )
     else:
         num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
         cfg = DALLEConfig(
@@ -342,7 +376,9 @@ def main(argv=None):
             moe_top_k=args.moe_top_k,
             moe_capacity_factor=args.moe_capacity_factor,
             moe_aux_weight=args.moe_aux_weight,
-            dtype=dtype,
+            fused_ff=args.fused_ff,
+            dtype=precision.compute_dtype,
+            stream_dtype=precision.stream_dtype,
         )
     model = DALLE(cfg)
     image_size = vae_cfg.image_size
@@ -390,16 +426,9 @@ def main(argv=None):
     rng = jax.random.PRNGKey(args.seed)
     if resume_meta is not None:
         # the opt_state restore is dtype-typed: a moment-dtype flag
-        # mismatch would silently cast the restored moments — enforce
-        # consistency instead (old checkpoints recorded no policy = f32)
-        saved_mu = (resume_meta.get("optimizer") or {}).get("mu_bf16", False)
-        if saved_mu != args.mu_bf16:
-            raise SystemExit(
-                f"--mu_bf16={args.mu_bf16} but the checkpoint was trained "
-                f"with mu_bf16={saved_mu}: pass the matching flag (the "
-                "typed optimizer-state restore would otherwise silently "
-                "cast the adam moments)"
-            )
+        # mismatch would silently cast the restored moments — the shared
+        # guard (checkpoint.py) enforces consistency instead
+        check_optimizer_meta(resume_meta, args.mu_bf16)
     tx = make_optimizer(args.learning_rate, clip_grad_norm=args.clip_grad_norm,
                         mu_bf16=args.mu_bf16)
     if args.ga_steps > 1:  # (reference: --ga_steps, train_dalle.py:103,464)
@@ -517,7 +546,7 @@ def main(argv=None):
             epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict() if sched else None,
-            optimizer_meta={"mu_bf16": args.mu_bf16},
+            optimizer_meta=optimizer_meta_from_args(args),
             keep_n=args.keep_n_checkpoints,
         )
         path = str(ckpt_dir / f"{args.dalle_output_file_name}-{tag}")
@@ -552,92 +581,102 @@ def main(argv=None):
         samples_per_step=args.batch_size,
     )
     lr = args.learning_rate
-    for epoch in range(start_epoch, args.epochs):
-        resume_epoch = epoch
-        if hasattr(loader, "set_epoch"):
-            loader.set_epoch(epoch)
-        # device-side loss accumulation: float(loss) every step would block
-        # on the device and serialize dispatch (round-1 VERDICT weak #6);
-        # the host only syncs on the logging cadence and at epoch end
-        loss_sum = None
-        loss_count = 0
-        batches = device_prefetch(loader, batch_sharding(distr.mesh))
-        for i, (text, images) in enumerate(batches):
-            if args.flops_profiler and global_step == 200 and is_root:
-                jax.profiler.start_trace(str(ckpt_dir / "profile"))
-            out = step_fn(
-                params, opt_state, vae_params, text, images,
-                jax.random.fold_in(rng, global_step),
-            )
-            if want_metrics:
-                params, opt_state, loss, step_metrics = out
-            else:
-                params, opt_state, loss = out
-                step_metrics = {}
-            if ema_step is not None:
-                ema_params = ema_step(ema_params, params)
-            if args.flops_profiler and global_step == 201 and is_root:
-                jax.block_until_ready(loss)
-                jax.profiler.stop_trace()
-                print(f"profiler trace written to {ckpt_dir/'profile'}")
-            loss_sum = loss if loss_sum is None else loss_sum + loss
-            loss_count += 1
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            resume_epoch = epoch
+            if hasattr(loader, "set_epoch"):
+                loader.set_epoch(epoch)
+            # device-side loss accumulation: float(loss) every step would block
+            # on the device and serialize dispatch (round-1 VERDICT weak #6);
+            # the host only syncs on the logging cadence and at epoch end
+            loss_sum = None
+            loss_count = 0
+            batches = device_prefetch(loader, batch_sharding(distr.mesh))
+            for i, (text, images) in enumerate(batches):
+                if args.flops_profiler and global_step == 200 and is_root:
+                    jax.profiler.start_trace(str(ckpt_dir / "profile"))
+                out = step_fn(
+                    params, opt_state, vae_params, text, images,
+                    jax.random.fold_in(rng, global_step),
+                )
+                if want_metrics:
+                    params, opt_state, loss, step_metrics = out
+                else:
+                    params, opt_state, loss = out
+                    step_metrics = {}
+                if ema_step is not None:
+                    ema_params = ema_step(ema_params, params)
+                if args.flops_profiler and global_step == 201 and is_root:
+                    jax.block_until_ready(loss)
+                    jax.profiler.stop_trace()
+                    print(f"profiler trace written to {ckpt_dir/'profile'}")
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                loss_count += 1
 
-            if global_step != 0 and global_step % args.save_every_n_steps == 0:
-                save(f"step{global_step}", in_loop=True)
-            m = meter.step()
-            if m is not None:
-                # average_all is a COLLECTIVE under multi-host
-                # (process_allgather): every process must enter it; only
-                # the print/log below is root-gated
-                avg_loss = float(distr.average_all(loss))
-            if is_root and m is not None:
-                extras = {k: float(v) for k, v in step_metrics.items()}
-                print(
-                    f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
-                    f"lr {lr:.2e} ({m['samples_per_sec']:.1f} samples/s, "
-                    f"MFU {m['mfu']:.1%})"
-                    + "".join(f" {k} {v:.3f}" for k, v in extras.items())
-                )
-                run.log(
-                    {"loss": avg_loss, "lr": lr, "epoch": epoch,
-                     "sample_per_sec": m["samples_per_sec"],
-                     "tokens_per_sec": m["tokens_per_sec"], "mfu": m["mfu"],
-                     **extras},
-                    step=global_step,
-                )
-            if is_root and global_step % 100 == 0 and global_step != 0:
-                # in-loop sample generation (reference: train_dalle.py:604-619)
-                # local_rows: text is a globally-sharded device batch under
-                # multi-host prefetch; plain text[:1] would touch remote shards
-                sample_text = jnp.asarray(local_rows(text, 1))
-                imgs = generate_images(
-                    eval_model, unstack(params), vae, vae_params, sample_text,
-                    # distinct stream from the train-step keys (fold_in
-                    # requires a non-negative value: uint32)
-                    jax.random.fold_in(
-                        jax.random.fold_in(rng, 0x5A3D), global_step
-                    ),
-                    filter_thres=0.9,
-                )
-                caption = tokenizer.decode(np.asarray(sample_text)[0])
-                run.log_images(
-                    "image", np.asarray(imgs, np.float32), global_step,
-                    captions=[caption],
-                )
-            global_step += 1
+                if global_step != 0 and global_step % args.save_every_n_steps == 0:
+                    save(f"step{global_step}", in_loop=True)
+                m = meter.step()
+                if m is not None:
+                    # average_all is a COLLECTIVE under multi-host
+                    # (process_allgather): every process must enter it; only
+                    # the print/log below is root-gated
+                    avg_loss = float(distr.average_all(loss))
+                if is_root and m is not None:
+                    extras = {k: float(v) for k, v in step_metrics.items()}
+                    print(
+                        f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
+                        f"lr {lr:.2e} ({m['samples_per_sec']:.1f} samples/s, "
+                        f"MFU {m['mfu']:.1%})"
+                        + "".join(f" {k} {v:.3f}" for k, v in extras.items())
+                    )
+                    run.log(
+                        {"loss": avg_loss, "lr": lr, "epoch": epoch,
+                         "sample_per_sec": m["samples_per_sec"],
+                         "tokens_per_sec": m["tokens_per_sec"], "mfu": m["mfu"],
+                         **extras},
+                        step=global_step,
+                    )
+                if is_root and global_step % 100 == 0 and global_step != 0:
+                    # in-loop sample generation (reference: train_dalle.py:604-619)
+                    # local_rows: text is a globally-sharded device batch under
+                    # multi-host prefetch; plain text[:1] would touch remote shards
+                    sample_text = jnp.asarray(local_rows(text, 1))
+                    imgs = generate_images(
+                        eval_model, unstack(params), vae, vae_params, sample_text,
+                        # distinct stream from the train-step keys (fold_in
+                        # requires a non-negative value: uint32)
+                        jax.random.fold_in(
+                            jax.random.fold_in(rng, 0x5A3D), global_step
+                        ),
+                        filter_thres=0.9,
+                    )
+                    caption = tokenizer.decode(np.asarray(sample_text)[0])
+                    run.log_images(
+                        "image", np.asarray(imgs, np.float32), global_step,
+                        captions=[caption],
+                    )
+                global_step += 1
 
-        if sched is not None and loss_count:
-            lr = sched.step(float(loss_sum) / loss_count)
-            opt_state = set_learning_rate(opt_state, lr)
-        resume_epoch = epoch + 1
-        save(f"epoch{epoch}")
-        if is_root:
-            run.log_artifact(
-                str(ckpt_dir / f"{args.dalle_output_file_name}-epoch{epoch}"),
-                name="trained-dalle",
-            )
-    save("final")
+            if sched is not None and loss_count:
+                lr = sched.step(float(loss_sum) / loss_count)
+                opt_state = set_learning_rate(opt_state, lr)
+            resume_epoch = epoch + 1
+            save(f"epoch{epoch}")
+            if is_root:
+                run.log_artifact(
+                    str(ckpt_dir / f"{args.dalle_output_file_name}-epoch{epoch}"),
+                    name="trained-dalle",
+                )
+        save("final")
+    finally:
+        # drain the async checkpoint writer on EVERY exit path:
+        # without this, an exception (or plain interpreter exit)
+        # tears down the executor machinery before the in-flight
+        # orbax save finishes and the checkpoint dies half-written
+        # with 'cannot schedule new futures after interpreter
+        # shutdown' (ADVICE.md)
+        if ckpt_writer is not None:
+            ckpt_writer.wait()
     if is_root:
         run.finish()
 

@@ -30,8 +30,13 @@ from dalle_tpu.training import (
     set_learning_rate,
 )
 from dalle_tpu.training.config import apply_config_json
-from dalle_tpu.training.checkpoint import save_checkpoint
+from dalle_tpu.training.checkpoint import (
+    check_optimizer_meta,
+    optimizer_meta_from_args,
+    save_checkpoint,
+)
 from dalle_tpu.training.logging import Run
+from dalle_tpu.training.precision import add_precision_args, policy_from_flags
 from dalle_tpu.training.schedule import ExponentialDecay
 
 
@@ -58,7 +63,20 @@ def parse_args(argv=None):
     parser.add_argument("--bf16", "--fp16", "--amp", dest="bf16",
                         action="store_true",
                         help="bf16 compute for the conv stacks (2x MXU "
-                             "rate on TPU); params stay f32")
+                             "rate on TPU); params stay f32; alias for "
+                             "--precision bf16 (the conv VAE has no "
+                             "residual stream, so bf16_stream = bf16 here)")
+    add_precision_args(parser)
+    parser.add_argument("--use_remat", action="store_true",
+                        help="jax.checkpoint the conv encoder/decoder "
+                             "stacks (memory lever)")
+    parser.add_argument("--remat_policy", type=str, default="full",
+                        choices=("full", "nothing", "dots", "dots_saveable",
+                                 "dots_no_batch"),
+                        help="with --use_remat: what the checkpointed "
+                             "stacks keep (dot-saving policies are "
+                             "near-no-ops for convs; full/nothing is the "
+                             "meaningful setting)")
     parser.add_argument("--num_images_save", type=int, default=4)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_path", type=str, default="vae_ckpt")
@@ -110,16 +128,18 @@ def main(argv=None):
         args.vae_resume_path, args.auto_resume, args.output_path, "vae",
         candidates=("vae", "vae-final"), is_root=is_root,
     )
+    # compute policy, not an hparam (to_dict pops dtype): applied the
+    # same way on fresh start and resume, so the flag always wins.  The
+    # conv VAE has no residual stream; only the compute dtype applies.
+    precision = policy_from_flags(args.precision, args.bf16)
+
     resume_meta = None
     if args.vae_resume_path:
         resume_meta = load_meta(args.vae_resume_path)
         cfg = DiscreteVAEConfig.from_dict(resume_meta["hparams"])
-        # dtype is compute policy, not an hparam (to_dict pops it):
-        # re-apply the flag so --bf16 survives a resume
         import dataclasses as _dc
-        cfg = _dc.replace(
-            cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
-        )
+        cfg = _dc.replace(cfg, dtype=precision.compute_dtype)
+        check_optimizer_meta(resume_meta, args.mu_bf16)
         if args.image_size != cfg.image_size:
             import warnings
 
@@ -141,7 +161,9 @@ def main(argv=None):
             temperature=args.starting_temp,
             straight_through=args.straight_through,
             kl_div_loss_weight=args.kl_loss_weight,
-            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            use_remat=args.use_remat,
+            remat_policy=args.remat_policy,
+            dtype=precision.compute_dtype,
         )
     vae = DiscreteVAE(cfg)
 
@@ -239,6 +261,7 @@ def main(argv=None):
             epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict(),
+            optimizer_meta=optimizer_meta_from_args(args),
         )
         path = f"{args.output_path}/{name}"
         if ckpt_writer is not None:
@@ -248,56 +271,63 @@ def main(argv=None):
             ckpt_writer.wait()
         save_checkpoint(path, **kwargs)
 
-    for epoch in range(start_epoch, args.epochs):
-        resume_epoch = epoch
-        loader.set_epoch(epoch)
-        for images in device_prefetch(loader, batch_sharding(distr.mesh)):
-            params, opt_state, loss, recons = step_fn(
-                params, opt_state, images, temp, jax.random.fold_in(rng, global_step)
-            )
-            if global_step % 100 == 0:
-                # temperature anneal (reference: train_vae.py:218-221,269-271)
-                temp = max(
-                    start_temp * math.exp(-args.anneal_rate * global_step),
-                    args.temp_min,
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            resume_epoch = epoch
+            loader.set_epoch(epoch)
+            for images in device_prefetch(loader, batch_sharding(distr.mesh)):
+                params, opt_state, loss, recons = step_fn(
+                    params, opt_state, images, temp, jax.random.fold_in(rng, global_step)
                 )
-                lr = sched.step()
-                opt_state = set_learning_rate(opt_state, lr)
-                if is_root:
-                    k = args.num_images_save
-                    # local_rows: under multi-host prefetch the batch is
-                    # globally sharded; images[:k] would touch remote shards
-                    images_np = local_rows(images, k)
-                    codes = encode_fn(params, jnp.asarray(images_np))
-                    hard = np.asarray(decode_fn(params, codes))
-                    run.log_images("original", images_np, global_step)
-                    run.log_images("hard_recon", np.clip(hard, 0, 1), global_step)
-                    run.log_images(
-                        "soft_recon", np.clip(local_rows(recons, k), 0, 1), global_step
+                if global_step % 100 == 0:
+                    # temperature anneal (reference: train_vae.py:218-221,269-271)
+                    temp = max(
+                        start_temp * math.exp(-args.anneal_rate * global_step),
+                        args.temp_min,
                     )
-                    run.log_histogram(
-                        "codebook_indices", np.asarray(codes), global_step
+                    lr = sched.step()
+                    opt_state = set_learning_rate(opt_state, lr)
+                    if is_root:
+                        k = args.num_images_save
+                        # local_rows: under multi-host prefetch the batch is
+                        # globally sharded; images[:k] would touch remote shards
+                        images_np = local_rows(images, k)
+                        codes = encode_fn(params, jnp.asarray(images_np))
+                        hard = np.asarray(decode_fn(params, codes))
+                        run.log_images("original", images_np, global_step)
+                        run.log_images("hard_recon", np.clip(hard, 0, 1), global_step)
+                        run.log_images(
+                            "soft_recon", np.clip(local_rows(recons, k), 0, 1), global_step
+                        )
+                        run.log_histogram(
+                            "codebook_indices", np.asarray(codes), global_step
+                        )
+                        run.log({"temperature": temp, "lr": lr}, step=global_step)
+                if global_step % args.save_every_n_steps == 0:
+                    save("vae", in_loop=True)
+                if global_step % 10 == 0:
+                    # collective: every process enters average_all (multi-host
+                    # process_allgather); print/log stays root-gated below
+                    avg_loss = float(distr.average_all(loss))
+                if is_root and global_step % 10 == 0:
+                    dt = time.perf_counter() - t10
+                    t10 = time.perf_counter()
+                    sps = args.batch_size * 10 / dt if global_step else 0.0
+                    print(
+                        f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
+                        f"({sps:.1f} samples/s)"
                     )
-                    run.log({"temperature": temp, "lr": lr}, step=global_step)
-            if global_step % args.save_every_n_steps == 0:
-                save("vae", in_loop=True)
-            if global_step % 10 == 0:
-                # collective: every process enters average_all (multi-host
-                # process_allgather); print/log stays root-gated below
-                avg_loss = float(distr.average_all(loss))
-            if is_root and global_step % 10 == 0:
-                dt = time.perf_counter() - t10
-                t10 = time.perf_counter()
-                sps = args.batch_size * 10 / dt if global_step else 0.0
-                print(
-                    f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
-                    f"({sps:.1f} samples/s)"
-                )
-                run.log({"loss": avg_loss, "epoch": epoch, "samples_per_sec": sps},
-                        step=global_step)
-            global_step += 1
-        resume_epoch = epoch + 1
-    save("vae-final")
+                    run.log({"loss": avg_loss, "epoch": epoch, "samples_per_sec": sps},
+                            step=global_step)
+                global_step += 1
+            resume_epoch = epoch + 1
+        save("vae-final")
+    finally:
+        # drain the async writer on EVERY exit path — interpreter
+        # shutdown tears down executors before the writer thread
+        # joins, killing in-flight saves (ADVICE.md)
+        if ckpt_writer is not None:
+            ckpt_writer.wait()
     if is_root:
         run.log_artifact(args.output_path + "/vae-final", name="trained-vae")
         run.finish()
