@@ -82,6 +82,10 @@ PHASES = [
     # TPU wire model at the flagship shape + XLA cost-model cross-check at
     # the smoke shape) — records the bf16-stream/fused-FF byte reduction
     ("bytes_budget", 600, False),
+    # host-side ICI evidence: per-axis inter-chip bytes at each grad_comm
+    # wire width + the exposed-comm-time model for the three overlap
+    # levers at a flagship dp=4,fsdp=4,tp=2 mesh (closed-form, no chip)
+    ("comms_budget", 300, False),
     # extra-credit final rung: real LEARNING on the bench device — the
     # reference's rainbow-notebook workflow (synthetic shapes -> VAE ->
     # DALLE -> generated-token accuracy, SURVEY.md §4.2) trained for real
@@ -1070,6 +1074,47 @@ def _bytes_budget_bench():
     }
 
 
+def _comms_budget_bench():
+    """Per-axis ICI byte + exposed-comm-time budget (ISSUE: compressed
+    gradient reduction + decomposed TP collective-matmul + FSDP gather
+    prefetch) — the inter-chip sibling of ``bytes_budget``.  Entirely
+    closed-form (profiler.dalle_step_ici_bytes / dalle_step_comm_time via
+    tools/mfu_breakdown.py --comms), so the rung records even when the
+    chip has wedged mid-run.  Headlines:
+
+      * bf16 / int8 grad_comm reduction of the dp+fsdp grad-reduction
+        bytes vs f32 (exact arithmetic: 50% / ~74.6%);
+      * exposed-comm-time reduction of the composed levers
+        (grad_comm=bf16 + tp_overlap + fsdp_prefetch) vs baseline.
+    """
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mfu_breakdown", os.path.join(REPO, "tools", "mfu_breakdown.py")
+    )
+    mfu = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mfu)
+
+    smoke = _smoke()
+    b = 32
+    mesh = {"dp": 4, "fsdp": 4, "tp": 2}
+    rep = mfu.comms_report(_flagship_cfg(False), b, mesh)
+    return {
+        "metric": "exposed_comm_time_reduction",
+        "value": rep["exposed_time_reduction_vs_baseline"]["all_levers_bf16"],
+        "unit": "fraction_vs_baseline",
+        "mesh": mesh,
+        "grad_reduce_reduction_vs_f32":
+            rep["grad_reduce_reduction_vs_f32"],
+        "ici_gbytes_per_chip": rep["ici_gbytes_per_chip"],
+        "comm_time_ms": rep["comm_time_ms"],
+        "exposed_time_reduction_vs_baseline":
+            rep["exposed_time_reduction_vs_baseline"],
+        "smoke": smoke,
+        "batch": b,
+    }
+
+
 def _ingest_bench():
     from dalle_tpu.data.ingest_bench import ingest_benchmark
 
@@ -1094,6 +1139,7 @@ PHASE_FNS = {
     "generate_int8": lambda: _generate_bench(quant=True),
     "ingest": _ingest_bench,
     "bytes_budget": _bytes_budget_bench,
+    "comms_budget": _comms_budget_bench,
     "rainbow": _rainbow_bench,
 }
 

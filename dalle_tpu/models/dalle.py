@@ -123,6 +123,12 @@ class DALLEConfig:
     kv_int8: bool = False
     # fused GEGLU FF (ops/fused_ff.py) — compute policy like use_flash
     fused_ff: bool = False
+    # decomposed tp collective-matmul rings (parallel/overlap.py) — compute
+    # policy; needs tp>1 in the mesh and no sp, falls back silently else
+    tp_overlap: bool = False
+    # fsdp param-gather prefetch under scan_layers (transformer.py
+    # ScanStack) — compute policy
+    fsdp_prefetch: bool = False
     dtype: Any = jnp.float32
     # residual-stream wire dtype (training/precision.py "bf16_stream");
     # compute policy like dtype — never an hparam
@@ -190,6 +196,8 @@ class DALLEConfig:
             quant_mode=self.quant_mode,
             kv_int8=self.kv_int8,
             fused_ff=self.fused_ff,
+            tp_overlap=self.tp_overlap,
+            fsdp_prefetch=self.fsdp_prefetch,
             dtype=self.dtype,
             stream_dtype=self.stream_dtype,
         )
@@ -204,6 +212,8 @@ class DALLEConfig:
         d.pop("stream_dtype")
         d.pop("use_flash")
         d.pop("fused_ff")
+        d.pop("tp_overlap")
+        d.pop("fsdp_prefetch")
         d["attn_types"] = list(self.attn_types)
         return d
 
@@ -213,6 +223,8 @@ class DALLEConfig:
         # pre-r5 checkpoints serialized use_flash; it is compute policy now
         d.pop("use_flash", None)
         d.pop("fused_ff", None)
+        d.pop("tp_overlap", None)
+        d.pop("fsdp_prefetch", None)
         d.pop("stream_dtype", None)
         d["attn_types"] = tuple(d.get("attn_types", ("full",)))
         return cls(**d)

@@ -154,6 +154,18 @@ class TransformerConfig:
     # XLA elsewhere).  Compute policy like use_flash — never an hparam.
     # Requires ff_dropout inactive; the unfused path serves dropout.
     fused_ff: bool = False
+    # decomposed tp collective-matmul (parallel/overlap.py): shard_map
+    # ppermute rings overlap the per-chunk projection dots with the tp
+    # all-gather / reduce-scatter hops, with the residual stream
+    # sequence-sharded over 'tp' between layers.  Same bytes as the
+    # baseline all-reduce, less exposure.  Compute policy like use_flash
+    # — never an hparam.  Needs tp>1 in the ambient mesh, seq % tp == 0,
+    # no sp, no quant_int8, dropout inactive; falls back silently else.
+    tp_overlap: bool = False
+    # fsdp param-gather prefetch (requires scan_layers): layer i+1's
+    # param all-gather is issued during layer i's compute via a manual
+    # double-buffered lax.scan instead of nn.scan.  Compute policy.
+    fsdp_prefetch: bool = False
     dtype: Any = jnp.float32
     # residual-stream wire dtype (training/precision.py "bf16_stream"):
     # the [b, n, d] stream itself is cast to this at stack entry, so the
@@ -219,6 +231,13 @@ def _constrain_activations(x, cfg: "TransformerConfig"):
     sp = cfg.sp_axis if cfg.sp_axis in have else None
     if sp is not None and x.shape[1] % mesh.shape[sp] != 0:
         sp = None
+    if (sp is None and cfg.sp_axis is None and cfg.tp_overlap
+            and "tp" in have and mesh.shape["tp"] > 1
+            and x.shape[1] % mesh.shape["tp"] == 0):
+        # tp_overlap sequence-shards the residual over 'tp' between layers
+        # (Korthikanti-style): the reduce-scatter rings leave it there, the
+        # next layer's gather ring picks it up
+        sp = "tp"
     wanted = tuple(a for a in ("dp", "fsdp") if a in have)
     sp_dropped = cfg.sp_axis in have and sp is None
     if batch_axes != wanted or sp_dropped:
@@ -505,6 +524,28 @@ class FeedForward(nn.Module):
     def __call__(self, x, deterministic=True):
         c = self.cfg
         dropout_active = c.ff_dropout > 0.0 and not deterministic
+        if c.tp_overlap and not c.quant_int8 and not dropout_active:
+            # decomposed collective-matmul (parallel/overlap.py): wi rides
+            # the sequence all-gather ring (GEGLU applied per chunk), wo
+            # rides the reduce-scatter ring.  Takes precedence over
+            # fused_ff — the per-chunk dots already avoid materializing
+            # the full [n, 2*inner] pre-activation on any one device.
+            # Dropout sits between the rings, so the unfused dense path
+            # serves it.
+            from dalle_tpu.parallel import overlap
+
+            ov = overlap.tp_overlap_mesh(c, x.shape[0], x.shape[1])
+            if ov is not None:
+                inner = c.dim * c.ff_mult
+                x, wi_k, wi_b, wo_k, wo_b = nn.dtypes.promote_dtype(
+                    x, self.wi.kernel, self.wi.bias,
+                    self.wo.kernel, self.wo.bias, dtype=c.dtype,
+                )
+                h = overlap.all_gather_geglu_matmul(
+                    x, wi_k.reshape(c.dim, 2, inner),
+                    wi_b.reshape(2, inner), mesh=ov,
+                )
+                return overlap.matmul_reduce_scatter(h, wo_k, wo_b, mesh=ov)
         if c.fused_ff and not c.quant_int8 and not dropout_active:
             from dalle_tpu.ops.fused_ff import geglu_ff
 
@@ -536,7 +577,12 @@ class JointAttention(nn.Module):
         inner = c.heads * c.dim_head
         kv_inner = c.num_kv_heads * c.dim_head
         self.to_qkv = _proj(c, inner + 2 * kv_inner, "qkv", use_bias=False)
-        self.to_out = _proj(c, c.dim, "out")
+        if c.quant_int8:
+            self.to_out = _proj(c, c.dim, "out")
+        else:
+            # DenseParams ≡ nn.Dense (same param names/shapes/init) but
+            # exposes kernel/bias for the tp_overlap reduce-scatter ring
+            self.to_out = DenseParams(inner, c.dim, dtype=c.dtype, name="out")
         self.drop = nn.Dropout(c.attn_dropout)
         if c.rotary:
             self._angles = dalle_rotary_angles(
@@ -569,9 +615,40 @@ class JointAttention(nn.Module):
             return k, v
         return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
 
+    def _overlap_mesh(self, x):
+        c = self.cfg
+        if not c.tp_overlap or c.quant_int8:
+            return None
+        from dalle_tpu.parallel import overlap
+
+        return overlap.tp_overlap_mesh(c, x.shape[0], x.shape[1])
+
+    def _project_out(self, out, ov, deterministic):
+        """Output projection: matmul-reduce-scatter ring under tp_overlap
+        (out arrives feature-sharded from the head-sharded attention; the
+        result leaves sequence-sharded), dense ``to_out`` otherwise.
+        Dropout runs after either — same global shape, same rng stream."""
+        if ov is not None:
+            from dalle_tpu.parallel import overlap
+
+            y, k_, b_ = nn.dtypes.promote_dtype(
+                out, self.to_out.kernel, self.to_out.bias, dtype=self.cfg.dtype
+            )
+            y = overlap.matmul_reduce_scatter(y, k_, b_, mesh=ov)
+            return self.drop(y, deterministic=deterministic)
+        return self.drop(self.to_out(out), deterministic=deterministic)
+
     def __call__(self, x, key_pad_mask=None, deterministic=True):
         c = self.cfg
         b, n, _ = x.shape
+        ov = self._overlap_mesh(x)
+        if ov is not None:
+            # explicit ring gather of the tp-sequence-sharded residual
+            # (same bytes as GSPMD's all-gather, hop-pipelined); qkv then
+            # runs column-parallel on the replicated sequence
+            from dalle_tpu.parallel import overlap
+
+            x = overlap.ring_all_gather(x, mesh=ov)
         q, k, v = self._heads(self.to_qkv(x), n)
         if self._angles is not None:
             ang = jnp.asarray(self._angles)
@@ -585,7 +662,7 @@ class JointAttention(nn.Module):
             # _full_or_sparse expands for every other consumer
             out = self._full_or_sparse(q, k, v, key_pad_mask)
             out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
-            return self.drop(self.to_out(out), deterministic=deterministic)
+            return self._project_out(out, ov, deterministic)
         k, v = self._expand_kv(k, v)
         if not c.causal:
             # bidirectional (CLIP encoders): flash handles the ragged
@@ -639,7 +716,7 @@ class JointAttention(nn.Module):
                     q, k, v, t, f, c.kernel_size, c.dilation, key_pad_mask
                 )
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
-        return self.drop(self.to_out(out), deterministic=deterministic)
+        return self._project_out(out, ov, deterministic)
 
     def _sp_mesh(self, f):
         """The ambient mesh when this layer can run its structured attend
@@ -1101,7 +1178,18 @@ class ScanGroup(nn.Module):
 class ScanStack(nn.Module):
     """jax.lax.scan over ``depth // cycle`` ScanGroups with stacked params
     (leading [groups] axis on every leaf) — ONE traced/compiled layer body
-    regardless of depth (the MaxText/T5X pattern)."""
+    regardless of depth (the MaxText/T5X pattern).
+
+    ``cfg.fsdp_prefetch`` swaps nn.scan for a manual, double-buffered
+    lax.scan over the SAME stacked params: each iteration first issues the
+    sharding constraint that all-gathers group g+1's fsdp-sharded slice,
+    then computes group g from the already-gathered buffer riding the
+    carry — the gather has no data dependence on the compute, so XLA's
+    latency-hiding scheduler overlaps it (the MaxText prefetch idiom).
+    Costs one extra group of gathered params resident (the double
+    buffer).  Init always takes the nn.scan path, so the parameter
+    structure is identical and any checkpoint works with either setting.
+    """
 
     cfg: TransformerConfig
 
@@ -1117,6 +1205,12 @@ class ScanStack(nn.Module):
             ],
             jnp.float32,
         )  # [groups, cycle]
+        if c.fsdp_prefetch and self.scope is not None and not self.is_initializing():
+            mesh = self._prefetch_mesh()
+            if mesh is not None:
+                return self._prefetch_forward(
+                    x, consts, key_pad_mask, deterministic, mesh
+                )
         scanned = nn.scan(
             ScanGroup,
             variable_axes={"params": 0},
@@ -1125,6 +1219,82 @@ class ScanStack(nn.Module):
             length=groups,
         )
         x, _ = scanned(c, name="layers")(x, consts, key_pad_mask, deterministic)
+        return x
+
+    def _prefetch_mesh(self):
+        """Ambient mesh when the prefetch path pays for itself: an fsdp
+        axis > 1 actually gathers; otherwise the nn.scan path is the same
+        program without the double buffer."""
+        from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+        mesh = get_ambient_mesh()
+        if mesh is None or dict(mesh.shape).get("fsdp", 1) <= 1:
+            return None
+        return mesh
+
+    def _prefetch_forward(self, x, consts, key_pad_mask, deterministic, mesh):
+        """Double-buffered manual scan.  Group g's gathered params ride the
+        carry; the xs row for iteration g holds group (g+1) % groups'
+        SHARDED slice (a roll keeps shapes uniform — the final iteration
+        re-gathers group 0 and discards it, which XLA drops as dead code
+        in forward and contributes zero gradient in backward)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+
+        from dalle_tpu.parallel.partition import param_specs
+
+        c = self.cfg
+        stacked = self.variables["params"]["layers"]
+        # same specs the real ("…/scan/layers/…") leaves get — _spec_for
+        # keys on the path suffix and the scan/layers substring
+        specs = param_specs({"scan": {"layers": stacked}}, mesh)["scan"]["layers"]
+
+        def slice_spec(spec):
+            # drop the leading depth axis, free the fsdp dim = the layout
+            # of one group's params after its all-gather
+            return _P(*[None if d == "fsdp" else d for d in list(spec)[1:]])
+
+        gspecs = jax.tree_util.tree_map(
+            slice_spec, specs, is_leaf=lambda s: isinstance(s, _P)
+        )
+
+        def gather(pslice):
+            return jax.tree_util.tree_map(
+                lambda a, s: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, s)
+                ),
+                pslice, gspecs,
+            )
+
+        need_drop = (not deterministic) and (
+            c.attn_dropout > 0 or c.ff_dropout > 0
+        )
+        # per-group keys via fold_in (independent streams; the nn.scan
+        # path splits instead — the two paths replay dropout differently,
+        # like every other compute-policy lever with active dropout)
+        key = self.make_rng("dropout") if need_drop else jax.random.PRNGKey(0)
+        groups = consts.shape[0]
+        keys = jax.vmap(lambda g: jax.random.fold_in(key, g))(
+            jnp.arange(groups)
+        )
+        rolled = jax.tree_util.tree_map(
+            lambda a: jnp.roll(a, -1, axis=0), stacked
+        )
+        group = ScanGroup(c)
+
+        def body(carry, inp):
+            y, cur = carry
+            nxt_shard, consts_g, key_g = inp
+            nxt = gather(nxt_shard)  # prefetch: no dep on the compute below
+            rngs = {"dropout": key_g} if need_drop else None
+            y, _ = group.apply(
+                {"params": cur}, y, consts_g, key_pad_mask, deterministic,
+                rngs=rngs,
+            )
+            return (y, nxt), None
+
+        cur0 = gather(jax.tree_util.tree_map(lambda a: a[0], stacked))
+        (x, _), _ = jax.lax.scan(body, (x, cur0), (rolled, consts, keys))
         return x
 
 

@@ -67,6 +67,38 @@ def make_mesh(
     return Mesh(dev_array, AXES)
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """Version-portable ``shard_map``.
+
+    ``jax.shard_map(..., check_vma=)`` is the modern spelling; on older
+    releases (like the pinned 0.4.x) the entry point lives in
+    ``jax.experimental.shard_map`` and the flag is ``check_rep``.  Every
+    manual-collectives region in this repo (ring/pipeline/ulysses/usp/
+    overlap/compress) goes through this wrapper so the call sites stay on
+    the modern spelling."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma,
+            )
+        except AttributeError:
+            pass  # deprecation stub without a real implementation
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def named_axis_size(axis_name) -> int:
+    """Version-portable ``jax.lax.axis_size`` for shard_map bodies: on older
+    releases without it, ``psum(1, axis)`` constant-folds to the (static)
+    axis size."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 _AMBIENT: list = [None]
 
 
@@ -88,6 +120,19 @@ class ambient:
 
 def get_ambient_mesh() -> Optional[Mesh]:
     return _AMBIENT[-1]
+
+
+def axis_sizes(mesh) -> dict:
+    """{axis: size} for a Mesh, a {axis: size} dict, or None (empty).
+
+    The analytic comms model (training/profiler.dalle_step_ici_bytes) and the
+    manual-collective train paths accept either a live Mesh or a plain dict so
+    the model can be evaluated for meshes larger than the attached devices."""
+    if mesh is None:
+        return {}
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    return {name: int(s) for name, s in zip(mesh.axis_names, mesh.devices.shape)}
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:

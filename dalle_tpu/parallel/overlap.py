@@ -1,0 +1,187 @@
+"""Decomposed tensor-parallel collective-matmul (``--tp_overlap``).
+
+XLA lowers Megatron-style tp as (full matmul) -> (all-reduce): the ICI hops
+serialize behind the dots.  This module decomposes each tp boundary into a
+``shard_map`` ppermute ring where per-chunk dots overlap the hops (the
+collective-matmul of Wang et al. / FastUSP's multi-level-overlap idea,
+PAPERS.md), with the residual stream *sequence-sharded* over tp between
+layers (Korthikanti-style sequence parallelism inside the tp group):
+
+  * ``ring_all_gather``         — assemble the full sequence from n-shards
+                                  (attention input: every head needs every
+                                  position);
+  * ``all_gather_geglu_matmul`` — FF up-projection fused with the gather
+                                  ring: each hop's incoming x-chunk is
+                                  immediately matmul'd against the local
+                                  column shard and GEGLU-gated;
+  * ``matmul_reduce_scatter``   — FF down- / attention-out projection:
+                                  row-shard partial sums ride the ring,
+                                  each device keeps only its n-chunk.
+
+Byte accounting: the all-gather + reduce-scatter pair moves exactly the
+``2*(P-1)/P * b*n*d`` bytes of the baseline all-reduce — ``--tp_overlap``
+changes *exposure*, not volume (profiler.dalle_step_ici_bytes is
+lever-invariant; dalle_step_comm_time models the exposure cut).
+
+Numerics: per-chunk dots are row-slices of the same matmuls, so the only
+reassociation is the cross-shard partial-sum order in the reduce-scatter —
+the same reassociation the baseline all-reduce performs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dalle_tpu.parallel.mesh import get_ambient_mesh, named_axis_size, shard_map
+
+_BATCH = ("dp", "fsdp")
+
+
+def tp_overlap_mesh(cfg, batch: int, seq_len: int):
+    """The ambient mesh when the decomposed tp path can run: ``tp_overlap``
+    set, tp axis > 1, sequence divisible by tp (decode's n=1 falls back
+    naturally), batch divisible by dp*fsdp (shard_map in_specs are strict
+    where with_sharding_constraint merely relaxes), no sp (the residual's
+    sequence dim can carry one axis), no pipeline (the ring would nest
+    inside the stage shard_map), and not the int8-decode param format.
+    None -> caller uses the dense path (GSPMD inserts the baseline
+    all-reduces)."""
+    if not getattr(cfg, "tp_overlap", False):
+        return None
+    if getattr(cfg, "quant_int8", False) or getattr(cfg, "sp_axis", None):
+        return None
+    if getattr(cfg, "pp_stages", 1) > 1:
+        return None
+    mesh = get_ambient_mesh()
+    if mesh is None or "tp" not in mesh.shape:
+        return None
+    tp = mesh.shape["tp"]
+    if tp <= 1 or seq_len % tp != 0:
+        return None
+    bprod = 1
+    for a in _BATCH:
+        bprod *= mesh.shape.get(a, 1)
+    if batch % bprod != 0:
+        return None
+    return mesh
+
+
+def _ring_perm(p: int):
+    return [(j, (j + 1) % p) for j in range(p)]
+
+
+def _gather_chunks(x_loc, axis_name: str, compute):
+    """Core ring: rotate this device's x chunk p-1 times, applying
+    ``compute`` to each incoming chunk, and return the per-chunk results
+    stacked in GLOBAL chunk order [p, ...].  After s hops device i holds
+    the chunk that started on device (i - s) mod p."""
+    p = named_axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(p)
+
+    def step(carry, _):
+        y = compute(carry)
+        nxt = jax.lax.ppermute(carry, axis_name, perm)
+        return nxt, y
+
+    if p == 1:
+        return compute(x_loc)[None]
+    last, ys = jax.lax.scan(step, x_loc, jnp.arange(p - 1))
+    ys = jnp.concatenate([ys, compute(last)[None]], axis=0)  # step order
+    cids = (i - jnp.arange(p)) % p
+    return jnp.zeros_like(ys).at[cids].set(ys)  # global chunk order
+
+
+def ring_all_gather(x, *, axis: str = "tp", mesh=None):
+    """[b, n, d] sequence-sharded over ``axis`` -> replicated full sequence,
+    via p-1 ppermute hops ((P-1)/P * b*n*d bytes, the ring lower bound)."""
+    mesh = mesh or get_ambient_mesh()
+
+    def body(x_loc):
+        chunks = _gather_chunks(x_loc, axis, lambda c: c)  # [p, b_l, nc, d]
+        pp, bl, nc, d = chunks.shape
+        return chunks.transpose(1, 0, 2, 3).reshape(bl, pp * nc, d)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=P(_BATCH, axis, None), out_specs=P(_BATCH, None, None),
+        check_vma=False,
+    )(x)
+
+
+def all_gather_geglu_matmul(x, w3, b2, *, axis: str = "tp", mesh=None):
+    """FF up-projection overlapped with the sequence all-gather.
+
+    ``x`` [b, n, d] sequence-sharded; ``w3`` [d, 2, F] is the GEGLU ``wi``
+    kernel reshaped so its value/gate column PAIRS shard together over the
+    last dim (a contiguous [d, 2F] column shard would put values on one
+    device and their gates on another); ``b2`` [2, F] likewise.  Each ring
+    hop matmuls the incoming x-chunk against the local column shard and
+    gates it immediately, so the [.., 2F] pre-activation never exists for
+    more than one chunk.  Returns [b, n, F] feature-sharded over ``axis``.
+    """
+    mesh = mesh or get_ambient_mesh()
+
+    def body(x_loc, w_loc, b_loc):
+        def compute(xc):
+            y2 = jnp.tensordot(xc, w_loc, axes=([2], [0])) + b_loc
+            return y2[..., 0, :] * jax.nn.gelu(y2[..., 1, :],
+                                               approximate=False)
+
+        chunks = _gather_chunks(x_loc, axis, compute)  # [p, b_l, nc, F_l]
+        pp, bl, nc, f = chunks.shape
+        return chunks.transpose(1, 0, 2, 3).reshape(bl, pp * nc, f)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(_BATCH, axis, None), P(None, None, axis), P(None, axis)),
+        out_specs=P(_BATCH, None, axis),
+        check_vma=False,
+    )(x, w3, b2)
+
+
+def matmul_reduce_scatter(h, w, bias, *, axis: str = "tp", mesh=None):
+    """Row-parallel projection with the reduce ring overlapped.
+
+    ``h`` [b, n, F] feature-sharded over ``axis``; ``w`` [F, d] row-sharded;
+    ``bias`` [d] replicated (added once, after the full sum, matching the
+    baseline all-reduce-then-bias).  Returns [b, n, d] sequence-sharded:
+    device i ends holding sequence chunk i of the fully-summed output.
+    Each step matmuls ONE sequence chunk against the local row shard and
+    adds it to the accumulator riding the ring — p-1 hops of
+    [b_l, n/p, d] = (P-1)/P * b*n*d bytes.
+    """
+    mesh = mesh or get_ambient_mesh()
+
+    def body(h_loc, w_loc, b_full):
+        p = named_axis_size(axis)
+        i = jax.lax.axis_index(axis)
+        n = h_loc.shape[1]
+        nc = n // p
+        perm = _ring_perm(p)
+
+        def chunk_mm(c):
+            xs = jax.lax.dynamic_slice_in_dim(h_loc, c * nc, nc, axis=1)
+            return jnp.tensordot(xs, w_loc, axes=([2], [0]))
+
+        if p == 1:
+            return chunk_mm(jnp.asarray(0)) + b_full
+        acc = chunk_mm((i - 1) % p)
+
+        def step(acc, s):
+            acc = jax.lax.ppermute(acc, axis, perm)
+            return acc + chunk_mm((i - s - 1) % p), None
+
+        acc, _ = jax.lax.scan(step, acc, jnp.arange(1, p))
+        return acc + b_full  # device i now holds chunk i, fully summed
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(_BATCH, None, axis), P(axis, None), P(None)),
+        out_specs=P(_BATCH, axis, None),
+        check_vma=False,
+    )(h, w, bias)

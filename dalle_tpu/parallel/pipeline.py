@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from dalle_tpu.parallel.mesh import shard_map
+
 
 def gpipe(
     stage_fn: Callable[..., Any],
@@ -124,7 +126,7 @@ def gpipe(
             aux_total = jax.lax.pmean(aux_total, a)
         return out, aux_total
 
-    out, aux = jax.shard_map(
+    out, aux = shard_map(
         run,
         mesh=mesh,
         in_specs=(P(axis), P(dp_axes), P()),

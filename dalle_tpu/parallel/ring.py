@@ -40,6 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from dalle_tpu.parallel.mesh import named_axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -100,7 +102,7 @@ def _ring_schedule(k, v, init, attend, *, axis_name, causal, stride=1):
     parallel/usp.py): the rotation shifts by ``stride`` so each member
     exchanges with its same-rank peer in the neighbor group, and
     ``src``/liveness are group indices."""
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = named_axis_size(axis_name)
     if p_size % stride != 0:
         # hard error, not assert: under python -O a non-dividing stride
         # would silently truncate the schedule and the rotation would never
@@ -183,7 +185,7 @@ def ring_attention(
     only transiently inside its attend — SP interchip traffic shrinks by
     the group factor, which is exactly the long-sequence regime GQA+SP
     targets."""
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = named_axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name) // stride  # chunk (group) index
     b, h, nl, d = q.shape
     def expand(x):  # grouped (GQA) K/V -> full heads, per chunk
@@ -259,7 +261,7 @@ def _zigzag_schedule(k, v, c, init, quadrant, *, axis_name):
 
     lives HERE, once, for both quadrant implementations.
     ``quadrant(st, qhalf, khalf, k_cur, v_cur, kpos, diag) -> st``."""
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = named_axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     ar = jnp.arange(c)
 
@@ -340,7 +342,7 @@ def zigzag_ring_attention(
     Grouped-query K/V supported as in :func:`ring_attention`: the
     rotation moves the small grouped tensors; quadrants expand
     transiently."""
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = named_axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, nl, d = q.shape
     assert nl % 2 == 0, "zigzag needs an even local chunk (n % 2P == 0)"
@@ -483,7 +485,7 @@ def ring_attention_sharded(
             zigzag_ring_attention, axis_name=sp_axis, use_flash=use_flash
         )
         if key_pad_mask is None:
-            out = jax.shard_map(
+            out = shard_map(
                 lambda q, k, v: fn(q, k, v),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False,
@@ -491,7 +493,7 @@ def ring_attention_sharded(
         else:
             # mask stays in GLOBAL order — the kernel gathers by global
             # key position, so only q/k/v need the zigzag layout
-            out = jax.shard_map(
+            out = shard_map(
                 fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
                 out_specs=spec, check_vma=False,
             )(q[:, :, zzj], k[:, :, zzj], v[:, :, zzj], key_pad_mask)
@@ -501,12 +503,12 @@ def ring_attention_sharded(
         ring_attention, axis_name=sp_axis, causal=causal, use_flash=use_flash
     )
     if key_pad_mask is None:
-        return jax.shard_map(
+        return shard_map(
             lambda q, k, v: fn(q, k, v),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=False,
     )(q, k, v, key_pad_mask)

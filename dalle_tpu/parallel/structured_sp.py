@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from dalle_tpu.parallel.mesh import named_axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -127,7 +129,7 @@ def axial_attention_sp(
     tspec = P(bspec, "tp", None, None)
     fn = functools.partial(_axial_local, f=f, t=t)
     if kpm_t is None:
-        out_g = jax.shard_map(
+        out_g = shard_map(
             lambda qg, kg, vg, kt, vt: fn(qg, kg, vg, kt, vt, None),
             mesh=mesh,
             in_specs=(gspec, gspec, gspec, tspec, tspec),
@@ -135,7 +137,7 @@ def axial_attention_sp(
             check_vma=False,
         )(qg, kg, vg, kt, vt)
     else:
-        out_g = jax.shard_map(
+        out_g = shard_map(
             fn,
             mesh=mesh,
             in_specs=(gspec, gspec, gspec, tspec, tspec, P(bspec, None)),
@@ -155,7 +157,7 @@ def _conv_local(
     local grid ROWS), K/V halo-extended via ring ppermutes, static local
     window table, global-position validity masks."""
     b, h, _, _, d = qg.shape
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = named_axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     halo = (kernel_size - 1) // 2 * dilation
     assert halo <= fl, (
@@ -270,7 +272,7 @@ def conv_like_attention_sp(
         dilation=dilation, axis_name=sp_axis,
     )
     if kpm_t is None:
-        out_g = jax.shard_map(
+        out_g = shard_map(
             lambda qg, kg, vg, kt, vt: fn(qg, kg, vg, kt, vt, None),
             mesh=mesh,
             in_specs=(gspec, gspec, gspec, tspec, tspec),
@@ -278,7 +280,7 @@ def conv_like_attention_sp(
             check_vma=False,
         )(qg, kg, vg, kt, vt)
     else:
-        out_g = jax.shard_map(
+        out_g = shard_map(
             fn,
             mesh=mesh,
             in_specs=(gspec, gspec, gspec, tspec, tspec, P(bspec, None)),

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from dalle_tpu.parallel.mesh import named_axis_size, shard_map
+
 
 def ulysses_attention(
     q: jnp.ndarray,
@@ -42,7 +44,7 @@ def ulysses_attention(
     GLOBAL [b, n] (replicated — after the head→seq all_to_all the local
     attention sees the full sequence anyway).  Returns the local output
     chunk [b, h, n_local, d]."""
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = named_axis_size(axis_name)
     b, h, nl, d = q.shape
     assert h % p_size == 0, (
         f"ulysses needs tp-LOCAL heads % sp == 0, got local heads={h} "
@@ -120,13 +122,13 @@ def ulysses_attention_sharded(
         use_flash=use_flash,
     )
     if key_pad_mask is None:
-        return jax.shard_map(
+        return shard_map(
             lambda q, k, v: fn(q, k, v),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
     mspec = P(("dp", "fsdp"), None)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=False,
     )(q, k, v, key_pad_mask)

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from dalle_tpu.parallel.mesh import named_axis_size, shard_map
+
 from dalle_tpu.parallel.ring import ring_attention
 
 
@@ -45,7 +47,7 @@ def usp_attention(
     """Local view: q, k, v [b, h, n/P, d] with P = sp axis size; sequence
     sharded over the whole axis; ``ulysses`` must divide P and the local
     head count.  key_pad_mask: optional GLOBAL [b, n] (replicated)."""
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = named_axis_size(axis_name)
     b, h, nl, d = q.shape
     assert p_size % ulysses == 0, (
         f"sp axis {p_size} not divisible by ulysses degree {ulysses}"
@@ -117,12 +119,12 @@ def usp_attention_sharded(
         use_flash=use_flash,
     )
     if key_pad_mask is None:
-        return jax.shard_map(
+        return shard_map(
             lambda q, k, v: fn(q, k, v),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=False,
     )(q, k, v, key_pad_mask)

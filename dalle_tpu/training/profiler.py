@@ -214,6 +214,250 @@ def dalle_step_wire_bytes(cfg, batch: int) -> dict:
     return out
 
 
+# Approximate per-chip aggregate ICI bandwidth, GB/s (public figures rounded;
+# override via the ici_gbps argument of dalle_step_comm_time).  These feed a
+# planning model, not a benchmark: the *ratios* between axes and levers are
+# what the tests pin, absolute seconds are indicative only.
+ICI_GBPS = {"v4": 270.0, "v5e": 200.0, "v5p": 540.0, "v6e": 360.0}
+
+# Wire width of one gradient element under --grad_comm, in bytes.  int8
+# carries one f32 scale per 256-element bucket (parallel/compress.py), so its
+# effective width is 1 + 4/256 bytes/element.
+GRAD_COMM_BUCKET = 256
+GRAD_COMM_BYTES = {
+    "f32": 4.0,
+    "bf16": 2.0,
+    "int8": 1.0 + 4.0 / GRAD_COMM_BUCKET,
+}
+
+
+def _mesh_axis_sizes(mesh_shape) -> dict:
+    from ..parallel.mesh import axis_sizes
+
+    return axis_sizes(mesh_shape)
+
+
+def dalle_step_ici_bytes(cfg, batch: int, mesh_shape, *,
+                         grad_comm: str = "f32") -> dict:
+    """Analytic per-chip ICI bytes per train step, by mesh axis — the
+    inter-chip sibling of ``dalle_step_wire_bytes``.
+
+    ``mesh_shape`` is a ``Mesh`` or an ``{axis: size}`` dict (axes absent
+    default to 1), so the model can be evaluated for pod shapes larger than
+    the attached devices.  All collectives are costed at their ring/bandwidth
+    lower bounds, which XLA's ICI collectives achieve:
+
+      * ring all-reduce of B bytes over P chips moves ``2*(P-1)/P * B``
+        per chip; all-gather / reduce-scatter move ``(P-1)/P * B``;
+      * **fsdp**: params are gathered fwd + bwd at f32 master width and the
+        grad is reduce-scattered at the ``grad_comm`` wire width
+        (``GRAD_COMM_BYTES``: bf16 halves it, int8 is ~1.016 B/elem with
+        per-256-bucket scales);
+      * **dp**: ring all-reduce of the (fsdp-scattered) grad shard at the
+        ``grad_comm`` width;
+      * **tp**: Megatron-style 4 per-layer all-reduces (attn out + FF out,
+        fwd and bwd) of the [b_loc, n_sp, d] activation at compute width;
+        remat recomputes the forward psums (same policy fractions as the
+        wire model).  The decomposed collective-matmul (``--tp_overlap``)
+        moves the *same* bytes — it changes exposure, not volume — so this
+        term is lever-invariant (see ``dalle_step_comm_time``);
+      * **sp**: ring attention rotates K/V blocks, GQA-scaled
+        (``kv_inner``): (sp-1) hops of 2 blocks fwd, 2x that in bwd
+        (recompute ring + dK/dV rotation).  The zigzag schedule moves the
+        same bytes as contiguous (it balances causal *compute*); ulysses /
+        usp modes are costed as head-sharding all-to-alls instead;
+      * **pp**: one boundary activation fwd + one grad bwd per microbatch at
+        residual width; microbatching changes the bubble, not the bytes;
+      * **ep**: dispatch + combine all-to-alls on MoE layers, fwd + bwd.
+
+    Returns ``{dp, fsdp, tp, sp, pp, ep, grad_reduce, total}`` in bytes.
+    The six axis keys sum to ``total``; ``grad_reduce`` is an informational
+    subtotal (the grad_comm-sensitive part of dp + fsdp: the dp all-reduce
+    plus the fsdp reduce-scatter, excluding the f32 param gathers).
+    """
+    import jax.numpy as jnp
+
+    if grad_comm not in GRAD_COMM_BYTES:
+        raise ValueError(
+            f"grad_comm must be one of {sorted(GRAD_COMM_BYTES)}, "
+            f"got {grad_comm!r}")
+    sz = _mesh_axis_sizes(mesh_shape)
+    dp = sz.get("dp", 1)
+    fs = sz.get("fsdp", 1)
+    tp = sz.get("tp", 1)
+    sp = sz.get("sp", 1)
+    pp = sz.get("pp", 1)
+    ep = sz.get("ep", 1)
+    w = GRAD_COMM_BYTES[grad_comm]
+
+    d, L = cfg.dim, cfg.depth
+    n = cfg.total_seq_len
+    h, dh = cfg.heads, cfg.dim_head
+    inner = h * dh
+    kv_inner = (getattr(cfg, "kv_heads", None) or h) * dh
+    F = d * cfg.ff_mult
+    vt = cfg.total_text_tokens
+    vi = cfg.num_image_tokens
+    s_res = 2 if getattr(cfg, "stream_dtype", None) is not None else 4
+    s_act = 2 if cfg.dtype == jnp.bfloat16 else 4
+    b_loc = batch / (dp * fs)
+    n_sp = n / sp
+    L_pp = L / pp
+
+    # --- parameter element counts (mirrors dalle_step_wire_bytes) ----------
+    p_attn = d * (inner + 2 * kv_inner) + inner * d
+    p_ff = d * 2 * F + F * d
+    blk = L_pp * (p_attn + p_ff)          # stage-local transformer blocks
+    head = d * (vt + vi)                   # to_logits (tp col-parallel)
+    emb = ((cfg.num_text_tokens + cfg.text_seq_len) * d
+           + (vi + cfg.image_seq_len) * d)  # embedding tables (fsdp only)
+    n_loc = (blk + head) / tp + emb        # params resident per (dp,fsdp) rank
+
+    # --- dp / fsdp: param gathers + grad reduction --------------------------
+    fsdp_gather = 2.0 * (fs - 1) / fs * n_loc * 4.0      # fwd + bwd, f32
+    fsdp_reduce = (fs - 1) / fs * n_loc * w              # grad reduce-scatter
+    dp_bytes = 2.0 * (dp - 1) / dp * (n_loc / fs) * w    # ring all-reduce
+
+    # --- tp: per-layer activation all-reduces -------------------------------
+    extra_attn = extra_ff = 0.0
+    if getattr(cfg, "use_remat", False):
+        pol = getattr(cfg, "remat_policy", "full")
+        frac = 0.5 if pol in ("dots", "dots_saveable", "dots_no_batch") else 1.0
+        if pol != "ff_only":
+            extra_attn = frac
+        if pol != "attn_only":
+            extra_ff = frac
+    psums_per_layer = 4.0 + extra_attn + extra_ff
+    act = b_loc * n_sp * d * s_act
+    tp_bytes = L_pp * psums_per_layer * 2.0 * (tp - 1) / tp * act
+
+    # --- sp: ring K/V hops (or ulysses head all-to-alls), GQA-scaled --------
+    mode = getattr(cfg, "sp_mode", "ring")
+    if sp <= 1:
+        sp_fwd = 0.0
+    elif mode == "ulysses":
+        sp_fwd = ((sp - 1) / sp * b_loc * n_sp
+                  * (2 * inner + 2 * kv_inner) * s_act)
+    elif mode == "usp":
+        u = max(int(getattr(cfg, "sp_ulysses", 1)), 1)
+        r = max(sp // u, 1)
+        sp_fwd = (r - 1) * 2.0 * b_loc * (n / r) * (kv_inner / u) * s_act
+        sp_fwd += ((u - 1) / u * b_loc * n_sp
+                   * (2 * inner + 2 * kv_inner) * s_act)
+    else:  # ring (contiguous or zigzag schedule: identical bytes)
+        sp_fwd = (sp - 1) * 2.0 * b_loc * n_sp * kv_inner * s_act
+    sp_bytes = L_pp * 3.0 * sp_fwd       # fwd + recompute ring + dK/dV hops
+
+    # --- pp: boundary activations, fwd + bwd --------------------------------
+    pp_bytes = 2.0 * (pp - 1) / pp * b_loc * n_sp * d * s_res
+
+    # --- ep: MoE dispatch/combine all-to-alls -------------------------------
+    ep_bytes = 0.0
+    if getattr(cfg, "moe_experts", 0) and ep > 1:
+        every = max(int(getattr(cfg, "moe_every", 1)), 1)
+        n_moe = L_pp / every
+        top_k = max(int(getattr(cfg, "moe_top_k", 1) or 1), 1)
+        # dispatch + combine, fwd + bwd = 4 all-to-alls per MoE layer
+        ep_bytes = n_moe * 4.0 * (ep - 1) / ep * b_loc * n_sp * d * s_act * top_k
+
+    out = {
+        "dp": float(dp_bytes),
+        "fsdp": float(fsdp_gather + fsdp_reduce),
+        "tp": float(tp_bytes),
+        "sp": float(sp_bytes),
+        "pp": float(pp_bytes),
+        "ep": float(ep_bytes),
+    }
+    out["total"] = sum(out.values())
+    out["grad_reduce"] = float(dp_bytes + fsdp_reduce)
+    return out
+
+
+def dalle_step_comm_time(cfg, batch: int, mesh_shape, *,
+                         grad_comm: str = "f32",
+                         tp_overlap: bool = False,
+                         fsdp_prefetch: bool = False,
+                         pp_microbatches: Optional[int] = None,
+                         ici_gbps: Optional[float] = None,
+                         peak_tflops: Optional[float] = None) -> dict:
+    """Exposed-vs-overlapped comm-time estimate against the analytic compute
+    time — the arbiter for the three overlap levers (chip unreachable, so
+    this closed-form model plays the role the XLA cost model played for HBM).
+
+    Per-axis time is ``ici_bytes / ici_gbps`` (defaults: v5e bandwidth and
+    peak, override both for other chips).  Exposure model:
+
+      * **tp**: XLA serializes each layer all-reduce against the matmul that
+        feeds it, so baseline tp time is fully exposed; the decomposed
+        collective-matmul (``--tp_overlap``) pipelines tp chunks so only the
+        first hop of each ring is exposed — exposed ≈ t_tp / tp;
+      * **fsdp gathers**: exposed at each scan-layer boundary in the
+        baseline; ``--fsdp_prefetch`` double-buffers layer i+1's gather
+        under layer i's compute, leaving only the first layer's — exposed ≈
+        t_gather / depth;
+      * **grad reduction** (dp all-reduce + fsdp reduce-scatter): grads
+        emerge throughout the backward pass (~2/3 of compute time), so the
+        reduction overlaps that window and only the excess is exposed;
+      * **sp**: ring attention overlaps hops with per-block attention by
+        construction — exposed ≈ t_sp / sp;
+      * **pp**: bytes overlap with microbatch compute; the cost is the
+        GPipe bubble ``(pp-1)/(m+pp-1)`` of compute time;
+      * **ep**: all-to-alls sit on the critical path (fully exposed).
+
+    Returns ``{compute_s, per_axis_s, exposed_s, comm_total_s,
+    exposed_total_s, step_s, exposed_frac}``.
+    """
+    sz = _mesh_axis_sizes(mesh_shape)
+    dp = sz.get("dp", 1)
+    fs = sz.get("fsdp", 1)
+    tp = sz.get("tp", 1)
+    sp = sz.get("sp", 1)
+    pp = sz.get("pp", 1)
+    nchips = 1
+    for v in sz.values():
+        nchips *= max(int(v), 1)
+    bw = (ici_gbps if ici_gbps is not None else ICI_GBPS["v5e"]) * 1e9
+    peak = (peak_tflops if peak_tflops is not None
+            else PEAK_TFLOPS["v5e"]) * 1e12
+
+    bts = dalle_step_ici_bytes(cfg, batch, mesh_shape, grad_comm=grad_comm)
+    compute_s = dalle_train_flops(cfg, batch) / nchips / peak
+
+    t = {ax: bts[ax] / bw for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep")}
+    # split fsdp into its gather (f32) and reduce (grad_comm width) parts
+    w = GRAD_COMM_BYTES[grad_comm]
+    reduce_frac = ((fs - 1) / fs * w) / ((2.0 * (fs - 1) / fs * 4.0)
+                                         + (fs - 1) / fs * w) if fs > 1 else 0.0
+    t_fsdp_reduce = t["fsdp"] * reduce_frac
+    t_fsdp_gather = t["fsdp"] - t_fsdp_reduce
+
+    exposed = {}
+    exposed["tp"] = t["tp"] / tp if (tp_overlap and tp > 1) else t["tp"]
+    exposed["fsdp_gather"] = (t_fsdp_gather / max(cfg.depth, 1)
+                              if fsdp_prefetch else t_fsdp_gather)
+    t_reduce = t["dp"] + t_fsdp_reduce
+    bwd_window = (2.0 / 3.0) * compute_s
+    exposed["grad_reduce"] = max(0.0, t_reduce - bwd_window)
+    exposed["sp"] = t["sp"] / sp if sp > 1 else 0.0
+    m = pp_microbatches or getattr(cfg, "pp_microbatches", 1) or 1
+    exposed["pp_bubble"] = (compute_s * (pp - 1) / (m + pp - 1)
+                            if pp > 1 else 0.0)
+    exposed["ep"] = t["ep"]
+
+    exposed_total = sum(exposed.values())
+    comm_total = sum(t.values())
+    return {
+        "compute_s": float(compute_s),
+        "per_axis_s": {k: float(v) for k, v in t.items()},
+        "exposed_s": {k: float(v) for k, v in exposed.items()},
+        "comm_total_s": float(comm_total),
+        "exposed_total_s": float(exposed_total),
+        "step_s": float(compute_s + exposed_total),
+        "exposed_frac": float(exposed_total
+                              / max(compute_s + exposed_total, 1e-30)),
+    }
+
+
 def compiled_cost_analysis(compiled) -> dict:
     """Normalize an executable's ``cost_analysis()`` (list-or-dict across
     JAX versions) to a plain dict."""

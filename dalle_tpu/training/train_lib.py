@@ -138,12 +138,141 @@ def init_train_state(model, tx, mesh, init_rng, *example_args, **example_kw):
     return params, opt_state
 
 
+def _validate_grad_comm(grad_comm: str, mesh):
+    """Fail at step-construction time, not first trace: unknown wire modes
+    and model-parallel meshes are config errors the trainer should surface
+    before data loading starts."""
+    from dalle_tpu.parallel import compress
+    from dalle_tpu.parallel.mesh import axis_sizes
+
+    if grad_comm not in compress.GRAD_COMM_MODES:
+        raise ValueError(
+            f"--grad_comm {grad_comm!r}: expected one of "
+            f"{compress.GRAD_COMM_MODES}")
+    if grad_comm == "f32":
+        return
+    sizes = axis_sizes(mesh)
+    bad = {a: s for a, s in sizes.items()
+           if a in ("tp", "sp", "pp", "ep") and s > 1}
+    if bad:
+        raise ValueError(
+            f"--grad_comm {grad_comm} uses a manual dp/fsdp shard_map step; "
+            f"model-parallel mesh axes are unsupported there (got {bad}). "
+            "Use --grad_comm f32 with tp/sp/pp/ep meshes.")
+
+
+def _compressed_loss_and_grads(
+    local_loss,
+    params,
+    mesh,
+    grad_comm: str,
+    key,
+    batch_args,
+    rep_args=(),
+    aux_batch_sharded: bool = False,
+):
+    """Loss + grads with MANUAL dp/fsdp collectives at a compressed wire
+    width (parallel/compress.py) instead of XLA's f32 inserts.
+
+    ``local_loss(full_params, batch_args, rep_args, dropout_key) ->
+    (local_mean_loss, aux)`` runs per-device inside a ``shard_map`` over the
+    whole mesh: fsdp-sharded params are all-gathered (f32 — masters keep
+    full precision on the wire, only *grads* compress), the local grads are
+    then psum'd over dp and reduce-scattered over fsdp at the ``grad_comm``
+    width, and Adam later accumulates the dequantized f32 result (master
+    accumulation).  Model-parallel axes (tp/sp/pp/ep) must be size 1: their
+    collectives live inside the model and would need their own manual
+    lowering.  Each device gets a distinct fold of ``key`` (dropout masks
+    are drawn per-shard rather than globally — same distribution, different
+    stream than the GSPMD step).
+
+    Returns (loss, aux, grads) with grads sharded per partition.py specs.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dalle_tpu.parallel import compress
+    from dalle_tpu.parallel.mesh import ambient, axis_sizes, shard_map
+    from dalle_tpu.parallel.partition import param_specs
+
+    sizes = axis_sizes(mesh)
+    bad = {a: s for a, s in sizes.items()
+           if a in ("tp", "sp", "pp", "ep") and s > 1}
+    if bad:
+        raise ValueError(
+            f"--grad_comm {grad_comm} uses a manual dp/fsdp shard_map step; "
+            f"model-parallel mesh axes are unsupported there (got {bad}). "
+            "Use --grad_comm f32 with tp/sp/pp/ep meshes.")
+    dp = sizes.get("dp", 1)
+    fs = sizes.get("fsdp", 1)
+    ndev = dp * fs
+    axes = ("dp", "fsdp")
+    pspecs = param_specs(params, mesh)
+
+    def _fsdp_dim(spec):
+        for i, names in enumerate(spec):
+            if names is None:
+                continue
+            ns = names if isinstance(names, tuple) else (names,)
+            if "fsdp" in ns:
+                return i
+        return -1  # sentinel (None leaves would vanish from the pytree)
+
+    dims = jax.tree_util.tree_map(
+        _fsdp_dim, pspecs, is_leaf=lambda s: isinstance(s, P))
+
+    def body(p_sh, key, rep, *b_args):
+        idx = jax.lax.axis_index("dp") * fs + jax.lax.axis_index("fsdp")
+        kd = jax.random.fold_in(key, idx)
+
+        def gather(leaf, d):
+            if d < 0 or fs == 1:
+                return leaf
+            return jax.lax.all_gather(leaf, "fsdp", axis=d, tiled=True)
+
+        full = jax.tree_util.tree_map(gather, p_sh, dims)
+        with ambient(None):  # sharding constraints are meaningless in here
+            (loss, aux), g = jax.value_and_grad(
+                lambda p: local_loss(p, b_args, rep, kd), has_aux=True
+            )(full)
+
+        g_leaves, tdef = jax.tree_util.tree_flatten(g)
+        d_leaves = jax.tree_util.tree_leaves(dims)
+        out = []
+        for i, (gl, d) in enumerate(zip(g_leaves, d_leaves)):
+            kq = jax.random.fold_in(kd, 0x5EED + i)
+            if d >= 0 and fs > 1:
+                r = compress.compressed_reduce(
+                    gl, mode=grad_comm, key=kq, sum_axes=("dp",),
+                    scatter_axis="fsdp", scatter_dim=d, axis_size=fs)
+            else:
+                r = compress.compressed_reduce(
+                    gl, mode=grad_comm, key=kq, sum_axes=axes)
+            out.append(r / ndev)
+        grads = jax.tree_util.tree_unflatten(tdef, out)
+        loss = jax.lax.pmean(loss, axes)
+        if not aux_batch_sharded:
+            aux = jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, axes), aux)
+        return loss, aux, grads
+
+    bspec = P(("dp", "fsdp"))
+    aux_spec = bspec if aux_batch_sharded else P()
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P(), P(), *([bspec] * len(batch_args))),
+        out_specs=(P(), aux_spec, pspecs),
+        check_vma=False,
+    )
+    return fn(params, key, tuple(rep_args), *batch_args)
+
+
 def make_dalle_train_step(
     model: DALLE,
     tx: optax.GradientTransformation,
     mesh,
     vae: Optional[DiscreteVAE] = None,
     with_metrics: bool = False,
+    grad_comm: str = "f32",
 ):
     """Returns ``step(params, opt_state, vae_params, text, images_or_codes,
     dropout_key) -> (params, opt_state, loss)`` — plus a ``{name: scalar}``
@@ -153,7 +282,12 @@ def make_dalle_train_step(
     When ``vae`` is given, the image input is raw pixels [b,H,W,C] encoded to
     codes inside the step (reference: dalle_pytorch.py:535-542); otherwise it
     must already be int codes [b, image_seq_len].
+
+    ``grad_comm``: wire precision of the dp/fsdp gradient reduction —
+    ``"f32"`` keeps XLA's inserted collectives; ``"bf16"``/``"int8"`` switch
+    to the manual compressed reduction (``_compressed_loss_and_grads``).
     """
+    _validate_grad_comm(grad_comm, mesh)
     bspec = batch_sharding(mesh)
 
     def step(params, opt_state, vae_params, text, images, key):
@@ -168,18 +302,18 @@ def make_dalle_train_step(
         else:
             codes = images
 
-        def loss_fn(p):
+        def loss_fn(p, t, c, k):
             # mutable=["losses"] collects sown auxiliary losses (MoE load
             # balancing, models/moe.py); empty dict when the model has none.
             # "metrics" collects non-loss diagnostics when requested.
             collections = ["losses", "metrics"] if with_metrics else ["losses"]
             task_loss, mut = model.apply(
                 {"params": p},
-                text,
-                codes,
+                t,
+                c,
                 return_loss=True,
                 deterministic=False,
-                rngs={"dropout": key},
+                rngs={"dropout": k},
                 mutable=collections,
             )
             aux = sum(
@@ -199,7 +333,13 @@ def make_dalle_train_step(
             metrics = {k: jnp.mean(jnp.stack(v)) for k, v in by_name.items()}
             return task_loss + aux, metrics
 
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if grad_comm == "f32":
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, text, codes, key)
+        else:
+            loss, metrics, grads = _compressed_loss_and_grads(
+                lambda p, b, rep, k: loss_fn(p, b[0], b[1], k),
+                params, mesh, grad_comm, key, (text, codes))
         updates, new_opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_opt_state, loss, metrics
@@ -243,24 +383,37 @@ def make_dalle_eval_step(model: DALLE, mesh, vae: Optional[DiscreteVAE] = None):
     return wrapped
 
 
-def make_clip_train_step(clip, tx: optax.GradientTransformation, mesh):
+def make_clip_train_step(clip, tx: optax.GradientTransformation, mesh,
+                         grad_comm: str = "f32"):
     """CLIP contrastive training step (the reference trains CLIP only via a
     README snippet, reference: README.md:210-235 — here it is a first-class
-    jitted step): step(params, opt_state, text, images, key)."""
+    jitted step): step(params, opt_state, text, images, key).
+
+    NOTE the contrastive caveat under ``grad_comm != "f32"``: the manual
+    step computes the InfoNCE loss over each device's LOCAL [b_loc, b_loc]
+    similarity block (negatives don't cross shard boundaries), exactly like
+    per-replica contrastive training without a logit all-gather."""
+    _validate_grad_comm(grad_comm, mesh)
     bspec = batch_sharding(mesh)
 
     def step(params, opt_state, text, images, key):
-        def loss_fn(p):
+        def loss_fn(p, t, im, k):
             return clip.apply(
                 {"params": p},
-                text,
-                images,
+                t,
+                im,
                 return_loss=True,
                 deterministic=False,
-                rngs={"dropout": key},
+                rngs={"dropout": k},
             )
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if grad_comm == "f32":
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, text, images, key)
+        else:
+            loss, _, grads = _compressed_loss_and_grads(
+                lambda p, b, rep, k: (loss_fn(p, b[0], b[1], k), {}),
+                params, mesh, grad_comm, key, (text, images))
         updates, new_opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt_state, loss
 
@@ -275,24 +428,33 @@ def make_clip_train_step(clip, tx: optax.GradientTransformation, mesh):
     return wrapped
 
 
-def make_vae_train_step(model: DiscreteVAE, tx: optax.GradientTransformation, mesh):
+def make_vae_train_step(model: DiscreteVAE, tx: optax.GradientTransformation,
+                        mesh, grad_comm: str = "f32"):
     """Returns ``step(params, opt_state, images, temp, key) ->
     (params, opt_state, loss, recons)``.  Temperature is traced so Gumbel
     annealing (reference: train_vae.py:218-221,269-271) never recompiles."""
+    _validate_grad_comm(grad_comm, mesh)
     bspec = batch_sharding(mesh)
 
     def step(params, opt_state, images, temp, key):
-        def loss_fn(p):
+        def loss_fn(p, im, t, k):
             return model.apply(
                 {"params": p},
-                images,
+                im,
                 return_loss=True,
                 return_recons=True,
-                temp=temp,
-                rngs={"gumbel": key},
+                temp=t,
+                rngs={"gumbel": k},
             )
 
-        (loss, recons), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if grad_comm == "f32":
+            (loss, recons), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, images, temp, key)
+        else:
+            loss, recons, grads = _compressed_loss_and_grads(
+                lambda p, b, rep, k: loss_fn(p, b[0], rep[0], k),
+                params, mesh, grad_comm, key, (images,), rep_args=(temp,),
+                aux_batch_sharded=True)
         updates, new_opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_opt_state, loss, recons

@@ -22,12 +22,13 @@ import jax.numpy as jnp
 
 
 def loss_trajectory(cfg, mesh, *, steps=6, seed=0, vae=None, vae_params=None,
-                    batch=4, lr=1e-3):
+                    batch=4, lr=1e-3, grad_comm="f32"):
     """Train ``steps`` steps of ``DALLE(cfg)`` on ``mesh`` with fully
     deterministic data/init/dropout; returns the list of float losses.
 
     ``vae``/``vae_params`` may be shared across calls so the sharded and
-    single-device runs consume identical codes."""
+    single-device runs consume identical codes.  ``grad_comm`` selects the
+    wire precision of the dp/fsdp grad reduction (train_lib)."""
     from dalle_tpu.models.dalle import DALLE
     from dalle_tpu.training import (
         init_train_state,
@@ -53,7 +54,8 @@ def loss_trajectory(cfg, mesh, *, steps=6, seed=0, vae=None, vae_params=None,
     params, opt_state = init_train_state(
         model, tx, mesh, {"params": rng}, text, codes0
     )
-    step = make_dalle_train_step(model, tx, mesh, vae=vae)
+    step = make_dalle_train_step(model, tx, mesh, vae=vae,
+                                 grad_comm=grad_comm)
     losses = []
     for s in range(steps):
         key = jax.random.fold_in(jax.random.PRNGKey(seed + 1), s)

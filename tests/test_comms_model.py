@@ -1,0 +1,201 @@
+"""Closed-form pins for the analytic ICI comms model
+(training/profiler.dalle_step_ici_bytes / dalle_step_comm_time).
+
+Every expected value below is hand-derived from the collective cost
+identities, restated literally so the model cannot drift silently:
+
+  * ring all-reduce of B bytes over P chips : 2*(P-1)/P * B per chip
+  * all-gather / reduce-scatter            : (P-1)/P * B per chip
+  * tp: 4 per-layer psums of [b_loc, n_sp, d] activations
+  * sp ring: (sp-1) hops x 2 K/V blocks, GQA-scaled, x3 for bwd
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import pytest
+
+from dalle_tpu.models.dalle import DALLEConfig
+from dalle_tpu.parallel.mesh import axis_sizes
+from dalle_tpu.training.profiler import (
+    GRAD_COMM_BYTES,
+    dalle_step_comm_time,
+    dalle_step_ici_bytes,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        num_text_tokens=2000, text_seq_len=32, num_image_tokens=1024,
+        image_fmap_size=8, dim=64, depth=4, heads=4, dim_head=16,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def _param_elems(cfg, tp=1, pp=1):
+    """Per-(dp,fsdp)-rank resident param elements, restated by hand."""
+    d, L = cfg.dim, cfg.depth
+    inner = cfg.heads * cfg.dim_head
+    kv_inner = (cfg.kv_heads or cfg.heads) * cfg.dim_head
+    F = d * cfg.ff_mult
+    p_attn = d * (inner + 2 * kv_inner) + inner * d
+    p_ff = d * 2 * F + F * d
+    blk = (L / pp) * (p_attn + p_ff)
+    head = d * (cfg.total_text_tokens + cfg.num_image_tokens)
+    emb = ((cfg.num_text_tokens + cfg.text_seq_len) * cfg.dim
+           + (cfg.num_image_tokens + cfg.image_seq_len) * cfg.dim)
+    return (blk + head) / tp + emb
+
+
+def test_pure_dp_is_one_ring_allreduce():
+    """Mesh dp=8: the only traffic is the grad ring all-reduce of the full
+    resident param set at f32: 2*(8-1)/8 * N * 4 bytes."""
+    cfg = _cfg()
+    b = dalle_step_ici_bytes(cfg, 16, {"dp": 8})
+    n = _param_elems(cfg)
+    expect = 2.0 * 7 / 8 * n * 4.0
+    assert b["dp"] == pytest.approx(expect, rel=1e-12)
+    for ax in ("fsdp", "tp", "sp", "pp", "ep"):
+        assert b[ax] == 0.0
+    assert b["total"] == pytest.approx(expect, rel=1e-12)
+    assert b["grad_reduce"] == pytest.approx(expect, rel=1e-12)
+
+
+def test_dp_fsdp_gather_plus_scatter():
+    """Mesh dp=4, fsdp=2: fsdp = two f32 param all-gathers (fwd+bwd) plus one
+    grad reduce-scatter; dp = ring all-reduce of the HALF (scattered) shard."""
+    cfg = _cfg()
+    b = dalle_step_ici_bytes(cfg, 16, {"dp": 4, "fsdp": 2})
+    n = _param_elems(cfg)
+    gather = 2.0 * (1 / 2) * n * 4.0
+    scatter = (1 / 2) * n * 4.0
+    dp = 2.0 * (3 / 4) * (n / 2) * 4.0
+    assert b["fsdp"] == pytest.approx(gather + scatter, rel=1e-12)
+    assert b["dp"] == pytest.approx(dp, rel=1e-12)
+    assert b["grad_reduce"] == pytest.approx(dp + scatter, rel=1e-12)
+    assert b["total"] == pytest.approx(gather + scatter + dp, rel=1e-12)
+
+
+def test_tp_per_layer_psums():
+    """Mesh dp=2, fsdp=2, tp=2: tp bytes = depth x 4 psums x ring all-reduce
+    of the [b_loc, n, d] activation at compute width; block+head params (but
+    not embeddings) halve for the dp/fsdp terms."""
+    cfg = _cfg()
+    batch = 16
+    b = dalle_step_ici_bytes(cfg, batch, {"dp": 2, "fsdp": 2, "tp": 2})
+    b_loc = batch / 4
+    act = b_loc * cfg.total_seq_len * cfg.dim * 4  # f32 activations
+    tp_expect = cfg.depth * 4.0 * (2.0 * (1 / 2)) * act
+    assert b["tp"] == pytest.approx(tp_expect, rel=1e-12)
+    n = _param_elems(cfg, tp=2)
+    assert b["fsdp"] == pytest.approx(3.0 * (1 / 2) * n * 4.0, rel=1e-12)
+    assert b["dp"] == pytest.approx(2.0 * (1 / 2) * (n / 2) * 4.0, rel=1e-12)
+    # bf16 compute halves the tp activation bytes
+    b16 = dalle_step_ici_bytes(
+        dataclasses.replace(cfg, dtype=jnp.bfloat16), batch,
+        {"dp": 2, "fsdp": 2, "tp": 2})
+    assert b16["tp"] == pytest.approx(tp_expect / 2, rel=1e-12)
+
+
+def test_sp_ring_hops_gqa_scaled():
+    """Mesh dp=2, sp=4 with GQA kv_heads=2 (of 4): ring hop bytes carry only
+    the K/V width — 2 blocks of [b_loc, n/4, kv_inner] per hop, 3 hops fwd,
+    x3 total for the bwd recompute ring + dK/dV rotation, per layer."""
+    cfg = _cfg(kv_heads=2)
+    batch = 8
+    b = dalle_step_ici_bytes(cfg, batch, {"dp": 2, "sp": 4})
+    b_loc = batch / 2
+    kv_inner = 2 * cfg.dim_head
+    hop = 2.0 * b_loc * (cfg.total_seq_len / 4) * kv_inner * 4
+    expect = cfg.depth * 3.0 * (3 * hop)
+    assert b["sp"] == pytest.approx(expect, rel=1e-12)
+    # full-MHA sp bytes are kv_heads/heads times larger
+    full = dalle_step_ici_bytes(_cfg(), batch, {"dp": 2, "sp": 4})
+    assert full["sp"] == pytest.approx(expect * 2, rel=1e-12)
+    # zigzag schedule moves identical bytes (it balances causal compute)
+    zig = dalle_step_ici_bytes(
+        _cfg(kv_heads=2, sp_schedule="zigzag"), batch, {"dp": 2, "sp": 4})
+    assert zig["sp"] == b["sp"]
+
+
+def test_pp_boundary_bytes_microbatch_invariant():
+    """Mesh pp=2, dp=4: pp bytes = 2 (fwd+bwd) x (pp-1)/pp x boundary
+    activation at residual width; microbatch count must not change bytes
+    (it only changes the bubble)."""
+    cfg = _cfg()
+    batch = 8
+    b = dalle_step_ici_bytes(cfg, batch, {"pp": 2, "dp": 4})
+    b_loc = batch / 4
+    expect = 2.0 * (1 / 2) * b_loc * cfg.total_seq_len * cfg.dim * 4
+    assert b["pp"] == pytest.approx(expect, rel=1e-12)
+    b2 = dalle_step_ici_bytes(
+        dataclasses.replace(cfg, pp_microbatches=8), batch,
+        {"pp": 2, "dp": 4})
+    assert b2["pp"] == b["pp"]
+    # blocks split over stages: dp grad bytes shrink vs the pp=1 mesh
+    flat = dalle_step_ici_bytes(cfg, batch, {"dp": 4})
+    assert b["dp"] < flat["dp"]
+
+
+def test_grad_comm_widths_cut_reduction_bytes():
+    """bf16 halves the grad_reduce subtotal exactly; int8 cuts it by
+    1 - 1.015625/4 ~ 74.6%.  Param gathers (f32 masters) are unchanged."""
+    cfg = _cfg()
+    mesh = {"dp": 4, "fsdp": 2}
+    f32 = dalle_step_ici_bytes(cfg, 16, mesh, grad_comm="f32")
+    b16 = dalle_step_ici_bytes(cfg, 16, mesh, grad_comm="bf16")
+    i8 = dalle_step_ici_bytes(cfg, 16, mesh, grad_comm="int8")
+    assert b16["grad_reduce"] == pytest.approx(
+        0.5 * f32["grad_reduce"], rel=1e-12)
+    assert i8["grad_reduce"] == pytest.approx(
+        (GRAD_COMM_BYTES["int8"] / 4.0) * f32["grad_reduce"], rel=1e-12)
+    gather_f32 = f32["fsdp"] - ((f32["grad_reduce"]) - f32["dp"])
+    gather_b16 = b16["fsdp"] - ((b16["grad_reduce"]) - b16["dp"])
+    assert gather_f32 == pytest.approx(gather_b16, rel=1e-12)
+    with pytest.raises(ValueError):
+        dalle_step_ici_bytes(cfg, 16, mesh, grad_comm="fp8")
+
+
+def test_mesh_object_matches_dict(devices):
+    """A live Mesh and its {axis: size} dict cost identically."""
+    from dalle_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(dp=4, fsdp=2, devices=devices)
+    cfg = _cfg()
+    as_mesh = dalle_step_ici_bytes(cfg, 16, mesh)
+    as_dict = dalle_step_ici_bytes(cfg, 16, axis_sizes(mesh))
+    assert as_mesh == as_dict
+    assert axis_sizes(mesh)["dp"] == 4 and axis_sizes(mesh)["fsdp"] == 2
+
+
+def test_axis_keys_sum_to_total():
+    cfg = _cfg(kv_heads=2)
+    b = dalle_step_ici_bytes(
+        cfg, 32, {"dp": 2, "fsdp": 2, "tp": 2, "sp": 2, "pp": 2})
+    parts = sum(b[ax] for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    assert parts == pytest.approx(b["total"], rel=1e-12)
+
+
+def test_comm_time_levers_reduce_exposure():
+    """The exposure model must rank the levers the way the ISSUE claims:
+    each overlap lever strictly cuts its own axis's exposed time and the
+    total, and the compressed reduction cuts exposed grad-reduce time
+    whenever any is exposed."""
+    cfg = _cfg(scan_layers=True)
+    mesh = {"dp": 2, "fsdp": 2, "tp": 2}
+    base = dalle_step_comm_time(cfg, 512, mesh)
+    tp_ov = dalle_step_comm_time(cfg, 512, mesh, tp_overlap=True)
+    assert tp_ov["exposed_s"]["tp"] == pytest.approx(
+        base["exposed_s"]["tp"] / 2, rel=1e-12)
+    assert tp_ov["exposed_total_s"] < base["exposed_total_s"]
+    pf = dalle_step_comm_time(cfg, 512, mesh, fsdp_prefetch=True)
+    assert pf["exposed_s"]["fsdp_gather"] == pytest.approx(
+        base["exposed_s"]["fsdp_gather"] / cfg.depth, rel=1e-12)
+    if base["exposed_s"]["grad_reduce"] > 0:
+        b16 = dalle_step_comm_time(cfg, 512, mesh, grad_comm="bf16")
+        assert (b16["exposed_s"]["grad_reduce"]
+                < base["exposed_s"]["grad_reduce"])
+    assert 0.0 <= base["exposed_frac"] <= 1.0
+    assert base["step_s"] == pytest.approx(
+        base["compute_s"] + base["exposed_total_s"], rel=1e-12)

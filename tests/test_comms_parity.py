@@ -1,0 +1,165 @@
+"""Trajectory parity for the three ICI-exposure levers (comms budget PR).
+
+Each lever changes HOW bytes move (wire precision, ring decomposition,
+gather timing), never WHAT is computed — so the proof obligation is the
+same as for the precision policies: 5-step loss-trajectory parity against
+an XLA-collectives baseline on the 8-virtual-device CPU mesh
+(training/trajectory.py, rtol 2e-3).
+
+  * ``grad_comm bf16`` — dp/fsdp gradient reduction on a bf16 wire
+    (train_lib._compressed_loss_and_grads); master accumulation stays f32,
+    so only the reduction operands are rounded (~1e-3-class drift, same
+    band as the bf16 compute policies).
+  * ``grad_comm int8`` — EQuARX-style stochastic-rounded int8 with
+    per-256-bucket scales and an exact int32 wire sum.  Stochastic
+    rounding is unbiased but per-step noisier than bf16, so its
+    documented tolerance is looser (2e-2 here vs the repo-wide 2e-3);
+    drift measured on this config is ~3e-4.
+  * ``tp_overlap`` — decomposed collective-matmul rings
+    (parallel/overlap.py): per-chunk dots are row-slices of the baseline
+    matmuls, the only reassociation is the partial-sum order the baseline
+    all-reduce also has.
+  * ``fsdp_prefetch`` — double-buffered manual scan (transformer.py
+    ScanStack): identical math, different gather schedule; parity is
+    bit-exact in f32.
+
+The composed case stacks grad_comm bf16 on scan_layers + bf16_stream +
+fused_ff + fsdp_prefetch — the flagship memory/comms recipe."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_tpu.models.dalle import DALLEConfig
+from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
+from dalle_tpu.parallel import make_mesh
+from dalle_tpu.training.trajectory import (
+    assert_trajectory_parity,
+    loss_trajectory,
+)
+
+STEPS = 5
+BATCH = 8  # divisible by every batch-axis product below (dp*fsdp up to 8)
+
+VCFG = DiscreteVAEConfig(
+    image_size=16, num_tokens=64, codebook_dim=16, num_layers=2, hidden_dim=8
+)
+
+BASE = DALLEConfig(
+    num_text_tokens=64,
+    text_seq_len=8,
+    num_image_tokens=VCFG.num_tokens,
+    image_fmap_size=VCFG.fmap_size,
+    dim=32,
+    depth=2,
+    heads=2,
+    dim_head=16,
+)
+
+_POLICY = dict(
+    scan_layers=True, fused_ff=True,
+    dtype=jnp.bfloat16, stream_dtype=jnp.bfloat16,
+)
+
+# name -> (mesh factory, cfg, grad_comm, rtol)
+CASES = {
+    "grad_comm_bf16": (
+        lambda: make_mesh(dp=4, fsdp=2), BASE, "bf16", 2e-3,
+    ),
+    # stochastic rounding: unbiased but per-step noisier — documented
+    # looser bound (ISSUE 2 acceptance)
+    "grad_comm_int8": (
+        lambda: make_mesh(dp=4, fsdp=2), BASE, "int8", 2e-2,
+    ),
+    "tp_overlap": (
+        lambda: make_mesh(dp=2, fsdp=2, tp=2),
+        dataclasses.replace(BASE, tp_overlap=True), "f32", 2e-3,
+    ),
+    "fsdp_prefetch_scan": (
+        lambda: make_mesh(dp=2, fsdp=4),
+        dataclasses.replace(BASE, scan_layers=True, fsdp_prefetch=True),
+        "f32", 2e-3,
+    ),
+    # the levers must compose with the existing memory policies
+    "composed_scan_stream_fused": (
+        lambda: make_mesh(dp=2, fsdp=4),
+        dataclasses.replace(BASE, fsdp_prefetch=True, **_POLICY),
+        "bf16", 2e-3,
+    ),
+}
+
+
+@pytest.fixture(scope="module")
+def vae_and_params():
+    vae = DiscreteVAE(VCFG)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (2, 16, 16, 3))
+    vparams = vae.init(
+        {"params": rng, "gumbel": rng}, images, return_loss=True
+    )["params"]
+    return vae, vparams
+
+
+@pytest.fixture(scope="module")
+def single_trajectories(vae_and_params):
+    """Single-device XLA baselines with the LEVERS stripped but the
+    compute policy kept — the lever under test is the wire format /
+    schedule, so the baseline must run the same math through the stock
+    collectives."""
+    vae, vparams = vae_and_params
+    mesh1 = make_mesh(dp=1, devices=[jax.devices()[0]])
+    cache = {}
+
+    def get(cfg):
+        key = dataclasses.replace(cfg, tp_overlap=False, fsdp_prefetch=False)
+        if key not in cache:
+            cache[key] = loss_trajectory(
+                key, mesh1, steps=STEPS, vae=vae, vae_params=vparams,
+                batch=BATCH,
+            )
+        return cache[key]
+
+    return get
+
+
+@pytest.mark.slow  # ~15s/case on the 8-device CPU mesh — tier-2 budget
+@pytest.mark.parametrize("name", list(CASES))
+def test_lever_trajectory_matches_xla_baseline(
+    name, vae_and_params, single_trajectories
+):
+    vae, vparams = vae_and_params
+    mesh_fn, cfg, grad_comm, rtol = CASES[name]
+    sharded = loss_trajectory(
+        cfg, mesh_fn(), steps=STEPS, vae=vae, vae_params=vparams,
+        batch=BATCH, grad_comm=grad_comm,
+    )
+    single = single_trajectories(cfg)
+    assert_trajectory_parity(sharded, single, rtol=rtol, label=name)
+    assert sharded[-1] < sharded[0], f"{name}: loss did not decrease"
+
+
+def test_grad_comm_rejects_non_dp_fsdp_meshes():
+    """The manual reduction only replaces the dp/fsdp grad collectives;
+    composing it with tp/sp/pp/ep sharding must fail loudly, not corrupt
+    gradients silently."""
+    from dalle_tpu.models.dalle import DALLE
+    from dalle_tpu.training import make_dalle_train_step, make_optimizer
+
+    mesh = make_mesh(dp=2, fsdp=2, tp=2)
+    with pytest.raises(ValueError, match="grad_comm"):
+        make_dalle_train_step(
+            DALLE(BASE), make_optimizer(1e-3), mesh, grad_comm="bf16"
+        )
+
+
+def test_grad_comm_rejects_unknown_mode():
+    from dalle_tpu.models.dalle import DALLE
+    from dalle_tpu.training import make_dalle_train_step, make_optimizer
+
+    mesh = make_mesh(dp=8)
+    with pytest.raises(ValueError, match="grad_comm"):
+        make_dalle_train_step(
+            DALLE(BASE), make_optimizer(1e-3), mesh, grad_comm="fp8"
+        )

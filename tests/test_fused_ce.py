@@ -147,6 +147,12 @@ def test_fused_loss_under_tp_sharded_mesh():
     np.testing.assert_allclose(losses["fused"], losses["dense"], rtol=1e-5)
 
 
+@pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="tp×sp meshes NaN under XLA:CPU GSPMD — partitioner miscompile "
+    "(de-optimized execution is clean; see docs/SCALING.md known issue). "
+    "Run on TPU.",
+)
 def test_fused_loss_under_sp_mesh():
     """loss_chunk under sequence parallelism: the chunk scan reshapes the
     sp-sharded sequence axis, which GSPMD must handle without changing the

@@ -8,6 +8,7 @@ import pytest
 
 from dalle_tpu.ops import attention as A
 from dalle_tpu.parallel import make_mesh
+from dalle_tpu.parallel.mesh import shard_map
 from dalle_tpu.parallel.ring import ring_attention_sharded
 
 B, H, D = 2, 2, 16
@@ -121,7 +122,7 @@ def test_ring_causal_skip_schedule(rng, devices):
         return out, n[None]
 
     out, n_done = jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -152,7 +153,7 @@ def test_ring_non_causal_no_skip(rng, devices):
         return out, n[None]
 
     _, n_done = jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -232,7 +233,7 @@ def test_zigzag_ring_balanced_load(rng, devices):
 
     spec = P(("dp", "fsdp"), "tp", "sp", None)
     _, n_done = jax.jit(
-        jax.shard_map(
+        shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=(spec, P("sp")), check_vma=False,
         )
@@ -329,7 +330,7 @@ def test_ring_flash_skip_schedule_preserved(rng, devices):
         return out, n[None]
 
     out, n_done = jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),

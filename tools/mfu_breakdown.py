@@ -31,6 +31,14 @@ each named policy combination compiled at the flagship shape, per-variant
 reduction vs the f32 no-remat baseline.  Per-layer rows reflect the
 dtype/fused levers only (remat wrapping lives in the full Transformer),
 so read remat effects off the step/fwd_bwd rows.
+
+``--comms`` emits the inter-chip sibling: per-axis ICI bytes at each
+--grad_comm wire width plus the exposed-vs-overlapped comm-time estimate
+for every lever combination (baseline / grad_comm / --tp_overlap /
+--fsdp_prefetch / composed), for an arbitrary ``--mesh`` — closed-form,
+no devices needed:
+
+    python tools/mfu_breakdown.py --comms --mesh dp=4,fsdp=4,tp=2
 """
 
 import argparse
@@ -197,6 +205,86 @@ def policy_report(table):
     }
 
 
+def _parse_mesh(s):
+    """"dp=4,fsdp=4,tp=2" -> {"dp": 4, "fsdp": 4, "tp": 2}."""
+    out = {}
+    for kv in s.split(","):
+        k, _, v = kv.partition("=")
+        out[k.strip()] = int(v)
+    return out
+
+
+COMM_LEVERS = {
+    # name -> dalle_step_comm_time kwargs; the three ISSUE levers, alone
+    # and composed (grad_comm changes BYTES, the overlaps change EXPOSURE)
+    "baseline": {},
+    "grad_comm_bf16": {"grad_comm": "bf16"},
+    "grad_comm_int8": {"grad_comm": "int8"},
+    "tp_overlap": {"tp_overlap": True},
+    "fsdp_prefetch": {"fsdp_prefetch": True},
+    "all_levers_bf16": {"grad_comm": "bf16", "tp_overlap": True,
+                        "fsdp_prefetch": True},
+}
+
+
+def comms_report(cfg, b, mesh, *, microbatches=None, chip="v5e"):
+    """Analytic ICI budget for one mesh: per-axis bytes at each grad_comm
+    width (profiler.dalle_step_ici_bytes) + exposed-vs-overlapped comm
+    time per lever combination (profiler.dalle_step_comm_time).  Pure
+    closed-form — no devices, no compilation — so it evaluates pod shapes
+    far larger than the attached host."""
+    from dalle_tpu.training.profiler import (
+        ICI_GBPS,
+        PEAK_TFLOPS,
+        dalle_step_comm_time,
+        dalle_step_ici_bytes,
+    )
+
+    kw = dict(ici_gbps=ICI_GBPS[chip], peak_tflops=PEAK_TFLOPS[chip])
+    bts = {
+        gc: dalle_step_ici_bytes(cfg, b, mesh, grad_comm=gc)
+        for gc in ("f32", "bf16", "int8")
+    }
+    times = {
+        name: dalle_step_comm_time(cfg, b, mesh,
+                                   pp_microbatches=microbatches,
+                                   **lever, **kw)
+        for name, lever in COMM_LEVERS.items()
+    }
+    base = times["baseline"]
+    return {
+        "mesh": dict(mesh),
+        "batch": b,
+        "chip": chip,
+        "ici_gbytes_per_chip": {
+            gc: {k: round(v / 1e9, 4) for k, v in row.items()}
+            for gc, row in bts.items()
+        },
+        "grad_reduce_reduction_vs_f32": {
+            gc: round(1.0 - row["grad_reduce"] / bts["f32"]["grad_reduce"], 3)
+            for gc, row in bts.items()
+        } if bts["f32"]["grad_reduce"] else {},
+        "comm_time_ms": {
+            name: {
+                "compute": round(t["compute_s"] * 1e3, 3),
+                "comm_total": round(t["comm_total_s"] * 1e3, 3),
+                "exposed_total": round(t["exposed_total_s"] * 1e3, 3),
+                "step": round(t["step_s"] * 1e3, 3),
+                "exposed_frac": round(t["exposed_frac"], 4),
+                "exposed_by_axis": {
+                    k: round(v * 1e3, 3) for k, v in t["exposed_s"].items()
+                },
+            }
+            for name, t in times.items()
+        },
+        "exposed_time_reduction_vs_baseline": {
+            name: round(1.0 - t["exposed_total_s"]
+                        / max(base["exposed_total_s"], 1e-30), 3)
+            for name, t in times.items()
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16)
@@ -207,8 +295,35 @@ def main():
     ap.add_argument("--policies", action="store_true",
                     help="emit the precision/remat/fused-FF policy byte "
                          "table instead of the component breakdown")
+    ap.add_argument("--comms", action="store_true",
+                    help="emit the analytic ICI byte + exposed-comm-time "
+                         "table (profiler.dalle_step_ici_bytes / "
+                         "dalle_step_comm_time) instead of the component "
+                         "breakdown")
+    ap.add_argument("--mesh", type=str, default="dp=4,fsdp=4,tp=2",
+                    help="mesh axis sizes for --comms, e.g. "
+                         "dp=4,fsdp=4,tp=2 (axes absent default to 1; "
+                         "need not match attached devices)")
+    ap.add_argument("--microbatches", type=int, default=None,
+                    help="pp microbatch count for the --comms bubble term")
+    ap.add_argument("--chip", type=str, default="v5e",
+                    choices=("v4", "v5e", "v5p", "v6e"),
+                    help="ICI bandwidth / peak-TFLOPs table for --comms")
     ap.add_argument("--json_out", type=str, default=None)
     args = ap.parse_args()
+
+    if args.comms:
+        # pure closed-form: no devices touched, safe on any host
+        cfg = bench._flagship_cfg(args.smoke)
+        out = comms_report(cfg, args.batch, _parse_mesh(args.mesh),
+                           microbatches=args.microbatches, chip=args.chip)
+        out["config"] = {"depth": cfg.depth, "dim": cfg.dim,
+                         "batch": args.batch}
+        print(json.dumps(out, indent=1))
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(out, f, indent=1)
+        return
 
     import jax
 

@@ -99,6 +99,17 @@ def parse_args(argv=None):
     parser.add_argument("--fused_ff", action="store_true",
                         help="fused GEGLU feed-forward in both encoders "
                              "(ops/fused_ff.py)")
+    parser.add_argument("--grad_comm", type=str, default="f32",
+                        choices=("f32", "bf16", "int8"),
+                        help="wire precision of the dp/fsdp gradient "
+                             "reduction (parallel/compress.py; pure "
+                             "dp/fsdp meshes only).  NOTE: the manual step "
+                             "computes InfoNCE over each device's LOCAL "
+                             "batch block — negatives don't cross shards "
+                             "(train_lib.make_clip_train_step)")
+    parser.add_argument("--prefetch_depth", type=int, default=2,
+                        help="host->device input pipeline depth "
+                             "(data/prefetch.device_prefetch)")
     for ax in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
         parser.add_argument(f"--mesh_{ax}", type=int, default=None)
     parser.add_argument("--distributed_backend", "--distr_backend",
@@ -218,7 +229,17 @@ def main(argv=None):
         params, opt_state = restore_train_state(
             args.clip_resume_path, resume_meta, params, opt_state
         )
-    step_fn = make_clip_train_step(clip, tx, distr.mesh)
+        # the step donates params/opt_state (train_lib, donate_argnums —
+        # there since the factories were written); restored trees must be
+        # REAL copies before the first donating step so nothing else (the
+        # restore machinery, a partial-restore fallback still aliasing the
+        # init tree) holds the soon-invalidated buffers — the ema guard of
+        # train_dalle.py applied to the restore path
+        params, opt_state = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        )((params, opt_state))
+    step_fn = make_clip_train_step(clip, tx, distr.mesh,
+                                   grad_comm=args.grad_comm)
     if is_root:
         print(f"CLIP params: {count_params(params):,}; dataset: {len(ds)} pairs")
 
@@ -274,7 +295,9 @@ def main(argv=None):
         for epoch in range(start_epoch, args.epochs):
             resume_epoch = epoch
             loader.set_epoch(epoch)
-            for text, images in device_prefetch(loader, batch_sharding(distr.mesh)):
+            for text, images in device_prefetch(
+                loader, batch_sharding(distr.mesh), depth=args.prefetch_depth
+            ):
                 params, opt_state, loss = step_fn(
                     params, opt_state, text, images, jax.random.fold_in(rng, global_step)
                 )

@@ -162,6 +162,29 @@ def parse_args(argv=None):
                              "HBM (Pallas kernel on TPU, checkpointed chunk "
                              "loop elsewhere); numerics match the unfused "
                              "path to ~2e-4")
+    parser.add_argument("--grad_comm", type=str, default="f32",
+                        choices=("f32", "bf16", "int8"),
+                        help="wire precision of the dp/fsdp gradient "
+                             "reduction (parallel/compress.py): bf16 halves "
+                             "the reduce bytes, int8 cuts ~4x via "
+                             "stochastic-rounded per-bucket quantization "
+                             "(EQuARX-style; Adam still accumulates f32). "
+                             "Requires a pure dp/fsdp mesh")
+    parser.add_argument("--tp_overlap", action="store_true",
+                        help="decomposed tp collective-matmul "
+                             "(parallel/overlap.py): shard_map ppermute "
+                             "rings overlap the per-layer all-gather/"
+                             "reduce-scatter with the projection dots; "
+                             "compute policy, needs mesh_tp>1 and no sp")
+    parser.add_argument("--fsdp_prefetch", action="store_true",
+                        help="with --scan_layers: double-buffered fsdp "
+                             "param-gather prefetch — layer i+1's "
+                             "all-gather issues during layer i's compute "
+                             "(transformer.py ScanStack); compute policy")
+    parser.add_argument("--prefetch_depth", type=int, default=2,
+                        help="host->device input pipeline depth "
+                             "(data/prefetch.device_prefetch): batches "
+                             "staged ahead of the step")
     parser.add_argument("--attn_types", type=str, default="full",
                         help="comma-sep cycle: full,axial_row,axial_col,conv_like,sparse,mlp")
     parser.add_argument("--shift_tokens", action="store_true")
@@ -334,7 +357,8 @@ def main(argv=None):
         cfg = _dc.replace(
             cfg, dtype=precision.compute_dtype,
             stream_dtype=precision.stream_dtype, use_flash=use_flash,
-            fused_ff=args.fused_ff,
+            fused_ff=args.fused_ff, tp_overlap=args.tp_overlap,
+            fsdp_prefetch=args.fsdp_prefetch,
         )
     else:
         num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
@@ -377,6 +401,8 @@ def main(argv=None):
             moe_capacity_factor=args.moe_capacity_factor,
             moe_aux_weight=args.moe_aux_weight,
             fused_ff=args.fused_ff,
+            tp_overlap=args.tp_overlap,
+            fsdp_prefetch=args.fsdp_prefetch,
             dtype=precision.compute_dtype,
             stream_dtype=precision.stream_dtype,
         )
@@ -495,7 +521,8 @@ def main(argv=None):
     # diagnostics (MoE dropped-token fraction) only when there is a router
     want_metrics = cfg.moe_experts > 0
     step_fn = make_dalle_train_step(
-        model, tx, distr.mesh, vae=vae, with_metrics=want_metrics
+        model, tx, distr.mesh, vae=vae, with_metrics=want_metrics,
+        grad_comm=args.grad_comm,
     )
 
     sched = ReduceLROnPlateau(lr=args.learning_rate) if args.lr_decay else None
@@ -591,7 +618,9 @@ def main(argv=None):
             # the host only syncs on the logging cadence and at epoch end
             loss_sum = None
             loss_count = 0
-            batches = device_prefetch(loader, batch_sharding(distr.mesh))
+            batches = device_prefetch(
+                loader, batch_sharding(distr.mesh), depth=args.prefetch_depth
+            )
             for i, (text, images) in enumerate(batches):
                 if args.flops_profiler and global_step == 200 and is_root:
                     jax.profiler.start_trace(str(ckpt_dir / "profile"))

@@ -90,6 +90,14 @@ def parse_args(argv=None):
     parser.add_argument("--mu_bf16", action="store_true",
                         help="adam first moment in bfloat16 (HBM stream "
                              "lever; keep consistent across resume)")
+    parser.add_argument("--grad_comm", type=str, default="f32",
+                        choices=("f32", "bf16", "int8"),
+                        help="wire precision of the dp/fsdp gradient "
+                             "reduction (parallel/compress.py; pure "
+                             "dp/fsdp meshes only)")
+    parser.add_argument("--prefetch_depth", type=int, default=2,
+                        help="host->device input pipeline depth "
+                             "(data/prefetch.device_prefetch)")
     parser.add_argument("--config_json", type=str, default=None,
                         help="JSON file of {flag: value} overriding the "
                              "command line (file wins, warns per override)")
@@ -204,7 +212,17 @@ def main(argv=None):
                     f"run's optimizer config ({type(e).__name__}); resuming "
                     "with a FRESH optimizer (params still restored)"
                 )
-    step_fn = make_vae_train_step(vae, tx, distr.mesh)
+        # the step donates params/opt_state (train_lib, donate_argnums —
+        # there since the factories were written); copy the restored trees
+        # before the first donating step so nothing else (restore
+        # machinery, the fresh-optimizer fallback aliasing the init tree)
+        # holds the soon-invalidated buffers — train_dalle.py's ema copy
+        # guard applied to the restore path
+        params, opt_state = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        )((params, opt_state))
+    step_fn = make_vae_train_step(vae, tx, distr.mesh,
+                                  grad_comm=args.grad_comm)
     encode_fn = jax.jit(
         lambda p, img: vae.apply({"params": p}, img, method=DiscreteVAE.get_codebook_indices)
     )
@@ -275,7 +293,9 @@ def main(argv=None):
         for epoch in range(start_epoch, args.epochs):
             resume_epoch = epoch
             loader.set_epoch(epoch)
-            for images in device_prefetch(loader, batch_sharding(distr.mesh)):
+            for images in device_prefetch(
+                loader, batch_sharding(distr.mesh), depth=args.prefetch_depth
+            ):
                 params, opt_state, loss, recons = step_fn(
                     params, opt_state, images, temp, jax.random.fold_in(rng, global_step)
                 )
