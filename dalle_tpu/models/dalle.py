@@ -427,6 +427,10 @@ class DALLE(nn.Module):
         """One AR step: embed token at ``pos``, run transformer decode, return
         (masked logits for position ``pos``, new cache).
 
+        ``pos`` is a scalar (lockstep scan decode) or a [b] per-slot
+        position vector (serving engine, one independent position per
+        batch lane); the scalar path is unchanged and bit-exact.
+
         ``image_only`` (static): when the caller knows every scanned
         position is an image position (the whole generation scan after the
         text prefill), project ONLY the image vocab slice — the logits
@@ -449,6 +453,8 @@ class DALLE(nn.Module):
                 [jnp.full((img.shape[0], vt), NEG_INF, jnp.float32), img],
                 axis=-1,
             )
+        elif jnp.ndim(pos) == 1:
+            logits = self.head(x[:, None], pos=jnp.asarray(pos)[:, None])[:, 0]
         else:
             logits = self.head(x[:, None], pos=jnp.asarray(pos)[None])[:, 0]
         return logits, cache

@@ -36,10 +36,15 @@ from dalle_tpu.ops.sampling import sample_logits
 PRIME_FRACTION = 0.4375
 
 
+# ``temperature`` and ``top_p`` are traced operands — changing the sampling
+# config does NOT recompile (tests/test_serving.py pins the cache-miss
+# count).  ``filter_thres`` stays static: it sets the top-k shape
+# (ops/sampling.py).  Note top_p None <-> float still recompiles (pytree
+# structure change), but float -> float does not.
 @functools.partial(
     jax.jit,
     static_argnames=("model", "num_steps", "start", "filter_thres",
-                     "temperature", "top_p", "image_only"),
+                     "image_only"),
 )
 def scan_decode(
     model: DALLE,

@@ -427,13 +427,20 @@ def shift_token_step(
     """Single-position token-shift for decode.
 
     x_t: [b, d] current (post-norm) token; hist: [b, n, d] cache of previous
-    post-norm tokens; idx: scalar position.  Matches `shift_tokens_full`.
+    post-norm tokens; idx: scalar position, or a [b] per-slot position
+    vector (serving engine — each lane shifts at its own position).
+    Matches `shift_tokens_full`; the scalar path is byte-for-byte the
+    pre-vector code.
     """
     b, d = x_t.shape
     h, q = d // 2, d // 4
+    per_slot = jnp.ndim(idx) == 1  # static under trace
 
     def gather(off):
         pos = jnp.clip(idx - off, 0)
+        if per_slot:
+            tok = hist[jnp.arange(b), pos]  # [b, d] per-lane row
+            return jnp.where((idx >= off)[:, None], tok, jnp.zeros_like(tok))
         tok = jax.lax.dynamic_slice_in_dim(hist, pos, 1, axis=1)[:, 0]
         return jnp.where(idx >= off, tok, jnp.zeros_like(tok))
 
@@ -450,10 +457,13 @@ def shift_token_step(
     on_row0 = j < f
     on_col0 = (j % f) == 0
     above = gather(f)
+    if per_slot:
+        on_row0, on_col0 = on_row0[:, None], on_col0[:, None]
     above = jnp.where(on_row0, jnp.zeros_like(above), above)
     left = jnp.where(on_col0, jnp.zeros_like(prev), prev)
     img_out = jnp.concatenate([above[:, :q], left[:, q : 2 * q], x_t[:, 2 * q :]], axis=-1)
-    return jnp.where(idx < t + 1, text_out, img_out)
+    sel = (idx < t + 1)[:, None] if per_slot else idx < t + 1
+    return jnp.where(sel, text_out, img_out)
 
 
 def _proj(cfg, features, name, use_bias=True):
@@ -852,8 +862,29 @@ class JointAttention(nn.Module):
 
     def _cache_store(self, cache: Cache, k, v, idx) -> Cache:
         """Write k/v [b,h,L,d] into the cache at position ``idx`` (int8
-        rows + scales under kv_int8, plain ``c.dtype`` otherwise)."""
+        rows + scales under kv_int8, plain ``c.dtype`` otherwise).  A [b]
+        ``idx`` vector writes each lane's single row (L == 1) at its own
+        position — the serving engine's staggered-slot layout."""
         c = self.cfg
+        if jnp.ndim(idx) == 1:  # per-slot positions: scatter one row per lane
+            bi = jnp.arange(k.shape[0])
+            if c.kv_int8:
+                from dalle_tpu.ops.quant import quantize_rows
+
+                kq, ks = quantize_rows(k)
+                vq, vs = quantize_rows(v)
+                # [b] + [b] advanced indices around the kv-head slice put the
+                # broadcast batch dim first: target/value shape [b, kv, d]
+                return {
+                    "k": cache["k"].at[bi, :, idx].set(kq[:, :, 0]),
+                    "v": cache["v"].at[bi, :, idx].set(vq[:, :, 0]),
+                    "k_scale": cache["k_scale"].at[bi, :, idx].set(ks[:, :, 0]),
+                    "v_scale": cache["v_scale"].at[bi, :, idx].set(vs[:, :, 0]),
+                }
+            return {
+                "k": cache["k"].at[bi, :, idx].set(k.astype(c.dtype)[:, :, 0]),
+                "v": cache["v"].at[bi, :, idx].set(v.astype(c.dtype)[:, :, 0]),
+            }
         upd = jax.lax.dynamic_update_slice_in_dim
         if c.kv_int8:
             from dalle_tpu.ops.quant import quantize_rows
@@ -903,27 +934,38 @@ class JointAttention(nn.Module):
         return self.to_out(out), new_cache
 
     def decode_step(self, x_t, idx, cache, deterministic=True):
-        """x_t: [b, dim] token at position idx; returns ([b, dim], cache')."""
+        """x_t: [b, dim] token at position idx; returns ([b, dim], cache').
+        ``idx`` may be a [b] per-slot position vector (serving engine):
+        each lane reads/writes the cache and masks at its own position."""
         c = self.cfg
         b = x_t.shape[0]
+        per_slot = jnp.ndim(idx) == 1
         y = self.to_qkv(x_t[:, None])
         q, k, v = self._heads(y, 1)  # [b,h,1,d]
         if self._angles is not None:
-            ang = jax.lax.dynamic_slice_in_dim(jnp.asarray(self._angles), idx, 1)
+            tab = jnp.asarray(self._angles)
+            if per_slot:
+                ang = tab[idx][:, None, None, :]  # [b,1,1,R] per-lane angles
+            else:
+                ang = jax.lax.dynamic_slice_in_dim(tab, idx, 1)
             q, k = apply_rotary(q, ang), apply_rotary(k, ang)
             if c.rotary_v:
                 v = apply_rotary(v, ang)
         new_cache = self._cache_store(cache, k, v, idx)
         ck, cv = self._cache_kv(new_cache)  # [b, kv, n, d]
         mask_table = jnp.asarray(_static_mask(c, self.attn_type))
-        row = jax.lax.dynamic_slice_in_dim(mask_table, idx, 1, axis=0)  # [1, n]
+        if per_slot:
+            mask = mask_table[idx][:, None, None, :]  # [b,1,1,n] per-lane rows
+        else:
+            row = jax.lax.dynamic_slice_in_dim(mask_table, idx, 1, axis=0)  # [1, n]
+            mask = row[None, None]
         # grouped read — the GQA point: fold the head-group into the query
         # axis so the cache is read at its [b, kv, n, d] size (no repeat
         # materializes).  At kv == heads the fold is [b, h, 1, d] and this
         # is element-for-element the plain MHA read, same head-major layout.
         g = c.heads // c.num_kv_heads
         qg = q[:, :, 0].reshape(b, c.num_kv_heads, g, c.dim_head)
-        out = attn_ops._sdpa(qg, ck, cv, row[None, None])  # [b,kv,g,d]
+        out = attn_ops._sdpa(qg, ck, cv, mask)  # [b,kv,g,d]
         return self.to_out(out.reshape(b, -1)), new_cache
 
 
@@ -978,6 +1020,17 @@ class CausalSGU(nn.Module):
 
     def _cache_store(self, cache: Cache, v, idx) -> Cache:
         c = self.cfg
+        if jnp.ndim(idx) == 1:  # per-slot positions (L == 1 rows)
+            bi = jnp.arange(v.shape[0])
+            if c.kv_int8:
+                from dalle_tpu.ops.quant import quantize_rows
+
+                vq, vs = quantize_rows(v)
+                return {
+                    "v": cache["v"].at[bi, idx].set(vq[:, 0]),
+                    "v_scale": cache["v_scale"].at[bi, idx].set(vs[:, 0]),
+                }
+            return {"v": cache["v"].at[bi, idx].set(v.astype(c.dtype)[:, 0])}
         upd = jax.lax.dynamic_update_slice_in_dim
         if c.kv_int8:
             from dalle_tpu.ops.quant import quantize_rows
@@ -1012,9 +1065,14 @@ class CausalSGU(nn.Module):
             cv = dequantize_rows(new_cache["v"], new_cache["v_scale"], c.dtype)
         else:
             cv = new_cache["v"]
-        w_row = jax.lax.dynamic_slice_in_dim(self._gate_weight(), idx, 1, axis=0)[0]
-        b_row = jax.lax.dynamic_slice_in_dim(self.spatial_b, idx, 1)[0]
-        gated = jnp.einsum("j,bjd->bd", w_row, cv) + b_row.astype(v.dtype)
+        if jnp.ndim(idx) == 1:  # per-slot gate row per lane
+            w_row = self._gate_weight()[idx]  # [b, n]
+            b_row = self.spatial_b[idx]  # [b]
+            gated = jnp.einsum("bj,bjd->bd", w_row, cv) + b_row[:, None].astype(v.dtype)
+        else:
+            w_row = jax.lax.dynamic_slice_in_dim(self._gate_weight(), idx, 1, axis=0)[0]
+            b_row = jax.lax.dynamic_slice_in_dim(self.spatial_b, idx, 1)[0]
+            gated = jnp.einsum("j,bjd->bd", w_row, cv) + b_row.astype(v.dtype)
         return self.proj_out(u * gated), new_cache
 
 
@@ -1120,9 +1178,14 @@ class SubLayer(nn.Module):
         y = self.norm(x_t)
         new_cache = dict(cache)
         if self._shifts():
-            hist = jax.lax.dynamic_update_slice_in_dim(
-                cache["hist"], y[:, None].astype(c.dtype), idx, axis=1
-            )
+            if jnp.ndim(idx) == 1:  # per-slot positions: one row per lane
+                hist = cache["hist"].at[
+                    jnp.arange(y.shape[0]), idx
+                ].set(y.astype(c.dtype))
+            else:
+                hist = jax.lax.dynamic_update_slice_in_dim(
+                    cache["hist"], y[:, None].astype(c.dtype), idx, axis=1
+                )
             new_cache["hist"] = hist
             y = shift_token_step(y, hist, idx, c.text_seq_len, c.fmap_size)
         if self._is_attn:

@@ -55,14 +55,53 @@ def sample_logits(
     """(Top-p | top-k) filter → temperature → categorical sample.
 
     ``top_p`` (nucleus) takes precedence over the reference's fractional
-    top-k when given.  Returns int32 ids."""
+    top-k when given.  ``temperature`` and ``top_p`` may be traced scalars
+    (jit operands — no recompile per sampling config); only the top-k
+    fraction ``filter_thres`` must be static (it sets the shape of the
+    ``top_k`` call).  Returns int32 ids."""
     if top_p is not None:
-        assert 0.0 < top_p <= 1.0, (
-            f"top_p must be in (0, 1], got {top_p} — <=0 would silence "
-            "every token and always emit id 0"
-        )
+        if isinstance(top_p, (int, float)):  # traced values skip the check
+            assert 0.0 < top_p <= 1.0, (
+                f"top_p must be in (0, 1], got {top_p} — <=0 would silence "
+                "every token and always emit id 0"
+            )
         filtered = top_p_filter(logits, top_p)
     else:
         filtered = top_k_filter(logits, filter_thres)
     t = jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
     return jax.random.categorical(key, filtered / t, axis=-1)
+
+
+def sample_logits_per_slot(
+    keys: jax.Array,
+    logits: jnp.ndarray,
+    *,
+    temperature=1.0,
+    filter_thres: float = 0.5,
+    top_p=None,
+) -> jnp.ndarray:
+    """Per-lane :func:`sample_logits` — the serving engine's sampler.
+
+    keys: [b, 2] uint32 (one legacy PRNG key per slot); logits: [b, vocab];
+    ``temperature`` and ``top_p`` broadcast from scalars or come in as [b]
+    per-slot vectors.  Each lane is bitwise-identical to
+    ``sample_logits(keys[i], logits[i:i+1], ...)[0]``: the threefry bits,
+    per-row top-k/sort reductions, and the Gumbel-max argmax all batch
+    exactly under vmap.  ``filter_thres`` stays static (top-k shape)."""
+    b = logits.shape[0]
+    temp = jnp.broadcast_to(jnp.asarray(temperature, logits.dtype), (b,))
+    if top_p is None:
+        def one(key, row, t):
+            return sample_logits(
+                key, row[None], temperature=t, filter_thres=filter_thres
+            )[0]
+
+        return jax.vmap(one)(keys, logits, temp)
+    tp = jnp.broadcast_to(jnp.asarray(top_p, logits.dtype), (b,))
+
+    def one(key, row, t, p):
+        return sample_logits(
+            key, row[None], temperature=t, filter_thres=filter_thres, top_p=p
+        )[0]
+
+    return jax.vmap(one)(keys, logits, temp, tp)

@@ -1,0 +1,38 @@
+"""Slot-based continuous-batching serving (in-flight decode).
+
+The lockstep scan decoder (models/generate.py) forces every request in a
+batch to start together; under live traffic that means either admission
+latency (wait for a full batch) or idle MXU (batch-of-1).  This package
+is the Orca/vLLM-style alternative adapted to TPU static shapes: a
+persistent jitted step over B fixed slots, each slot at its own position,
+with free slots refilled by batched prefill while occupied slots keep
+decoding — exact (bit-identical to solo decode), not approximate,
+because every DALL-E request has the same shape (text_seq_len prefix +
+image_seq_len generation).  See docs/SERVING.md §5.
+"""
+
+from dalle_tpu.serving.engine import DecodeEngine, EngineState
+from dalle_tpu.serving.queue import Request, RequestQueue
+from dalle_tpu.serving.scheduler import (
+    POLICIES,
+    Scheduler,
+    TraceItem,
+    load_trace,
+    make_poisson_trace,
+    replay_trace,
+    save_trace,
+)
+
+__all__ = [
+    "DecodeEngine",
+    "EngineState",
+    "Request",
+    "RequestQueue",
+    "Scheduler",
+    "POLICIES",
+    "TraceItem",
+    "make_poisson_trace",
+    "replay_trace",
+    "load_trace",
+    "save_trace",
+]

@@ -1,0 +1,276 @@
+"""Slot-based continuous-batching decode engine (device side).
+
+B fixed slots, one jitted ``tick`` advancing every occupied slot by one
+token — each slot at its OWN position (the vector-``pos`` path of
+``DALLE.decode_step``), with its own RNG ladder, temperature, and done
+flag.  Free slots are refilled by a jitted ``admit`` that prefills the
+newcomers' text in one batched pass and gather-merges the result into
+the slot cache.  Everything is static-shape in (num_slots,
+total_seq_len): admitting or completing a request never recompiles, and
+the engine state is donated through both jitted calls so the cache is
+updated in place (no per-step copy).
+
+Exactness: a request admitted into slot k at tick T produces
+bit-identical image codes to the same request decoded solo by
+``models/generate.py generate_image_codes`` with the same seed
+(tests/test_serving.py pins this, including under kv_int8):
+
+* the per-slot cache rows/mask/sample are independent per lane;
+* the RNG ladder is ``jax.random.split(PRNGKey(seed), image_seq_len)``
+  — exactly the solo scan's key schedule — indexed by the slot's own
+  step counter;
+* inactive slots clamp their position to ``text_seq_len`` and keep
+  writing a garbage row there, which is harmless: the first real decode
+  step of the next occupant (or the admission prefill for rows below
+  it) overwrites the row before any read that reaches the output.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.models.dalle import DALLE
+from dalle_tpu.ops.sampling import sample_logits_per_slot
+
+from dalle_tpu.serving.queue import Request
+
+
+class EngineState(NamedTuple):
+    """The donated device state — one pytree, static shapes in B and S."""
+
+    cache: Any  # per-layer KV/gate/hist caches, [B, ...] slot-major
+    pos: jax.Array  # [B] int32 next position to feed (t .. t+S)
+    prev: jax.Array  # [B] int32 last sampled combined-vocab id
+    first: jax.Array  # [B] int32 forced token at position t (remapped[:, -1])
+    keys: jax.Array  # [B, S, 2] uint32 per-step sample keys
+    temp: jax.Array  # [B] f32 per-slot temperature
+    top_p: jax.Array  # [B] f32 per-slot nucleus threshold (top-p engines)
+    active: jax.Array  # [B] bool slot occupied and still decoding
+    out: jax.Array  # [B, S] int32 sampled combined ids
+
+
+class DecodeEngine:
+    """Host wrapper around the two jitted device functions.
+
+    The host mirrors only what scheduling needs: which request occupies
+    which slot and the tick at which it completes — both computable
+    WITHOUT a device sync, because every request decodes exactly
+    ``image_seq_len`` ticks after admission.  Results are fetched (one
+    [S] row) only at completion.
+
+    ``filter_thres`` (the top-k fraction) is static per engine — it sets
+    the top-k shape.  ``use_top_p`` switches the whole engine to nucleus
+    sampling; per-request ``top_p`` values are then honored (requests
+    without one sample at top_p=1.0, i.e. pure temperature).
+    """
+
+    def __init__(
+        self,
+        model: DALLE,
+        params,
+        *,
+        num_slots: int = 8,
+        filter_thres: float = 0.9,
+        use_top_p: bool = False,
+    ):
+        self.model = model
+        self.params = params
+        self.num_slots = int(num_slots)
+        c = model.cfg
+        self.t = c.text_seq_len
+        self.S = c.image_seq_len
+        self.filter_thres = filter_thres
+        self.use_top_p = use_top_p
+        self._tick_fn = jax.jit(self._tick_impl, donate_argnums=(1,))
+        self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(1,))
+        self.state = self._init_state()
+        self.tick_count = 0
+        self.slot_req: List[Optional[Request]] = [None] * self.num_slots
+        self._slot_done: List[Optional[int]] = [None] * self.num_slots
+
+    # --- device side -----------------------------------------------------
+    def _init_state(self) -> EngineState:
+        B, S, t = self.num_slots, self.S, self.t
+        cache = self.model.apply(
+            {"params": self.params}, B, method=DALLE.init_cache
+        )
+        return EngineState(
+            cache=cache,
+            pos=jnp.full((B,), t, jnp.int32),
+            prev=jnp.zeros((B,), jnp.int32),
+            first=jnp.zeros((B,), jnp.int32),
+            keys=jnp.zeros((B, S, 2), jnp.uint32),
+            temp=jnp.ones((B,), jnp.float32),
+            top_p=jnp.ones((B,), jnp.float32),
+            active=jnp.zeros((B,), bool),
+            out=jnp.zeros((B, S), jnp.int32),
+        )
+
+    def _tick_impl(self, params, state: EngineState) -> EngineState:
+        """Advance every active slot by one token (inactive lanes run the
+        same math at a clamped position and discard the result)."""
+        model, t, S = self.model, self.t, self.S
+        bi = jnp.arange(self.num_slots)
+        pos = jnp.where(state.active, state.pos, t)  # clamp inactive lanes
+        fed = jnp.where(pos == t, state.first, state.prev)
+        logits, cache = model.apply(
+            {"params": params}, fed, pos, state.cache, image_only=True,
+            method=DALLE.decode_step,
+        )
+        si = jnp.clip(pos - t, 0, S - 1)  # per-slot step index
+        step_keys = state.keys[bi, si]  # [B, 2]
+        sampled = sample_logits_per_slot(
+            step_keys, logits,
+            temperature=state.temp,
+            filter_thres=self.filter_thres,
+            top_p=state.top_p if self.use_top_p else None,
+        ).astype(jnp.int32)
+        out = state.out.at[bi, si].set(
+            jnp.where(state.active, sampled, state.out[bi, si])
+        )
+        new_pos = jnp.where(state.active, pos + 1, pos)
+        prev = jnp.where(state.active, sampled, state.prev)
+        active = state.active & (new_pos < t + S)
+        return EngineState(
+            cache, new_pos, prev, state.first, state.keys, state.temp,
+            state.top_p, active, out,
+        )
+
+    def _admit_impl(
+        self, params, state: EngineState, texts, base_keys, temps, tps,
+        src, take,
+    ) -> EngineState:
+        """Prefill up to B newcomers in one batched pass and gather-merge
+        them into their slots.
+
+        ``src[b]`` names the newcomer row slot b takes, ``take[b]`` whether
+        it takes one.  The merge is a gather-select (``where(take,
+        new[src], old)``) rather than a scatter — deterministic even if a
+        host bug ever produced duplicate targets."""
+        model, t, S = self.model, self.t, self.S
+        A = texts.shape[0]  # == num_slots (static)
+        fresh = model.apply({"params": params}, A, method=DALLE.init_cache)
+        pcache = model.apply(
+            {"params": params}, texts, fresh, method=DALLE.prefill
+        )
+        remapped = model.apply(
+            {"params": params}, texts, method=DALLE.remap_pad_tokens
+        )
+        first = remapped[:, -1].astype(jnp.int32)  # forced token at pos t
+        # the solo scan's key schedule, one ladder per request
+        ladder = jax.vmap(lambda k: jax.random.split(k, S))(base_keys)
+
+        def merge(old, new):
+            tk = take.reshape((-1,) + (1,) * (old.ndim - 1))
+            return jnp.where(tk, jnp.take(new, src, axis=0), old)
+
+        cache = jax.tree_util.tree_map(merge, state.cache, pcache)
+        return EngineState(
+            cache=cache,
+            pos=jnp.where(take, jnp.int32(t), state.pos),
+            prev=jnp.where(take, 0, state.prev),
+            first=jnp.where(take, first[src], state.first),
+            keys=jnp.where(take[:, None, None], ladder[src], state.keys),
+            temp=jnp.where(take, temps[src], state.temp),
+            top_p=jnp.where(take, tps[src], state.top_p),
+            active=state.active | take,
+            out=jnp.where(take[:, None], 0, state.out),
+        )
+
+    # --- host side -------------------------------------------------------
+    def free_slots(self) -> List[int]:
+        return [b for b in range(self.num_slots) if self.slot_req[b] is None]
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self.slot_req)
+
+    def warmup(self):
+        """Compile tick + admit up front (keeps XLA compile time out of
+        the latency stats), then reset to a fresh state."""
+        B, t = self.num_slots, self.t
+        z = np.zeros
+        st = self._admit_fn(
+            self.params, self.state,
+            jnp.asarray(z((B, t), np.int32)),
+            jnp.asarray(z((B, 2), np.uint32)),
+            jnp.ones((B,), jnp.float32), jnp.ones((B,), jnp.float32),
+            jnp.asarray(z((B,), np.int32)), jnp.asarray(z((B,), bool)),
+        )
+        st = self._tick_fn(self.params, st)
+        jax.block_until_ready(st.out)
+        self.state = self._init_state()
+        self.tick_count = 0
+
+    def admit(self, reqs: Sequence[Request]):
+        """Scatter up to ``len(free_slots())`` new requests into free slots
+        (one jitted call, no recompilation — shapes are static in B)."""
+        if not reqs:
+            return
+        free = self.free_slots()
+        assert len(reqs) <= len(free), (
+            f"admit({len(reqs)}) with only {len(free)} free slots"
+        )
+        B, t, S = self.num_slots, self.t, self.S
+        texts = np.zeros((B, t), np.int32)
+        base = np.zeros((B, 2), np.uint32)
+        temps = np.ones((B,), np.float32)
+        tps = np.ones((B,), np.float32)
+        src = np.zeros((B,), np.int32)
+        take = np.zeros((B,), bool)
+        now = time.monotonic()
+        for i, req in enumerate(reqs):
+            slot = free[i]
+            tt = np.asarray(req.text_tokens, np.int32).reshape(-1)
+            assert tt.shape[0] == t, (
+                f"request text must be [{t}] tokens, got {tt.shape}"
+            )
+            texts[i] = tt
+            base[i] = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+            temps[i] = req.temperature
+            if req.top_p is not None:
+                assert self.use_top_p, (
+                    "request has top_p but the engine was built with "
+                    "use_top_p=False (static sampling mode)"
+                )
+                tps[i] = req.top_p
+            src[slot] = i
+            take[slot] = True
+            self.slot_req[slot] = req
+            self._slot_done[slot] = self.tick_count + S
+            req.admit_time = now
+        self.state = self._admit_fn(
+            self.params, self.state, jnp.asarray(texts), jnp.asarray(base),
+            jnp.asarray(temps), jnp.asarray(tps), jnp.asarray(src),
+            jnp.asarray(take),
+        )
+
+    def step(self) -> List[Request]:
+        """One engine tick.  Returns the requests that just completed,
+        with ``codes`` ([image_seq_len] VQ codes) and ``finish_time``
+        stamped.  Completion ticks are known host-side — the only device
+        sync is fetching each finished slot's output row."""
+        self.state = self._tick_fn(self.params, self.state)
+        self.tick_count += 1
+        done = []
+        c = self.model.cfg
+        for b in range(self.num_slots):
+            if (
+                self.slot_req[b] is not None
+                and self.tick_count >= self._slot_done[b]
+            ):
+                req = self.slot_req[b]
+                out = np.asarray(self.state.out[b])
+                req.codes = np.clip(
+                    out - c.total_text_tokens, 0, c.num_image_tokens - 1
+                ).astype(np.int32)
+                req.finish_time = time.monotonic()
+                done.append(req)
+                self.slot_req[b] = None
+                self._slot_done[b] = None
+        return done

@@ -1,0 +1,116 @@
+"""Host-side request plumbing: `Request` + a thread-safe FIFO queue.
+
+The engine/scheduler never see raw client payloads — a `Request` carries
+the tokenized text, the per-request sampling config and seed, and the
+latency bookkeeping the bench rung reads back (arrival/admit/finish
+timestamps, all `time.monotonic`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+_ids = itertools.count()
+
+
+@dataclass
+class Request:
+    """One image-generation request.
+
+    ``finish_time`` is set when the LAST image token is sampled (the TTLT
+    endpoint the bench measures); VAE decode / CLIP rerank happen after it
+    on the detok worker and stamp ``detok_time`` separately.
+    """
+
+    text_tokens: Any  # [text_seq_len] int token ids (pad id 0)
+    seed: int = 0
+    temperature: float = 1.0
+    top_p: Optional[float] = None
+    request_id: str = ""
+    deadline_s: Optional[float] = None  # relative to arrival; None = no deadline
+    # --- filled in downstream ---
+    arrival_time: Optional[float] = None
+    admit_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    detok_time: Optional[float] = None
+    codes: Optional[np.ndarray] = None  # [image_seq_len] VQ codes
+    image: Optional[np.ndarray] = None
+    clip_score: Optional[float] = None
+    dropped: bool = False
+    _done: threading.Event = field(
+        default_factory=threading.Event, repr=False, compare=False
+    )
+
+    def __post_init__(self):
+        if not self.request_id:
+            self.request_id = f"req{next(_ids)}"
+
+    @property
+    def ttlt(self) -> Optional[float]:
+        """Time-to-last-token: last image token sampled − arrival."""
+        if self.finish_time is None or self.arrival_time is None:
+            return None
+        return self.finish_time - self.arrival_time
+
+    def result(self, timeout: Optional[float] = None) -> "Request":
+        """Block until the request is fully processed (or dropped)."""
+        self._done.wait(timeout)
+        return self
+
+
+class RequestQueue:
+    """Thread-safe FIFO with close() semantics.
+
+    Producers `submit()` from any thread; the scheduler `pop()`s batches.
+    `close()` signals no more submissions — the scheduler drains what is
+    left and exits.
+    """
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def submit(self, req: Request) -> Request:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("RequestQueue is closed")
+            if req.arrival_time is None:
+                req.arrival_time = time.monotonic()
+            self._q.append(req)
+            self._cv.notify_all()
+        return req
+
+    def pop(self, max_n: int) -> list:
+        """FIFO-pop up to ``max_n`` requests (non-blocking)."""
+        with self._cv:
+            out = []
+            while self._q and len(out) < max_n:
+                out.append(self._q.popleft())
+            return out
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        with self._cv:
+            return self._closed
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until a request is pending or the queue is closed."""
+        with self._cv:
+            self._cv.wait_for(lambda: bool(self._q) or self._closed, timeout)

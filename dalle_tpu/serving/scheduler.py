@@ -1,0 +1,313 @@
+"""Host-side scheduling: admission policies, the detok worker, trace replay.
+
+Three admission policies (the bench rung's three bars):
+
+* ``sequential`` — batch-of-1: one request in flight at a time (the
+  engine is built with a single slot).  The no-batching baseline.
+* ``full_batch`` — wait until B requests are pending (or the stream
+  ends), decode them in lockstep, drain, repeat.  Maximizes device
+  utilization per step but stalls admission: a request arriving just
+  after a batch starts waits a full decode.
+* ``continuous`` — admit into any free slot every tick (in-flight
+  batching).  No global barrier: tokens/s of full-batch, admission
+  latency of batch-of-1.
+
+VAE decode + optional CLIP scoring run on a worker thread
+(``detok``) so the device step loop never blocks on detokenization;
+``Request.finish_time`` (the TTLT endpoint) is stamped when the last
+token is sampled, before detok.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as pyqueue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from dalle_tpu.serving.engine import DecodeEngine
+from dalle_tpu.serving.queue import Request, RequestQueue
+
+POLICIES = ("sequential", "full_batch", "continuous")
+
+
+class Scheduler:
+    """Drives one `DecodeEngine` from one `RequestQueue` until drained."""
+
+    def __init__(
+        self,
+        engine: DecodeEngine,
+        req_queue: RequestQueue,
+        *,
+        policy: str = "continuous",
+        vae=None,
+        vae_params=None,
+        clip=None,
+        clip_params=None,
+        on_result=None,
+        idle_wait: float = 0.002,
+    ):
+        assert policy in POLICIES, f"policy must be one of {POLICIES}"
+        self.engine = engine
+        self.queue = req_queue
+        self.policy = policy
+        self.on_result = on_result
+        self.idle_wait = idle_wait
+        self.completed: List[Request] = []
+        self._detok_q: pyqueue.Queue = pyqueue.Queue()
+        self._decode_fn = None
+        self._clip_fn = None
+        if vae is not None:
+            import jax
+
+            self._decode_fn = jax.jit(
+                lambda codes: vae.apply(
+                    {"params": vae_params}, codes, method=type(vae).decode
+                )
+            )
+        if clip is not None:
+            import jax
+
+            self._clip_fn = jax.jit(
+                lambda text, img: clip.apply({"params": clip_params}, text, img)
+            )
+
+    # --- detok worker ----------------------------------------------------
+    def _detok_loop(self):
+        while True:
+            req = self._detok_q.get()
+            if req is None:
+                return
+            try:
+                if self._decode_fn is not None and req.codes is not None:
+                    req.image = np.asarray(self._decode_fn(req.codes[None]))[0]
+                    if self._clip_fn is not None:
+                        score = self._clip_fn(
+                            np.asarray(req.text_tokens, np.int32)[None],
+                            req.image[None],
+                        )
+                        req.clip_score = float(np.asarray(score).reshape(-1)[0])
+                req.detok_time = time.monotonic()
+                if self.on_result is not None:
+                    self.on_result(req)
+            finally:
+                req._done.set()
+
+    # --- admission -------------------------------------------------------
+    def _want(self, n_free: int) -> int:
+        B = self.engine.num_slots
+        if self.policy == "continuous":
+            return n_free
+        if self.policy == "sequential":
+            # batch-of-1: engine should have one slot; in any case, only
+            # admit one request when the engine is fully drained
+            return 1 if n_free == B else 0
+        # full_batch: wait for a full batch (or the stream's tail)
+        if n_free == B and (
+            self.queue.pending() >= B
+            or (self.queue.closed and self.queue.pending() > 0)
+        ):
+            return B
+        return 0
+
+    def _drop_expired(self, reqs: Sequence[Request]) -> List[Request]:
+        now = time.monotonic()
+        keep = []
+        for r in reqs:
+            if (
+                r.deadline_s is not None
+                and r.arrival_time is not None
+                and now > r.arrival_time + r.deadline_s
+            ):
+                r.dropped = True
+                self.completed.append(r)
+                r._done.set()
+            else:
+                keep.append(r)
+        return keep
+
+    # --- main loop -------------------------------------------------------
+    def run(self) -> dict:
+        """Serve until the queue is closed AND drained AND all slots are
+        idle.  Returns `stats()`."""
+        worker = threading.Thread(target=self._detok_loop, daemon=True)
+        worker.start()
+        eng = self.engine
+        try:
+            while True:
+                want = self._want(len(eng.free_slots()))
+                if want:
+                    reqs = self._drop_expired(self.queue.pop(want))
+                    if reqs:
+                        eng.admit(reqs)
+                if eng.num_active:
+                    for req in eng.step():
+                        self.completed.append(req)
+                        self._detok_q.put(req)
+                elif self.queue.closed and self.queue.pending() == 0:
+                    return self.stats()
+                else:
+                    self.queue.wait(timeout=self.idle_wait)
+        finally:
+            self._detok_q.put(None)
+            worker.join()
+
+    # --- metrics ---------------------------------------------------------
+    def stats(self) -> dict:
+        S = self.engine.S
+        served = [r for r in self.completed if not r.dropped]
+        dropped = len(self.completed) - len(served)
+        out = {
+            "policy": self.policy,
+            "num_slots": self.engine.num_slots,
+            "served": len(served),
+            "dropped": dropped,
+            "ticks": self.engine.tick_count,
+            "tokens": len(served) * S,
+        }
+        if not served:
+            out.update(makespan_s=0.0, tokens_per_s=0.0,
+                       ttlt_p50_s=None, ttlt_p99_s=None)
+            return out
+        t0 = min(r.arrival_time for r in served)
+        t1 = max(r.finish_time for r in served)
+        makespan = max(t1 - t0, 1e-9)
+        tt = sorted(r.ttlt for r in served)
+
+        def pct(p):
+            i = min(len(tt) - 1, int(round(p / 100.0 * (len(tt) - 1))))
+            return tt[i]
+
+        out.update(
+            makespan_s=makespan,
+            tokens_per_s=out["tokens"] / makespan,
+            ttlt_p50_s=pct(50),
+            ttlt_p99_s=pct(99),
+        )
+        return out
+
+
+# --- arrival traces (bench rung + tools/serving_bench.py) -----------------
+
+
+@dataclass
+class TraceItem:
+    """One recorded arrival: offset from trace start + the request body."""
+
+    arrival_s: float
+    text_tokens: Any
+    seed: int = 0
+    temperature: float = 1.0
+    top_p: Optional[float] = None
+    deadline_s: Optional[float] = None
+    request_id: str = ""
+
+
+def make_poisson_trace(
+    n: int, rate_hz: float, text_seq_len: int, num_text_tokens: int,
+    seed: int = 0,
+) -> List[TraceItem]:
+    """Poisson arrivals (exponential interarrivals at ``rate_hz``) with
+    random text prompts — one seeded trace, replayed under every policy
+    so the comparison sees identical traffic."""
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+    texts = rng.randint(1, num_text_tokens, size=(n, text_seq_len))
+    return [
+        TraceItem(
+            arrival_s=float(a), text_tokens=texts[i].astype(np.int32),
+            seed=int(i), request_id=f"trace{i}",
+        )
+        for i, a in enumerate(arrivals)
+    ]
+
+
+def save_trace(path: str, trace: Sequence[TraceItem]):
+    with open(path, "w") as f:
+        for it in trace:
+            f.write(json.dumps({
+                "arrival_s": it.arrival_s,
+                "text_tokens": np.asarray(it.text_tokens).tolist(),
+                "seed": it.seed,
+                "temperature": it.temperature,
+                "top_p": it.top_p,
+                "deadline_s": it.deadline_s,
+                "request_id": it.request_id,
+            }) + "\n")
+
+
+def load_trace(path: str) -> List[TraceItem]:
+    trace = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            trace.append(TraceItem(
+                arrival_s=float(d["arrival_s"]),
+                text_tokens=np.asarray(d["text_tokens"], np.int32),
+                seed=int(d.get("seed", 0)),
+                temperature=float(d.get("temperature", 1.0)),
+                top_p=d.get("top_p"),
+                deadline_s=d.get("deadline_s"),
+                request_id=d.get("request_id", ""),
+            ))
+    return trace
+
+
+def replay_trace(
+    model,
+    params,
+    trace: Sequence[TraceItem],
+    *,
+    policy: str = "continuous",
+    num_slots: int = 8,
+    filter_thres: float = 0.9,
+    time_scale: float = 1.0,
+    vae=None,
+    vae_params=None,
+    clip=None,
+    clip_params=None,
+) -> dict:
+    """Replay a recorded arrival trace against a fresh engine.
+
+    A feeder thread submits each request at its recorded offset (scaled
+    by ``time_scale``); the scheduler serves until the trace drains.  The
+    engine is warmed up first so XLA compile time never lands in the
+    latency numbers.  ``sequential`` forces a single-slot engine
+    (batch-of-1 by construction)."""
+    B = 1 if policy == "sequential" else num_slots
+    engine = DecodeEngine(
+        model, params, num_slots=B, filter_thres=filter_thres,
+        use_top_p=any(it.top_p is not None for it in trace),
+    )
+    engine.warmup()
+    q = RequestQueue()
+    sched = Scheduler(
+        engine, q, policy=policy, vae=vae, vae_params=vae_params,
+        clip=clip, clip_params=clip_params,
+    )
+
+    def feeder():
+        t0 = time.monotonic()
+        for it in trace:
+            delay = t0 + it.arrival_s * time_scale - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            q.submit(Request(
+                text_tokens=it.text_tokens, seed=it.seed,
+                temperature=it.temperature, top_p=it.top_p,
+                deadline_s=it.deadline_s, request_id=it.request_id,
+            ))
+        q.close()
+
+    th = threading.Thread(target=feeder, daemon=True)
+    th.start()
+    stats = sched.run()
+    th.join()
+    return stats

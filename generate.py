@@ -33,8 +33,24 @@ from dalle_tpu.tokenizers import get_tokenizer
 def parse_args(argv=None):
     parser = argparse.ArgumentParser(description="Generate images from a trained DALL-E")
     parser.add_argument("--dalle_path", type=str, required=True)
-    parser.add_argument("--text", type=str, required=True,
-                        help="'|'-separated prompts")
+    parser.add_argument("--text", type=str, default=None,
+                        help="'|'-separated prompts (required unless --serve)")
+    # continuous-batching server mode (dalle_tpu/serving/, docs/SERVING.md
+    # §5): a JSONL request stream drives the slot engine — requests are
+    # admitted into free decode slots while occupied slots keep decoding
+    parser.add_argument("--serve", type=str, default=None,
+                        help="serve a JSONL request stream ('-' = stdin; "
+                             "fields: text, seed, temperature, top_p, "
+                             "deadline_s, id) through the continuous-"
+                             "batching engine instead of --text prompts")
+    parser.add_argument("--serve_slots", type=int, default=8,
+                        help="decode slots B (concurrent in-flight "
+                             "requests; static shape, no recompile as "
+                             "occupancy changes)")
+    parser.add_argument("--serve_policy", type=str, default="continuous",
+                        choices=("continuous", "full_batch", "sequential"),
+                        help="admission policy (sequential/full_batch exist "
+                             "for comparison; continuous is the lever)")
     parser.add_argument("--num_images", type=int, default=128)
     parser.add_argument("--batch_size", type=int, default=4)
     parser.add_argument("--top_k", type=float, default=0.9,
@@ -118,6 +134,14 @@ def main(argv=None):
 
     dalle_tpu.force_cpu_if_virtual()
     args = parse_args(argv)
+    assert args.text is not None or args.serve, (
+        "pass --text PROMPTS or --serve STREAM"
+    )
+    if args.serve:
+        assert not args.gentxt and not args.prime_image, (
+            "--serve does not compose with --gentxt/--prime_image "
+            "(per-request text only)"
+        )
     tokenizer = get_tokenizer(bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese)
 
     if args.dalle_path.endswith(".pt"):
@@ -131,8 +155,9 @@ def main(argv=None):
         model, params, vae, vae_params, cfg = _load_reference_pt(args)
         model, params = _maybe_int8(args, model, params)
         model = _maybe_kv_int8(args, model)
-        _generate_loop(args, tokenizer, model, params, vae, vae_params,
-                       cfg, clip=None, clip_params=None)
+        loop = _serve_loop if args.serve else _generate_loop
+        loop(args, tokenizer, model, params, vae, vae_params,
+             cfg, clip=None, clip_params=None)
         return
 
     assert is_checkpoint(args.dalle_path), f"{args.dalle_path}: not a checkpoint"
@@ -206,8 +231,9 @@ def main(argv=None):
 
     model, params = _maybe_int8(args, model, params)
     model = _maybe_kv_int8(args, model)
-    _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,
-                   clip, clip_params)
+    loop = _serve_loop if args.serve else _generate_loop
+    loop(args, tokenizer, model, params, vae, vae_params, cfg,
+         clip, clip_params)
 
 
 def _maybe_int8(args, model, params):
@@ -304,6 +330,118 @@ def _load_reference_pt(args):
     print(f"loaded reference .pt checkpoint (epoch {loaded['epoch']}), "
           f"depth={cfg.depth} dim={cfg.dim} attn_types={cfg.attn_types}")
     return model, params, vae, vae_params, cfg
+
+
+def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
+                clip, clip_params):
+    """--serve: drive the continuous-batching engine from a JSONL request
+    stream (docs/SERVING.md §5).  One line per request::
+
+        {"text": "...", "seed": 3, "temperature": 0.9, "top_p": 0.95,
+         "deadline_s": 30.0, "id": "job-17"}
+
+    Every field but ``text`` is optional (defaults come from the CLI
+    flags).  Per-request ``top_p`` is honored only when the engine was
+    built for nucleus sampling, i.e. when ``--top_p`` was passed.  Images
+    land in ``<outputs_dir>/serve/<id>.jpg`` as each request finishes —
+    detokenization runs on the scheduler's worker thread, so slow VAE
+    decode never stalls the token loop.  Composes with --mesh_*, --int8,
+    --kv_int8 exactly like batch generation (the engine is built under
+    the same ambient mesh, from the same quantized model)."""
+    import json
+    import sys
+    import threading
+
+    from dalle_tpu.parallel.mesh import mesh_kwargs_from_args
+    from dalle_tpu.serving import DecodeEngine, Request, RequestQueue, Scheduler
+
+    mesh_kw = mesh_kwargs_from_args(args)
+    stack = contextlib.ExitStack()
+    if mesh_kw:
+        from dalle_tpu.parallel import make_mesh
+        from dalle_tpu.parallel.mesh import ambient
+        from dalle_tpu.parallel.partition import shard_params
+
+        mesh = make_mesh(**mesh_kw)
+        params = shard_params(params, mesh)
+        vae_params = shard_params(vae_params, mesh)
+        if clip_params is not None:
+            clip_params = shard_params(clip_params, mesh)
+        stack.enter_context(ambient(mesh))
+        print(f"sharded serving over mesh {dict(mesh.shape)}")
+
+    outdir = Path(args.outputs_dir) / "serve"
+    outdir.mkdir(parents=True, exist_ok=True)
+
+    from PIL import Image
+
+    def on_result(req):
+        if req.dropped:
+            print(f"[{req.request_id}] dropped: deadline {req.deadline_s}s "
+                  "expired before admission")
+            return
+        if req.image is not None:
+            arr = (np.clip(req.image.astype(np.float32), 0, 1) * 255)
+            Image.fromarray(arr.astype(np.uint8)).save(
+                outdir / f"{req.request_id}.jpg"
+            )
+        score = (f" clip={req.clip_score:.4f}"
+                 if req.clip_score is not None else "")
+        print(f"[{req.request_id}] done: ttlt={req.ttlt:.3f}s{score}")
+
+    try:
+        engine = DecodeEngine(
+            model, params, num_slots=args.serve_slots,
+            filter_thres=args.top_k, use_top_p=args.top_p is not None,
+        )
+        engine.warmup()
+        req_queue = RequestQueue()
+        sched = Scheduler(
+            engine, req_queue, policy=args.serve_policy,
+            vae=vae, vae_params=vae_params, clip=clip,
+            clip_params=clip_params, on_result=on_result,
+        )
+        print(f"serving: {args.serve_slots} slots, policy "
+              f"{args.serve_policy}, stream "
+              f"{'stdin' if args.serve == '-' else args.serve}")
+
+        def feeder():
+            stream = sys.stdin if args.serve == "-" else open(args.serve)
+            try:
+                for i, line in enumerate(stream):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    d = json.loads(line)
+                    tokens = tokenizer.tokenize(
+                        d["text"], cfg.text_seq_len, truncate_text=True
+                    ).astype(np.int32)[0]
+                    # per-request top_p only in a top-p engine; otherwise
+                    # the CLI's static sampling mode applies to everyone
+                    top_p = (d.get("top_p", args.top_p)
+                             if args.top_p is not None else None)
+                    req_queue.submit(Request(
+                        text_tokens=tokens,
+                        seed=int(d.get("seed", args.seed + i)),
+                        temperature=float(
+                            d.get("temperature", args.temperature)
+                        ),
+                        top_p=top_p,
+                        deadline_s=d.get("deadline_s"),
+                        request_id=str(d.get("id", f"req{i}")),
+                    ))
+            finally:
+                if stream is not sys.stdin:
+                    stream.close()
+                req_queue.close()
+
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        stats = sched.run()
+        th.join()
+        print(json.dumps(stats))
+    finally:
+        stack.close()
 
 
 def _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,

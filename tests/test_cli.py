@@ -128,6 +128,28 @@ def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
     ])
     assert len(list(Path(p_dir).glob("*/*.jpg"))) == 2
 
+    # --serve: continuous-batching server mode — a JSONL request stream
+    # in, one image per request out (dalle_tpu/serving/, docs/SERVING.md
+    # §5); three requests through two slots forces in-flight admission
+    import json
+
+    s_dir = str(tmp_path / "outputs_serve")
+    stream = tmp_path / "requests.jsonl"
+    stream.write_text("\n".join(json.dumps(d) for d in [
+        {"text": "red square", "seed": 1, "id": "a"},
+        {"text": "green circle", "seed": 2, "temperature": 0.8, "id": "b"},
+        {"text": "blue cross", "seed": 3, "id": "c"},
+    ]) + "\n")
+    generate.main([
+        "--dalle_path", dalle_out + "/dalle-final",
+        "--serve", str(stream), "--serve_slots", "2",
+        "--outputs_dir", s_dir,
+    ])
+    served = sorted(p.name for p in (Path(s_dir) / "serve").glob("*.jpg"))
+    assert served == ["a.jpg", "b.jpg", "c.jpg"]
+    img = Image.open(Path(s_dir) / "serve" / "a.jpg")
+    assert img.size == (16, 16)
+
 
 def test_train_dalle_webdataset_cli(tmp_path):
     """train_dalle end to end from tar shards (--wds), the reference's

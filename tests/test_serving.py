@@ -1,0 +1,424 @@
+"""Continuous-batching serving tests (dalle_tpu/serving/).
+
+The exactness contract: a request admitted into an engine slot mid-flight
+produces BIT-IDENTICAL image codes to the same request decoded solo by
+``generate_image_codes`` with the same seed.  That reduces to three
+pinned layers:
+
+1. the vector-``pos`` path of ``DALLE.decode_step`` is bitwise equal to
+   the scalar path (all cache layouts — full/GQA/gMLP/shift+rotary/
+   kv_int8);
+2. lanes at *staggered* positions decode exactly as they would solo
+   (per-lane cache rows, masks, rotary tables are independent);
+3. the engine's per-slot RNG ladder replays the solo scan's key schedule
+   (``jax.random.split(PRNGKey(seed), image_seq_len)``), so the sampled
+   trajectory — not just the logits — matches.
+
+Plus the serving plumbing: queue FIFO/close/deadlines, admission
+policies, trace round-trip, and the no-recompile pins (traced
+temperature/top_p in scan_decode; engine tick/admit compile once).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.generate import generate_image_codes
+from dalle_tpu.ops.sampling import sample_logits, sample_logits_per_slot
+from dalle_tpu.serving import (
+    DecodeEngine,
+    Request,
+    RequestQueue,
+    Scheduler,
+    load_trace,
+    make_poisson_trace,
+    replay_trace,
+    save_trace,
+)
+
+T, F = 4, 2
+N_IMG = F * F
+
+
+def build(rng, *, kv_int8=False, **kw):
+    kw.setdefault("image_fmap_size", F)
+    cfg = DALLEConfig(
+        num_text_tokens=30,
+        text_seq_len=T,
+        num_image_tokens=20,
+        dim=32,
+        depth=2,
+        heads=2,
+        dim_head=16,
+        **kw,
+    )
+    text = jax.random.randint(rng, (3, T), 1, 30)
+    codes = jax.random.randint(rng, (3, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    if kv_int8:
+        from dalle_tpu.models.quantize import kv_int8_model
+
+        model = kv_int8_model(model)
+    return model, params, text
+
+
+LAYOUTS = {
+    "full": {},
+    "gqa": dict(kv_heads=1),
+    "mlp": dict(attn_types=("mlp",)),
+    "shift_rot": dict(shift_tokens=True, rotary_emb=True),
+    "kv_int8": dict(kv_int8=True),
+    "kv_int8_mlp": dict(kv_int8=True, attn_types=("mlp",)),
+}
+
+
+# --- 1. scalar vs vector decode_step -----------------------------------
+
+
+@pytest.mark.parametrize("layout", sorted(LAYOUTS))
+def test_decode_step_vector_pos_matches_scalar(rng, layout):
+    """`decode_step(fed, pos)` with pos a [b] vector (all lanes equal) is
+    bitwise the scalar-pos path — logits AND every cache leaf.  Existing
+    callers (scan_decode, export) keep the scalar path; the engine uses
+    the vector one."""
+    model, params, text = build(rng, **LAYOUTS[layout])
+    c = model.cfg
+    b = text.shape[0]
+
+    def prefilled():
+        cache = model.apply({"params": params}, b, method=DALLE.init_cache)
+        return model.apply(
+            {"params": params}, text.astype(jnp.int32), cache,
+            method=DALLE.prefill,
+        )
+
+    cache_s, cache_v = prefilled(), prefilled()
+    remapped = model.apply(
+        {"params": params}, text, method=DALLE.remap_pad_tokens
+    )
+    fed = remapped[:, -1].astype(jnp.int32)
+    for step in range(3):
+        p = c.text_seq_len + step
+        log_s, cache_s = model.apply(
+            {"params": params}, fed, p, cache_s, image_only=True,
+            method=DALLE.decode_step,
+        )
+        log_v, cache_v = model.apply(
+            {"params": params}, fed, jnp.full((b,), p, jnp.int32), cache_v,
+            image_only=True, method=DALLE.decode_step,
+        )
+        np.testing.assert_array_equal(np.asarray(log_s), np.asarray(log_v))
+        jax.tree_util.tree_map(
+            lambda a, bb: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(bb)
+            ),
+            cache_s, cache_v,
+        )
+        fed = jnp.argmax(log_s, axis=-1).astype(jnp.int32)
+
+
+@pytest.mark.parametrize("layout", ["full", "shift_rot", "kv_int8"])
+def test_decode_step_staggered_lanes_match_solo(rng, layout):
+    """Lanes decoding at DIFFERENT positions in one vector step produce
+    exactly the logits each would produce solo — per-lane cache rows,
+    masks, and rotary slices are independent (the property continuous
+    batching rests on)."""
+    # 3x3 image grid: stagger offsets + vector steps must fit inside
+    # image_seq_len (max offset + n_vec <= 9)
+    model, params, text = build(rng, image_fmap_size=3, **LAYOUTS[layout])
+    c = model.cfg
+    t = c.text_seq_len
+    offsets = [0, 2, 5]
+    n_vec = 4  # vector steps to run (keeps every lane < image_seq_len)
+
+    # --- solo: each lane in its own batch-of-1 cache, greedy feds;
+    # snapshot the cache + next fed at the lane's stagger point ---
+    solo_logits = []  # [lane][step] over offsets[i] + n_vec steps
+    lane_caches, lane_feds = [], []
+    remapped = model.apply(
+        {"params": params}, text, method=DALLE.remap_pad_tokens
+    )
+    for i, off in enumerate(offsets):
+        cache = model.apply({"params": params}, 1, method=DALLE.init_cache)
+        cache = model.apply(
+            {"params": params}, text[i : i + 1].astype(jnp.int32), cache,
+            method=DALLE.prefill,
+        )
+        fed = remapped[i : i + 1, -1].astype(jnp.int32)
+        logs = []
+        for step in range(off + n_vec):
+            if step == off:
+                lane_caches.append(cache)
+                lane_feds.append(fed)
+            log, cache = model.apply(
+                {"params": params}, fed, t + step, cache, image_only=True,
+                method=DALLE.decode_step,
+            )
+            logs.append(np.asarray(log[0]))
+            fed = jnp.argmax(log, axis=-1).astype(jnp.int32)
+        solo_logits.append(logs)
+
+    # --- vector: stack the lane caches, decode all three at once ---
+    vcache = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *lane_caches
+    )
+    fed = jnp.concatenate(lane_feds)
+    pos = jnp.asarray([t + off for off in offsets], jnp.int32)
+    for step in range(n_vec):
+        log, vcache = model.apply(
+            {"params": params}, fed, pos, vcache, image_only=True,
+            method=DALLE.decode_step,
+        )
+        for i, off in enumerate(offsets):
+            np.testing.assert_array_equal(
+                np.asarray(log[i]), solo_logits[i][off + step],
+                err_msg=f"lane {i} (offset {off}) diverged at step {step}",
+            )
+        fed = jnp.argmax(log, axis=-1).astype(jnp.int32)
+        pos = pos + 1
+
+
+def test_sample_logits_per_slot_matches_solo(rng):
+    """Per-slot sampling (vmapped, per-lane temperature/top_p) is bitwise
+    the row-at-a-time `sample_logits` — threefry + the filter math are
+    integer/elementwise, nothing reassociates across lanes."""
+    keys = jax.random.split(jax.random.PRNGKey(7), 5)
+    logits = jax.random.normal(rng, (5, 33), jnp.float32)
+    temps = jnp.asarray([0.5, 1.0, 1.5, 0.8, 1.2], jnp.float32)
+    batch = sample_logits_per_slot(
+        keys, logits, temperature=temps, filter_thres=0.5
+    )
+    for i in range(5):
+        solo = sample_logits(
+            keys[i], logits[i : i + 1], temperature=temps[i],
+            filter_thres=0.5,
+        )[0]
+        assert int(batch[i]) == int(solo)
+    # and the nucleus path
+    tps = jnp.asarray([0.9, 0.5, 0.99, 0.7, 0.8], jnp.float32)
+    batch = sample_logits_per_slot(
+        keys, logits, temperature=temps, filter_thres=0.5, top_p=tps
+    )
+    for i in range(5):
+        solo = sample_logits(
+            keys[i], logits[i : i + 1], temperature=temps[i],
+            filter_thres=0.5, top_p=tps[i],
+        )[0]
+        assert int(batch[i]) == int(solo)
+
+
+# --- 2. engine: staggered admission == solo decode ----------------------
+
+
+ENGINE_MODES = {
+    # name: (model kwargs, sampling kwargs)
+    "greedy": ({}, dict(temperature=1e-8, filter_thres=0.0)),
+    "sampled": ({}, dict(temperature=1.0, filter_thres=0.9)),
+    "kv_int8": (dict(kv_int8=True), dict(temperature=1.0, filter_thres=0.9)),
+    "top_p": ({}, dict(temperature=0.9, filter_thres=0.5, top_p=0.9)),
+}
+
+
+@pytest.mark.parametrize("mode", sorted(ENGINE_MODES))
+def test_engine_staggered_admission_bitwise_matches_solo(rng, mode):
+    """Five requests through three slots with forced staggering: every
+    request's codes are bit-identical to `generate_image_codes` run solo
+    with the same seed — admission tick and slot neighbours must not
+    change a single sampled token."""
+    model_kw, samp = ENGINE_MODES[mode]
+    top_p = samp.get("top_p")
+    model, params, _ = build(rng, **model_kw)
+    c = model.cfg
+    texts = jax.random.randint(rng, (5, T), 1, c.num_text_tokens)
+
+    expected = [
+        np.asarray(generate_image_codes(
+            model, params, texts[i : i + 1], jax.random.PRNGKey(100 + i),
+            filter_thres=samp["filter_thres"],
+            temperature=samp["temperature"], top_p=top_p,
+        )[0])
+        for i in range(5)
+    ]
+
+    engine = DecodeEngine(
+        model, params, num_slots=3, filter_thres=samp["filter_thres"],
+        use_top_p=top_p is not None,
+    )
+    engine.warmup()
+    reqs = [
+        Request(
+            text_tokens=np.asarray(texts[i]), seed=100 + i,
+            temperature=samp["temperature"], top_p=top_p,
+            request_id=f"r{i}",
+        )
+        for i in range(5)
+    ]
+    # staggered plan: 2 at tick 0, 1 more at tick 2 (mid-flight), rest
+    # whenever slots free up (naturally staggered by completion order)
+    pending = list(reqs)
+    engine.admit([pending.pop(0), pending.pop(0)])
+    done = []
+    while pending or engine.num_active:
+        if engine.tick_count == 2 and pending and engine.free_slots():
+            engine.admit([pending.pop(0)])
+        elif engine.tick_count > 2 and pending:
+            free = engine.free_slots()
+            take = min(len(free), len(pending))
+            if take:
+                engine.admit([pending.pop(0) for _ in range(take)])
+        done.extend(engine.step())
+    assert len(done) == 5
+    assert engine.tick_count > c.image_seq_len  # actually staggered
+
+    for req in reqs:
+        i = int(req.request_id[1:])
+        np.testing.assert_array_equal(
+            req.codes, expected[i],
+            err_msg=f"request {i} ({mode}) != solo decode",
+        )
+        assert req.finish_time is not None and req.admit_time is not None
+
+
+def test_engine_no_recompile_across_occupancy(rng):
+    """Admitting 1, 2, or 3 requests into a 3-slot engine and ticking at
+    any occupancy reuses ONE compiled tick and ONE compiled admit —
+    static shapes in (num_slots, total_seq_len)."""
+    model, params, _ = build(rng)
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=0.9)
+    engine.warmup()
+    texts = np.random.RandomState(0).randint(1, 30, size=(6, T))
+    mk = lambda i: Request(text_tokens=texts[i], seed=i)
+    engine.admit([mk(0)])
+    engine.step()
+    engine.admit([mk(1), mk(2)])
+    for _ in range(6):
+        engine.step()
+    engine.admit([mk(3)])
+    while engine.num_active:
+        engine.step()
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+
+
+# --- 3. scan_decode: sampling config is traced --------------------------
+
+
+def test_scan_decode_sampling_config_does_not_recompile(rng):
+    """temperature/top_p are traced operands of the decode scan: retuning
+    them costs zero recompiles.  Only filter_thres (the top-k SHAPE) and
+    the top_p None<->float structure switch recompile."""
+    from dalle_tpu.models.generate import _build_forced, scan_decode
+
+    model, params, text = build(rng)
+    c = model.cfg
+    forced, mask = _build_forced(model, params, text)
+    kw = dict(
+        model=model, num_steps=c.image_seq_len, start=c.text_seq_len,
+        prefill_text=text.astype(jnp.int32), image_only=True,
+    )
+    key = jax.random.PRNGKey(0)
+
+    scan_decode(params=params, forced=forced, forced_mask=mask, key=key,
+                filter_thres=0.9, temperature=1.0, **kw)
+    base = scan_decode._cache_size()
+    scan_decode(params=params, forced=forced, forced_mask=mask, key=key,
+                filter_thres=0.9, temperature=0.25, **kw)
+    assert scan_decode._cache_size() == base, "temperature recompiled"
+
+    scan_decode(params=params, forced=forced, forced_mask=mask, key=key,
+                filter_thres=0.9, temperature=1.0, top_p=0.9, **kw)
+    assert scan_decode._cache_size() == base + 1  # None -> float: structure
+    scan_decode(params=params, forced=forced, forced_mask=mask, key=key,
+                filter_thres=0.9, temperature=1.0, top_p=0.5, **kw)
+    assert scan_decode._cache_size() == base + 1, "top_p value recompiled"
+
+    scan_decode(params=params, forced=forced, forced_mask=mask, key=key,
+                filter_thres=0.5, temperature=1.0, **kw)
+    assert scan_decode._cache_size() == base + 2  # top-k shape: static
+
+
+# --- 4. queue / scheduler / policies ------------------------------------
+
+
+def test_request_queue_fifo_and_close():
+    q = RequestQueue()
+    reqs = [Request(text_tokens=np.zeros(T, np.int32), request_id=f"q{i}")
+            for i in range(4)]
+    for r in reqs:
+        q.submit(r)
+    assert r.arrival_time is not None
+    assert q.pending() == 4
+    got = q.pop(2)
+    assert [r.request_id for r in got] == ["q0", "q1"]
+    q.close()
+    assert q.closed
+    with pytest.raises(RuntimeError):
+        q.submit(Request(text_tokens=np.zeros(T, np.int32)))
+    assert [r.request_id for r in q.pop(10)] == ["q2", "q3"]
+
+
+def test_scheduler_drops_expired_deadline(rng):
+    model, params, _ = build(rng)
+    engine = DecodeEngine(model, params, num_slots=2, filter_thres=0.9)
+    engine.warmup()
+    q = RequestQueue()
+    texts = np.random.RandomState(1).randint(1, 30, size=(2, T))
+    live = q.submit(Request(text_tokens=texts[0], seed=0))
+    dead = q.submit(Request(text_tokens=texts[1], seed=1, deadline_s=-1.0))
+    q.close()
+    stats = Scheduler(engine, q, policy="continuous").run()
+    assert stats["served"] == 1 and stats["dropped"] == 1
+    assert dead.dropped and dead.codes is None and dead._done.is_set()
+    assert not live.dropped and live.codes is not None
+
+
+@pytest.mark.parametrize("policy,expect_ticks", [
+    # 3 requests, 2 slots, S=4 ticks per request:
+    ("sequential", 3 * N_IMG),  # one at a time: 3 solo flights
+    ("full_batch", 2 * N_IMG),  # wave of 2, then the tail wave of 1
+])
+def test_policy_admission_cadence(rng, policy, expect_ticks):
+    model, params, _ = build(rng)
+    engine = DecodeEngine(model, params, num_slots=2, filter_thres=0.9)
+    engine.warmup()
+    q = RequestQueue()
+    texts = np.random.RandomState(2).randint(1, 30, size=(3, T))
+    for i in range(3):
+        q.submit(Request(text_tokens=texts[i], seed=i))
+    q.close()
+    stats = Scheduler(engine, q, policy=policy).run()
+    assert stats["served"] == 3 and stats["dropped"] == 0
+    assert stats["ticks"] == expect_ticks
+    assert stats["tokens"] == 3 * N_IMG
+    assert stats["tokens_per_s"] > 0
+    assert stats["ttlt_p99_s"] >= stats["ttlt_p50_s"] > 0
+
+
+def test_trace_roundtrip_and_replay(rng, tmp_path):
+    trace = make_poisson_trace(4, 50.0, T, 30, seed=3)
+    trace[1].top_p = 0.9
+    trace[2].deadline_s = 30.0
+    path = str(tmp_path / "trace.jsonl")
+    save_trace(path, trace)
+    loaded = load_trace(path)
+    assert len(loaded) == 4
+    for a, b in zip(trace, loaded):
+        assert a.arrival_s == b.arrival_s
+        np.testing.assert_array_equal(
+            np.asarray(a.text_tokens), b.text_tokens
+        )
+        assert (a.seed, a.temperature, a.top_p, a.deadline_s,
+                a.request_id) == (
+            b.seed, b.temperature, b.top_p, b.deadline_s, b.request_id)
+
+    model, params, _ = build(rng)
+    stats = replay_trace(
+        model, params, loaded, policy="continuous", num_slots=2,
+        time_scale=0.0,  # burst replay: no wall-clock sleeps in tests
+    )
+    assert stats["served"] == 4 and stats["dropped"] == 0
+    assert stats["tokens"] == 4 * N_IMG

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Offline serving-trace replay: tune slot count / policy against a
+recorded arrival trace (docs/SERVING.md §5).
+
+Replays a JSONL arrival trace (one ``{"arrival_s": ..., "text_tokens":
+[...], "seed": ..., ...}`` line per request — the format written by
+``dalle_tpu.serving.save_trace``) against the continuous-batching
+engine for each requested slot count and policy, and prints one JSON
+line per combination: tokens/s, p50/p99 TTLT, served/dropped counts.
+The same trace drives every combination, so the comparison sees
+identical traffic — pick the smallest B whose p99 meets your SLO.
+
+    # synthesize a 64-request Poisson trace at 2 req/s, save it, sweep B
+    python tools/serving_bench.py --quick --synth 64 --rate_hz 2.0 \
+        --save_trace /tmp/trace.jsonl --slots 1,4,8,16
+
+    # replay a recorded production trace against a real checkpoint
+    python tools/serving_bench.py --dalle_path ckpt/ \
+        --trace prod_trace.jsonl --slots 8,16 --policy continuous
+
+``--quick`` runs a tiny randomly-initialized model (no checkpoint) —
+arrival *pattern* effects (queueing, admission stalls) reproduce fine at
+toy scale; absolute tokens/s obviously does not transfer.  Runs on
+whatever backend JAX selects; BENCH_PLATFORM=cpu forces CPU.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Replay serving arrival traces to tune slot count"
+    )
+    ap.add_argument("--trace", type=str, default=None,
+                    help="JSONL arrival trace to replay (serving.save_trace "
+                         "format); omit with --synth to generate one")
+    ap.add_argument("--synth", type=int, default=None,
+                    help="synthesize a Poisson trace with this many requests "
+                         "instead of loading --trace")
+    ap.add_argument("--rate_hz", type=float, default=2.0,
+                    help="with --synth: mean arrival rate")
+    ap.add_argument("--trace_seed", type=int, default=0,
+                    help="with --synth: RNG seed for arrivals + prompts")
+    ap.add_argument("--save_trace", type=str, default=None,
+                    help="write the (synthesized or loaded) trace here for "
+                         "later replays")
+    ap.add_argument("--slots", type=str, default="1,4,8",
+                    help="comma-separated slot counts to sweep")
+    ap.add_argument("--policy", type=str, default="continuous",
+                    help="comma-separated subset of "
+                         "sequential,full_batch,continuous (or 'all')")
+    ap.add_argument("--filter_thres", type=float, default=0.9)
+    ap.add_argument("--time_scale", type=float, default=1.0,
+                    help="scale recorded arrival offsets (0 = replay as a "
+                         "burst, ignoring recorded gaps)")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny randomly-initialized model instead of a "
+                         "checkpoint (pattern effects only)")
+    ap.add_argument("--dalle_path", type=str, default=None,
+                    help="checkpoint to serve (omit with --quick)")
+    ap.add_argument("--no_ema", action="store_true")
+    return ap.parse_args(argv)
+
+
+def _quick_model(seed=0):
+    """The bench rung's smoke shape: big enough for a 64-token image
+    sequence, small enough that a full sweep runs in seconds on CPU."""
+    import jax
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    cfg = DALLEConfig(
+        num_text_tokens=64, text_seq_len=16, num_image_tokens=128,
+        image_fmap_size=8, dim=32, depth=2, heads=2, dim_head=16,
+        attn_types=("full",),
+    )
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(seed)
+    text = jax.random.randint(rng, (1, cfg.text_seq_len), 1,
+                              cfg.num_text_tokens)
+    codes = jax.random.randint(rng, (1, cfg.image_seq_len), 0,
+                               cfg.num_image_tokens)
+    params = model.init({"params": rng}, text, codes)["params"]
+    return model, params
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    from dalle_tpu.serving import (
+        POLICIES, load_trace, make_poisson_trace, replay_trace, save_trace,
+    )
+
+    assert args.quick or args.dalle_path, (
+        "pass --dalle_path CKPT or --quick"
+    )
+    if args.quick:
+        model, params = _quick_model()
+    else:
+        from dalle_tpu.training.checkpoint import load_dalle_for_eval
+
+        model, params, _meta, notes = load_dalle_for_eval(
+            args.dalle_path, prefer_ema=not args.no_ema,
+        )
+        for note in notes:
+            print(note, file=sys.stderr)
+    cfg = model.cfg
+
+    if args.synth is not None:
+        trace = make_poisson_trace(
+            args.synth, args.rate_hz, cfg.text_seq_len,
+            cfg.num_text_tokens, seed=args.trace_seed,
+        )
+    else:
+        assert args.trace, "pass --trace FILE or --synth N"
+        trace = load_trace(args.trace)
+        for it in trace:
+            assert len(it.text_tokens) == cfg.text_seq_len, (
+                f"trace text length {len(it.text_tokens)} != model "
+                f"text_seq_len {cfg.text_seq_len}"
+            )
+    if args.save_trace:
+        save_trace(args.save_trace, trace)
+        print(f"wrote {len(trace)} arrivals to {args.save_trace}",
+              file=sys.stderr)
+
+    policies = (POLICIES if args.policy == "all"
+                else tuple(args.policy.split(",")))
+    for p in policies:
+        assert p in POLICIES, f"unknown policy {p!r} (not in {POLICIES})"
+    slot_counts = [int(s) for s in args.slots.split(",")]
+
+    for policy in policies:
+        for slots in slot_counts:
+            if policy == "sequential" and slots != slot_counts[0]:
+                continue  # batch-of-1 ignores the slot count
+            stats = replay_trace(
+                model, params, trace, policy=policy, num_slots=slots,
+                filter_thres=args.filter_thres,
+                time_scale=args.time_scale,
+            )
+            print(json.dumps(stats))
+
+
+if __name__ == "__main__":
+    main()

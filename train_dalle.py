@@ -190,7 +190,11 @@ def parse_args(argv=None):
     parser.add_argument("--shift_tokens", action="store_true")
     parser.add_argument("--rotary_emb", action="store_true")
     parser.add_argument("--shared_attn_ids", type=str, default=None,
-                        help="unsupported (reference janEbert extension); ignored")
+                        help="accepted-but-ignored compatibility shim for "
+                             "later upstream DALLE-pytorch CLIs; the "
+                             "reference at the reproduced version has no "
+                             "such flag (layer weight sharing unsupported "
+                             "here)")
     parser.add_argument("--stable_softmax", dest="stable", action="store_true")
     parser.add_argument("--sandwich_norm", action="store_true")
     parser.add_argument("--attn_dropout", type=float, default=0.0)
