@@ -96,6 +96,12 @@ PHASES = [
     # reference's rainbow-notebook workflow (synthetic shapes -> VAE ->
     # DALLE -> generated-token accuracy, SURVEY.md §4.2) trained for real
     ("rainbow", 600, True),
+    # fault-tolerance evidence (docs/RESILIENCE.md): the chaos scenario —
+    # NaN grads at step 3 + SIGTERM at step 7 under --anomaly_policy skip
+    # must exit 0 with an intact checkpoint, and the --auto_resume
+    # trajectory must match the uninterrupted reference (rtol 2e-3, zero
+    # lost steps).  Host-side subprocesses; records even on a wedged chip
+    ("resilience", 900, False),
 ]
 
 # phases that are their own hardened scripts (run via custom argv instead of
@@ -1244,6 +1250,49 @@ def _ingest_bench():
     )
 
 
+def _resilience_bench():
+    """Chaos kill-and-resume rung (tools/chaos_run.py, the ISSUE pin).
+
+    Gate: the faulted run (nan_grad@3 + sigterm@7) exits 0 with an
+    intact checkpoint, and the resumed 10-step loss trajectory matches
+    the uninterrupted reference within rtol 2e-3 with zero lost steps.
+    A failed gate sets ``rung_failed`` (rung exits 2, evidence still
+    persisted)."""
+    import tempfile
+
+    from tools.chaos_run import run_chaos
+
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="bench_chaos_") as wd:
+        try:
+            verdict = run_chaos(wd, steps=10, nan_step=3, kill_step=7,
+                                rtol=2e-3)
+        except (RuntimeError, AssertionError) as e:
+            return {"rung_failed": f"chaos scenario crashed: {e}"[:2000],
+                    "wall_s": round(time.time() - t0, 1)}
+    _hb(
+        f"resilience: ok={verdict['ok']} lost={verdict['lost_steps']} "
+        f"mismatches={len(verdict['mismatches'])}"
+    )
+    res = {
+        "steps": verdict["steps"],
+        "nan_step": verdict["nan_step"],
+        "kill_step": verdict["kill_step"],
+        "rtol": verdict["rtol"],
+        "lost_steps": verdict["lost_steps"],
+        "mismatches": verdict["mismatches"],
+        "reference_trace": verdict["reference_trace"],
+        "resumed_trace": verdict["resumed_trace"],
+        "wall_s": round(time.time() - t0, 1),
+    }
+    if not verdict["ok"]:
+        res["rung_failed"] = (
+            f"trajectory parity: lost_steps={verdict['lost_steps']} "
+            f"mismatches={verdict['mismatches'][:3]} (rtol {verdict['rtol']})"
+        )
+    return res
+
+
 PHASE_FNS = {
     "train_tiny": lambda: _train_bench(tiny=True),
     "train": _train_bench,
@@ -1258,6 +1307,7 @@ PHASE_FNS = {
     "comms_budget": _comms_budget_bench,
     "serving_throughput": _serving_bench,
     "rainbow": _rainbow_bench,
+    "resilience": _resilience_bench,
 }
 
 

@@ -109,6 +109,9 @@ class TextImageDataset:
         self.truncate_captions = truncate_captions
         self.tokenizer = tokenizer
         self._rng = np.random.RandomState(seed)
+        #: samples replaced by a neighbor because their file was corrupt /
+        #: unreadable (quarantine-and-continue; surfaced via log_event)
+        self.quarantined = 0
 
         path = Path(folder)
         text_files = {p.stem: p for p in path.glob("**/*.txt")}
@@ -131,7 +134,13 @@ class TextImageDataset:
         return self[(ind + 1) % len(self)]
 
     def skip_sample(self, ind):
-        """Neighbor fallback (reference: loader.py:58-69)."""
+        """Neighbor fallback (reference: loader.py:58-69), counted as a
+        quarantine so a rotting dataset is visible, not silent."""
+        self.quarantined += 1
+        from dalle_tpu.training.logging import log_event
+
+        log_event("data_sample_quarantined", dataset="TextImageDataset",
+                  index=int(ind), total=self.quarantined)
         return self.random_sample() if self.shuffle else self.sequential_sample(ind)
 
     def _load_image(self, key) -> np.ndarray:
@@ -184,6 +193,7 @@ class TextImageDataset:
             ind = int(ind)
             tokens = self._caption_tokens(ind)
             while tokens is None:  # caption-side skip, mirrors __getitem__
+                self.quarantined += 1
                 ind = (ind + 1) % len(self) if not self.shuffle else int(
                     self._rng.randint(0, len(self))
                 )
@@ -223,6 +233,7 @@ class ImageFolderDataset:
             p for p in path.glob("**/*") if p.suffix.lower() in IMAGE_EXTS
         )
         self.image_size = image_size
+        self.quarantined = 0
 
     def __len__(self):
         return len(self.files)
@@ -233,6 +244,11 @@ class ImageFolderDataset:
         except Exception:
             # corrupt image → neighbor fallback, same policy as
             # TextImageDataset (reference: loader.py:58-69)
+            self.quarantined += 1
+            from dalle_tpu.training.logging import log_event
+
+            log_event("data_sample_quarantined", dataset="ImageFolderDataset",
+                      index=int(ind), total=self.quarantined)
             return self[(ind + 1) % len(self)]
         h, w = rgb.shape[:2]
         side = min(w, h)
@@ -358,15 +374,24 @@ class DataLoader:
             return None
 
     def __iter__(self) -> Iterator:
+        from dalle_tpu.training import faults
+
         batches = self._indices()
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = object()
+        err: list = []  # worker-side exception, re-raised on the consumer
 
         def worker():
             pipeline = self._open_pipeline()
             try:
-                for rows in batches:
+                for i, rows in enumerate(batches):
+                    faults.loader_stall(i)
                     q.put(self._make_batch(rows, pipeline))
+            except BaseException as e:
+                # without this the stop sentinel in `finally` turns any
+                # worker crash into a silently SHORT epoch — the trainer
+                # would keep going minus most of its data
+                err.append(e)
             finally:
                 if pipeline is not None:
                     pipeline.close()
@@ -377,5 +402,7 @@ class DataLoader:
         while True:
             item = q.get()
             if item is stop:
+                if err:
+                    raise RuntimeError("DataLoader worker failed") from err[0]
                 break
             yield item

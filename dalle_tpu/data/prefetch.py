@@ -14,9 +14,77 @@ whole integration.
 from __future__ import annotations
 
 import collections
+import queue as queue_mod
+import threading
 from typing import Iterable, Iterator
 
 import jax
+
+from dalle_tpu.training.logging import log_event
+
+
+def watchdog_iter(it: Iterable, *, timeout_s: float, max_stalls: int = 5,
+                  label: str = "data") -> Iterator:
+    """Wrap a (possibly hanging) batch iterator with a stall watchdog.
+
+    A pump thread drains ``it`` into a depth-1 queue; the consumer side
+    waits at most ``timeout_s`` per batch.  Each timeout emits a
+    ``data_watchdog_stall`` event (heartbeat: the run is wedged on input,
+    not compute) and keeps waiting; ``max_stalls`` CONSECUTIVE timeouts
+    raise — at that point the pipeline is dead, not slow, and a loud
+    crash beats an idle chip.  A pump-side exception re-raises here with
+    the original as ``__cause__`` (the loader's thread boundary otherwise
+    swallows it into a silently short epoch).
+
+    ``timeout_s <= 0`` disables: returns ``iter(it)`` unwrapped.
+    """
+    if timeout_s <= 0:
+        return iter(it)
+
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=1)
+    done = object()
+    box: list = []  # pump-side exception, if any
+
+    def pump():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:
+            box.append(e)
+        finally:
+            q.put(done)
+
+    threading.Thread(target=pump, name=f"watchdog-{label}", daemon=True).start()
+
+    def gen():
+        stalls = 0
+        while True:
+            try:
+                item = q.get(timeout=timeout_s)
+            except queue_mod.Empty:
+                stalls += 1
+                log_event("data_watchdog_stall", label=label,
+                          timeout_s=timeout_s, consecutive=stalls)
+                print(f"[watchdog] {label}: no batch for "
+                      f"{timeout_s * stalls:.0f}s ({stalls}/{max_stalls})")
+                if stalls >= max_stalls:
+                    log_event("data_watchdog_abort", label=label,
+                              stalls=stalls)
+                    raise RuntimeError(
+                        f"data watchdog: {label} produced no batch in "
+                        f"{timeout_s * stalls:.0f}s — input pipeline is dead"
+                    )
+                continue
+            if item is done:
+                if box:
+                    raise RuntimeError(
+                        f"data pipeline worker failed ({label})"
+                    ) from box[0]
+                return
+            stalls = 0
+            yield item
+
+    return gen()
 
 
 def device_prefetch(it: Iterable, sharding, depth: int = 2) -> Iterator:

@@ -143,6 +143,7 @@ class WebDataset:
         self.shuffle_buffer = shuffle_buffer
         self.seed = seed
         self.epoch = 0
+        self.quarantined_shards = 0
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -154,18 +155,47 @@ class WebDataset:
         ik = self.image_key or next((k for k in IMAGE_KEYS if k in sample), None)
         return ck, ik
 
+    #: per-shard read retries (transient NFS/object-store hiccups): each
+    #: failed open/read re-opens the shard after an exponential backoff;
+    #: a shard that fails every attempt is quarantined (skipped + counted)
+    SHARD_RETRIES = 2
+    SHARD_BACKOFF_S = 0.5
+
+    def _iter_shard(self, url: str) -> Iterator[Dict[str, bytes]]:
+        """Samples of one shard with bounded re-open/backoff.  A retry
+        restarts the shard from the top — WebDataset sample streams are
+        unordered by contract, and duplicated samples from the replayed
+        prefix are benign next to losing the whole shard."""
+        import time
+
+        from dalle_tpu.training.logging import log_event
+
+        for attempt in range(1 + self.SHARD_RETRIES):
+            try:
+                yield from iter_tar_samples(url)
+                return
+            except (OSError, tarfile.TarError) as e:
+                if attempt < self.SHARD_RETRIES:
+                    delay = self.SHARD_BACKOFF_S * (2 ** attempt)
+                    log_event("wds_shard_retry", shard=url, attempt=attempt + 1,
+                              error=repr(e), backoff_s=delay)
+                    print(f"[wds] shard {url}: {e}; retry "
+                          f"{attempt + 1}/{self.SHARD_RETRIES} in {delay}s")
+                    time.sleep(delay)
+                else:
+                    self.quarantined_shards += 1
+                    log_event("wds_shard_quarantined", shard=url,
+                              error=repr(e), total=self.quarantined_shards)
+                    print(f"[wds] shard {url}: {e}; quarantined after "
+                          f"{self.SHARD_RETRIES} retries")
+
     def __iter__(self) -> Iterator[Dict[str, bytes]]:
         rng = np.random.RandomState(self.seed + self.epoch)
         order = rng.permutation(len(self.shards))
         my_shards = [self.shards[i] for i in order[self.rank :: self.world]]
         buf: List[Dict[str, bytes]] = []
         for url in my_shards:
-            try:
-                it = iter_tar_samples(url)
-            except (OSError, tarfile.TarError) as e:
-                print(f"[wds] shard {url}: {e}; skipping")
-                continue
-            for sample in it:
+            for sample in self._iter_shard(url):
                 ck, ik = self._keys(sample)
                 if ck is None or ik is None:
                     continue  # filtered (reference: train_dalle.py:361-368)
@@ -203,6 +233,7 @@ class BatchedWebLoader:
         self.image_size = image_size
         self.truncate_captions = truncate_captions
         self.nominal_length = nominal_length
+        self.quarantined = 0  # samples dropped on decode errors
 
     def __len__(self):
         if self.nominal_length is None:
@@ -235,7 +266,9 @@ class BatchedWebLoader:
                 try:
                     item = self._decode(sample)
                 except Exception as e:  # warn-and-continue (reference: :372)
-                    print(f"[wds] decode error: {e}; continuing")
+                    self.quarantined += 1
+                    print(f"[wds] decode error: {e}; continuing "
+                          f"({self.quarantined} quarantined)")
                     continue
                 if item is None:
                     continue

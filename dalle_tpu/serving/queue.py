@@ -44,6 +44,7 @@ class Request:
     image: Optional[np.ndarray] = None
     clip_score: Optional[float] = None
     dropped: bool = False
+    error: Optional[str] = None  # detok-worker failure, request still completes
     _done: threading.Event = field(
         default_factory=threading.Event, repr=False, compare=False
     )

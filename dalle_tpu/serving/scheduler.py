@@ -83,17 +83,34 @@ class Scheduler:
             if req is None:
                 return
             try:
-                if self._decode_fn is not None and req.codes is not None:
-                    req.image = np.asarray(self._decode_fn(req.codes[None]))[0]
-                    if self._clip_fn is not None:
-                        score = self._clip_fn(
-                            np.asarray(req.text_tokens, np.int32)[None],
-                            req.image[None],
-                        )
-                        req.clip_score = float(np.asarray(score).reshape(-1)[0])
-                req.detok_time = time.monotonic()
+                # one bad request (corrupt codes, a decode bug, an
+                # on_result callback that throws) must not kill the worker
+                # thread — that would wedge every later request's result()
+                try:
+                    if self._decode_fn is not None and req.codes is not None:
+                        req.image = np.asarray(
+                            self._decode_fn(req.codes[None])
+                        )[0]
+                        if self._clip_fn is not None:
+                            score = self._clip_fn(
+                                np.asarray(req.text_tokens, np.int32)[None],
+                                req.image[None],
+                            )
+                            req.clip_score = float(
+                                np.asarray(score).reshape(-1)[0]
+                            )
+                    req.detok_time = time.monotonic()
+                except Exception as e:
+                    req.error = f"{type(e).__name__}: {e}"
+                    req.detok_time = time.monotonic()
                 if self.on_result is not None:
-                    self.on_result(req)
+                    try:
+                        self.on_result(req)
+                    except Exception as e:
+                        if req.error is None:
+                            req.error = f"{type(e).__name__}: {e}"
+                        print(f"[serve] on_result failed for "
+                              f"{req.request_id}: {e}")
             finally:
                 req._done.set()
 

@@ -22,6 +22,7 @@ reference: train_dalle.py:483-488,545-546):
 from __future__ import annotations
 
 import json
+import os
 import shutil
 from pathlib import Path
 from typing import Any, Optional
@@ -29,7 +30,31 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from dalle_tpu.training import faults
+from dalle_tpu.training.logging import log_event
+
 _SUBTREES = ("params", "opt_state", "vae_params", "ema_params")
+
+#: completion marker: written inside the staging dir LAST (after every
+#: subtree and meta.json are on disk and fsync'd), so its presence in a
+#: renamed dir proves the write ran to completion.  Validation treats a
+#: dir without it as legacy-format and falls back to structural checks.
+_MARKER = "COMPLETE"
+
+
+def _fsync_dir(path: Path) -> None:
+    """fsync a directory so the rename/create of its entries is durable
+    (best-effort: not all filesystems support dir fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _is_primary() -> bool:
@@ -57,12 +82,16 @@ def save_checkpoint(
     vae_hparams: Optional[dict] = None,
     epoch: int = 0,
     step: int = 0,
+    data_step: int = 0,
     scheduler_state: Optional[dict] = None,
     optimizer_meta: Optional[dict] = None,
     keep_n: Optional[int] = None,
 ) -> str:
     path = Path(path).absolute()
-    tmp = path.with_name(path.name + ".tmp")
+    faults.on_ckpt_write(path)
+    # pid-suffixed staging dir: a crashed writer's leftover .tmp-* can
+    # never collide with (or be rmtree'd under) a live writer's staging
+    tmp = path.with_name(f"{path.name}.tmp-{os.getpid()}")
     if _is_primary():
         if tmp.exists():
             shutil.rmtree(tmp)
@@ -93,6 +122,11 @@ def save_checkpoint(
             "vae_hparams": vae_hparams,
             "epoch": epoch,
             "step": step,
+            # batches already applied within `epoch` — mid-epoch resume
+            # (and anomaly rollback) fast-forwards the deterministic
+            # loader by exactly this many batches so no batch is replayed
+            # against the restored params and none is lost
+            "data_step": data_step,
             "scheduler_state": scheduler_state,
             # optimizer-state POLICY (e.g. mu_bf16): the opt_state restore
             # is dtype-typed, so trainers must rebuild the same optimizer —
@@ -101,15 +135,55 @@ def save_checkpoint(
             "optimizer": optimizer_meta,
             "subtrees": [n for n in _SUBTREES if trees[n] is not None],
         }
-        (tmp / "meta.json").write_text(json.dumps(meta, indent=2))
+        with open(tmp / "meta.json", "w") as f:
+            f.write(json.dumps(meta, indent=2))
+            f.flush()
+            os.fsync(f.fileno())
+        # marker LAST: its presence proves every subtree + meta.json
+        # preceded it (write ordering within the staging dir)
+        with open(tmp / _MARKER, "w") as f:
+            f.write(f"step={step}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        faults.before_ckpt_rename()
         if path.exists():
             shutil.rmtree(path)
         tmp.rename(path)
+        _fsync_dir(path.parent)
 
         if keep_n is not None:
             prune_checkpoints(path.parent, keep_n, pattern=_family_pattern(path.name))
     _mp_barrier("renamed")
     return str(path)
+
+
+def is_intact_checkpoint(path) -> bool:
+    """True when ``path`` is a completed checkpoint safe to resume from.
+
+    Fast path: the :data:`_MARKER` file written last by
+    :func:`save_checkpoint` — its presence proves the write ran to
+    completion.  Dirs without it (written before the marker existed)
+    fall back to a structural check: meta.json parses and every subtree
+    it lists exists as a non-empty directory.  Staging dirs
+    (``*.tmp-*``) are never intact regardless of contents.
+    """
+    path = Path(path)
+    if ".tmp" in path.name:
+        return False
+    if not path.is_dir():
+        return False
+    try:
+        meta = json.loads((path / "meta.json").read_text())
+    except (ValueError, OSError):
+        return False
+    if (path / _MARKER).exists():
+        return True
+    for name in meta.get("subtrees", ()):
+        sub = path / name
+        if not sub.is_dir() or not any(sub.iterdir()):
+            return False
+    return True
 
 
 class AsyncCheckpointWriter:
@@ -132,13 +206,18 @@ class AsyncCheckpointWriter:
     reference: train_dalle.py:514-557).
     """
 
-    def __init__(self):
+    def __init__(self, retries: int = 3, backoff_s: float = 0.5):
         assert jax.process_count() == 1, (
             "AsyncCheckpointWriter is single-process; multi-host saves are "
             "collectives and must stay synchronous"
         )
         self._thread = None
         self._error = None
+        # transient-I/O retry policy: attempts = 1 + retries, exponential
+        # backoff between them.  Only OSError retries — a shape/pytree
+        # error would fail identically every attempt.
+        self.retries = max(int(retries), 0)
+        self.backoff_s = float(backoff_s)
 
     def _report_pending_error(self) -> None:
         # atexit net: a normal exit after an in-loop save joins the thread
@@ -184,8 +263,22 @@ class AsyncCheckpointWriter:
                 host_kwargs[name] = jax.device_get(host_kwargs[name])
 
         def work():
+            import time
+
             try:
-                save_checkpoint(path, **host_kwargs)
+                for attempt in range(1, self.retries + 2):
+                    try:
+                        save_checkpoint(path, **host_kwargs)
+                        return
+                    except OSError as e:
+                        if attempt > self.retries:
+                            raise
+                        delay = self.backoff_s * (2 ** (attempt - 1))
+                        log_event(
+                            "ckpt_retry", path=str(path), attempt=attempt,
+                            error=repr(e), backoff_s=delay,
+                        )
+                        time.sleep(delay)
             except BaseException as e:  # re-raised on the main thread
                 self._error = e
 
@@ -281,12 +374,19 @@ def find_latest_checkpoint(parent, prefix: str):
         return None
     best, best_key = None, None
     for d in parent.glob(f"{prefix}-*"):
-        # a crash between meta.json write and the atomic rename leaves a
-        # complete-looking {prefix}-stepN.tmp dir; resuming from it races
-        # with the next save of the same tag, which rmtree-deletes it
-        if d.name.endswith(".tmp"):
+        # a crash mid-save leaves {prefix}-stepN.tmp-<pid> staging dirs;
+        # a crash mid-rename (or torn disk) can leave a renamed dir with
+        # missing subtrees — is_intact_checkpoint rejects both, so
+        # --auto_resume falls back to the newest checkpoint that IS whole
+        if ".tmp" in d.name:
             continue
-        if not (d.is_dir() and (d / "meta.json").exists()):
+        if not d.is_dir():
+            continue
+        if not is_intact_checkpoint(d):
+            log_event(
+                "ckpt_corrupt_skipped", path=str(d),
+                reason="missing marker / unreadable meta / missing subtrees",
+            )
             continue
         try:
             step = json.loads((d / "meta.json").read_text()).get("step", 0)
@@ -318,10 +418,18 @@ def resolve_auto_resume(
         cands = [
             str(Path(output_path) / n) for n in candidates
         ]
-        cands = [c for c in cands if is_checkpoint(c)]
+        intact = []
+        for c in cands:
+            if is_intact_checkpoint(c):
+                intact.append(c)
+            elif Path(c).exists():
+                log_event(
+                    "ckpt_corrupt_skipped", path=c,
+                    reason="missing marker / unreadable meta / missing subtrees",
+                )
         latest = (
-            max(cands, key=lambda c: load_meta(c).get("step", 0))
-            if cands else None
+            max(intact, key=lambda c: load_meta(c).get("step", 0))
+            if intact else None
         )
     else:
         latest = find_latest_checkpoint(output_path, prefix)
@@ -357,14 +465,43 @@ def restore_train_state(path, meta, params, opt_state):
 
 
 def prune_checkpoints(parent: Path, keep_n: int, pattern: str = "*"):
-    """Delete oldest-by-mtime beyond keep_n (reference: train_dalle.py:523-526)."""
+    """Delete the oldest checkpoints beyond ``keep_n``
+    (reference: train_dalle.py:523-526), with the guarantees retention
+    must give resilience:
+
+    * in-flight staging dirs (``*.tmp-*``) are never candidates — an
+      async writer's half-finished save can't be deleted under it;
+    * "newest" orders by the COMPLETED write (saved ``step``, then
+      mtime), not bare mtime — a stale clock or slow rename can't make
+      the last-known-good checkpoint look old;
+    * ``keep_n`` floors at 1 so the last-known-good survives any config;
+    * a dir vanishing mid-prune (concurrent prune/crash cleanup) is
+      tolerated, not fatal.
+    """
     parent = Path(parent)
-    cands = [
-        d for d in parent.glob(pattern) if d.is_dir() and (d / "meta.json").exists()
-    ]
-    cands.sort(key=lambda d: d.stat().st_mtime, reverse=True)
-    for old in cands[keep_n:]:
-        shutil.rmtree(old)
+    keep_n = max(int(keep_n), 1)
+    cands = []
+    for d in parent.glob(pattern):
+        if ".tmp" in d.name or not d.is_dir():
+            continue
+        try:
+            meta = json.loads((d / "meta.json").read_text())
+        except (ValueError, OSError):
+            continue  # not a (readable) checkpoint: never ours to delete
+        try:
+            # intact-ness leads the sort key: a corrupted newer dir must
+            # never out-rank (and so evict) the last-known-good checkpoint
+            key = (is_intact_checkpoint(d), meta.get("step", 0),
+                   d.stat().st_mtime)
+        except OSError:
+            continue
+        cands.append((key, d))
+    cands.sort(key=lambda t: t[0], reverse=True)
+    for _, old in cands[keep_n:]:
+        try:
+            shutil.rmtree(old)
+        except FileNotFoundError:
+            pass
 
 
 def load_meta(path: str) -> dict:

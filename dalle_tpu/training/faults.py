@@ -1,0 +1,167 @@
+"""Deterministic fault injection for resilience testing.
+
+The chaos harness (tests/test_resilience.py, tools/chaos_run.py) needs
+faults that happen at exactly the same step on every run — otherwise
+"resumed trajectory matches the uninterrupted run" is unfalsifiable.
+All injection sites are driven by ONE schedule parsed from the
+``DALLE_FAULTS`` environment variable (inherited by trainer
+subprocesses) or set explicitly via :func:`configure`.
+
+Spec grammar — comma-separated events::
+
+    nan_grad@3          poison the gradients of global step 3 (the train
+                        step's fault_scale operand becomes NaN)
+    sigterm@7           deliver SIGTERM to this process at the top of
+                        step 7 (before the step runs); also sigint@N
+    ckpt_fail@2         the 2nd checkpoint-write attempt (process-wide,
+                        1-based) raises OSError; ranges: ckpt_fail@1-3
+    ckpt_delay@0.5      every checkpoint write sleeps 0.5 s before the
+                        atomic rename (holds the .tmp window open so
+                        tests can enumerate the directory mid-write)
+    loader_stall@5:2.5  the data loader sleeps 2.5 s before producing
+                        batch 5 (exercises the data watchdog)
+
+Zero overhead when off: every hook first checks a module bool that is
+False unless a schedule was configured — one attribute load per call,
+no device work ever.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, Optional, Set
+
+_ENV = "DALLE_FAULTS"
+
+_SIGNALS = {
+    "sigterm": signal.SIGTERM,
+    "sigint": signal.SIGINT,
+}
+
+
+class FaultPlan:
+    """Parsed fault schedule (see module docstring for the grammar)."""
+
+    def __init__(self):
+        self.nan_grad_steps: Set[int] = set()
+        self.signal_steps: Dict[int, int] = {}  # step -> signum (fire once)
+        self.ckpt_fail_attempts: Set[int] = set()  # 1-based write attempts
+        self.ckpt_delay_s: float = 0.0
+        self.loader_stalls: Dict[int, float] = {}  # batch index -> seconds
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        plan = cls()
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            name, _, arg = tok.partition("@")
+            name = name.strip().lower()
+            if name == "nan_grad":
+                plan.nan_grad_steps.add(int(arg))
+            elif name in _SIGNALS:
+                plan.signal_steps[int(arg)] = _SIGNALS[name]
+            elif name == "ckpt_fail":
+                if "-" in arg:
+                    lo, hi = arg.split("-")
+                    plan.ckpt_fail_attempts.update(range(int(lo), int(hi) + 1))
+                else:
+                    plan.ckpt_fail_attempts.add(int(arg))
+            elif name == "ckpt_delay":
+                plan.ckpt_delay_s = float(arg)
+            elif name == "loader_stall":
+                batch, _, secs = arg.partition(":")
+                plan.loader_stalls[int(batch)] = float(secs) if secs else 1.0
+            else:
+                raise ValueError(f"unknown fault event {tok!r} in {spec!r}")
+        return plan
+
+
+_active = False
+_plan: Optional[FaultPlan] = None
+_parsed = False
+_ckpt_attempts = 0
+
+
+def configure(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Install a fault schedule (None/"" clears it).  Resets counters."""
+    global _active, _plan, _parsed, _ckpt_attempts
+    _plan = FaultPlan.parse(spec) if spec else None
+    _active = _plan is not None
+    _parsed = True
+    _ckpt_attempts = 0
+    return _plan
+
+
+def reset():
+    """Forget everything, including the cached env parse (tests)."""
+    global _active, _plan, _parsed, _ckpt_attempts
+    _active, _plan, _parsed, _ckpt_attempts = False, None, False, 0
+
+
+def plan() -> Optional[FaultPlan]:
+    """The active schedule, lazily parsed from ``DALLE_FAULTS`` once."""
+    global _parsed
+    if not _parsed:
+        configure(os.environ.get(_ENV))
+    return _plan
+
+
+def active() -> bool:
+    if not _parsed:
+        plan()
+    return _active
+
+
+# --- injection hooks (each a no-op single bool check when off) -------------
+
+
+def grad_scale(step: int) -> float:
+    """Multiplier for the train step's loss: NaN on poisoned steps."""
+    if not active():
+        return 1.0
+    return float("nan") if step in _plan.nan_grad_steps else 1.0
+
+
+def check_signal(step: int) -> None:
+    """Deliver a scheduled signal at the top of ``step`` (fires once)."""
+    if not active():
+        return
+    signum = _plan.signal_steps.pop(step, None)
+    if signum is not None:
+        os.kill(os.getpid(), signum)
+
+
+def on_ckpt_write(path) -> None:
+    """Called at the top of every save_checkpoint: raises the injected
+    I/O failure on scheduled attempts (process-wide 1-based counter)."""
+    if not active():
+        return
+    global _ckpt_attempts
+    _ckpt_attempts += 1
+    if _ckpt_attempts in _plan.ckpt_fail_attempts:
+        raise OSError(
+            f"injected checkpoint write failure "
+            f"(attempt {_ckpt_attempts}, path {path})"
+        )
+
+
+def before_ckpt_rename() -> None:
+    """Called just before the atomic rename: holds the staging window
+    open so tests can observe that no partial checkpoint is visible."""
+    if not active():
+        return
+    if _plan.ckpt_delay_s:
+        time.sleep(_plan.ckpt_delay_s)
+
+
+def loader_stall(batch_index: int) -> None:
+    """Sleep before producing ``batch_index`` (data-watchdog exercise)."""
+    if not active():
+        return
+    secs = _plan.loader_stalls.get(batch_index)
+    if secs:
+        time.sleep(secs)

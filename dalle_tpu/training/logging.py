@@ -14,11 +14,68 @@ Root-worker gating is the caller's job, same idiom as the reference
 from __future__ import annotations
 
 import json
+import threading
 import time
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
+
+# --- structured events (events.jsonl per run dir) --------------------------
+#
+# Resilience machinery (anomaly skips, checkpoint retries, watchdog
+# timeouts, corrupt-checkpoint fallbacks) reports through log_event so
+# post-mortems read one JSONL file instead of scraping stdout.  Events
+# fired before a Run exists (e.g. --auto_resume rejecting a corrupted
+# checkpoint during startup) buffer in memory and flush into events.jsonl
+# when the Run opens it.
+
+_EVENT_LOCK = threading.Lock()
+_EVENT_SINK = None  # open file handle, bound by Run (or set_event_sink)
+_PENDING_EVENTS: list = []
+_PENDING_CAP = 1000
+
+
+def log_event(kind: str, **fields) -> dict:
+    """Append one structured event to the run's events.jsonl (buffered
+    until a Run binds the sink).  Thread-safe; never raises."""
+    rec = {"_time": time.time(), "kind": kind, **fields}
+    with _EVENT_LOCK:
+        if _EVENT_SINK is not None:
+            try:
+                _EVENT_SINK.write(json.dumps(rec) + "\n")
+                _EVENT_SINK.flush()
+            except (ValueError, OSError):
+                pass  # closed/broken sink: the event is best-effort
+        elif len(_PENDING_EVENTS) < _PENDING_CAP:
+            _PENDING_EVENTS.append(rec)
+    return rec
+
+
+def set_event_sink(fh) -> None:
+    """Bind (or with None, unbind) the events.jsonl handle; flushes any
+    events buffered before the sink existed."""
+    global _EVENT_SINK
+    with _EVENT_LOCK:
+        _EVENT_SINK = fh
+        if fh is not None and _PENDING_EVENTS:
+            for rec in _PENDING_EVENTS:
+                try:
+                    fh.write(json.dumps(rec) + "\n")
+                except (ValueError, OSError):
+                    break
+            _PENDING_EVENTS.clear()
+            try:
+                fh.flush()
+            except (ValueError, OSError):
+                pass
+
+
+def pending_events() -> list:
+    """Snapshot of events buffered before any sink was bound (tests,
+    and pre-Run diagnostics)."""
+    with _EVENT_LOCK:
+        return list(_PENDING_EVENTS)
 
 
 def _to_uint8(img: np.ndarray) -> np.ndarray:
@@ -71,6 +128,8 @@ class Run:
         self.dir.mkdir(parents=True, exist_ok=True)
         (self.dir / "media").mkdir(exist_ok=True)
         self._metrics = open(self.dir / "metrics.jsonl", "a")
+        self._events = open(self.dir / "events.jsonl", "a")
+        set_event_sink(self._events)
         if config:
             (self.dir / "config.json").write_text(json.dumps(config, indent=2))
 
@@ -142,7 +201,17 @@ class Run:
             json.dumps({"name": name, "path": str(path), "time": time.time()}) + "\n"
         )
 
+    def log_event(self, kind: str, **fields) -> dict:
+        """Structured event into this run's events.jsonl (module-level
+        :func:`log_event` under the hood, so library code that only has
+        the module reaches the same file)."""
+        return log_event(kind, **fields)
+
     def finish(self):
         self._metrics.close()
+        global _EVENT_SINK
+        if _EVENT_SINK is self._events:
+            set_event_sink(None)
+        self._events.close()
         if self._wandb:
             self._wandb.finish()

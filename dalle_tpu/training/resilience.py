@@ -1,0 +1,288 @@
+"""Training resilience: anomaly skip/rollback, preemption, loss tracing.
+
+The failure model (ROUND5_NOTES.md: the TPU dropped mid-session three
+rounds running; at scale preemption is the common case):
+
+* **Anomalous steps** — a NaN/Inf loss or grad, or a loss far outside
+  the recent distribution, must not poison the optimizer.  The jitted
+  train steps (train_lib, ``anomaly=True``) compute the global grad
+  norm and a finite-ness check and guard the optimizer update with
+  ``lax.cond`` — an anomalous step returns params/opt_state unchanged
+  inside the SAME compiled program (no recompile, no second step
+  variant; the skip threshold is a traced scalar operand).  The host
+  side of the loop feeds that threshold from a rolling median+MAD
+  spike detector (:class:`Resilience`) and escalates to
+  restore-from-last-good-checkpoint after ``--rollback_after K``
+  consecutive skips.
+* **Preemption** — SIGTERM/SIGINT set a flag; the trainer checks it at
+  the next step boundary, writes a synchronous checkpoint (including
+  the intra-epoch data position, so resume replays no batch and loses
+  none) and exits 0 (:class:`Resilience.install_signal_handlers`,
+  :exc:`Preempted`).
+
+Observability goes through ``training/logging.log_event`` (events.jsonl
+per run dir): ``anomaly_skip``, ``anomaly_rollback``, ``preempt_*``.
+
+``DALLE_LOSS_TRACE=<path>`` makes the trainer append one
+``{"step": N, "loss": x}`` JSONL line per step — the chaos harness
+(tools/chaos_run.py) compares these trajectories across kill/resume.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import signal
+import statistics
+import threading
+from typing import Optional
+
+from dalle_tpu.training.logging import log_event
+
+ANOMALY_POLICIES = ("off", "skip", "rollback")
+
+
+class Preempted(Exception):
+    """Raised by the train loop after the preemption checkpoint is
+    written; trainers catch it and exit 0 (clean shutdown, not a crash)."""
+
+
+def add_resilience_args(parser):
+    """The shared trainer flag surface (train_dalle / train_vae /
+    train_clip)."""
+    parser.add_argument(
+        "--anomaly_policy", type=str, default="off",
+        choices=ANOMALY_POLICIES,
+        help="in-step anomaly handling: 'skip' guards the optimizer "
+             "update with lax.cond inside the jitted step (non-finite "
+             "loss/grad-norm or a loss spiking past the rolling "
+             "median+MAD threshold applies a ZERO update); 'rollback' "
+             "additionally restores the last intact checkpoint after "
+             "--rollback_after consecutive skips; 'off' = today's step, "
+             "zero extra device work")
+    parser.add_argument(
+        "--spike_zscore", type=float, default=8.0,
+        help="robust z-score (MAD units) above the rolling median at "
+             "which a finite loss counts as a spike; the threshold is a "
+             "traced operand, so adjusting it never recompiles")
+    parser.add_argument(
+        "--rollback_after", type=int, default=3,
+        help="with --anomaly_policy rollback: consecutive skipped steps "
+             "before restoring the last intact checkpoint (data stream "
+             "is fast-forwarded deterministically so the same batches "
+             "replay)")
+    parser.add_argument(
+        "--data_watchdog_s", type=float, default=300.0,
+        help="seconds without a batch from the input pipeline before "
+             "the watchdog logs a data_watchdog_stall event; after 5 "
+             "consecutive timeouts the run aborts (0 disables)")
+    return parser
+
+
+class SpikeDetector:
+    """Rolling median+MAD loss-spike detector (host side).
+
+    Robust statistics, not mean/std: one diverging loss would drag a
+    mean-based threshold up and mask the next spike; the median/MAD
+    pair is insensitive to the outliers it exists to catch.  The
+    detector stays open (+inf threshold) until ``min_warm`` clean
+    losses arrive, and skipped/non-finite losses never enter the
+    window, so an anomaly cannot teach the detector that anomalies
+    are normal.
+    """
+
+    #: MAD -> sigma for a normal distribution (1/Phi^-1(3/4))
+    MAD_SIGMA = 1.4826
+
+    def __init__(self, zscore: float = 8.0, window: int = 64,
+                 min_warm: int = 8):
+        self.zscore = float(zscore)
+        self.min_warm = int(min_warm)
+        self._window: collections.deque = collections.deque(maxlen=window)
+
+    def observe(self, loss: float) -> None:
+        if math.isfinite(loss):
+            self._window.append(float(loss))
+
+    def threshold(self) -> float:
+        """Current skip threshold (+inf until the window is warm)."""
+        if len(self._window) < self.min_warm:
+            return float("inf")
+        med = statistics.median(self._window)
+        mad = statistics.median(abs(x - med) for x in self._window)
+        # a dead-flat window (mad 0, e.g. constant synthetic loss) must
+        # not flag ordinary float jitter: floor the deviation scale
+        scale = max(self.MAD_SIGMA * mad, 1e-6 * max(abs(med), 1.0))
+        return med + self.zscore * scale
+
+
+class Resilience:
+    """One trainer's host-side resilience state: spike detector,
+    skip/rollback policy, preemption flag, loss tracing."""
+
+    def __init__(self, policy: str = "off", *, zscore: float = 8.0,
+                 rollback_after: int = 3, window: int = 64,
+                 min_warm: int = 8, is_root: bool = True):
+        assert policy in ANOMALY_POLICIES, (
+            f"anomaly_policy must be one of {ANOMALY_POLICIES}")
+        self.policy = policy
+        self.rollback_after = max(int(rollback_after), 1)
+        self.is_root = is_root
+        self.detector = SpikeDetector(zscore, window, min_warm)
+        self.consecutive_skips = 0
+        self.rollbacks = 0
+        self._last_rollback_step: Optional[int] = None
+        self._preempt = threading.Event()
+        self._signum: Optional[int] = None
+        self._prev_handlers: dict = {}
+        trace = os.environ.get("DALLE_LOSS_TRACE")
+        self._trace_fh = open(trace, "a") if trace else None
+
+    @classmethod
+    def from_args(cls, args, *, is_root: bool = True) -> "Resilience":
+        return cls(
+            args.anomaly_policy, zscore=args.spike_zscore,
+            rollback_after=args.rollback_after, is_root=is_root,
+        )
+
+    # --- anomaly ----------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        """True when the trainer should build the anomaly train step."""
+        return self.policy != "off"
+
+    def threshold(self) -> float:
+        """Skip threshold fed to the jitted step as a traced operand."""
+        return self.detector.threshold()
+
+    def observe(self, step: int, loss: float, grad_norm: float,
+                skipped: bool) -> str:
+        """Record one finished step; returns the action for the trainer:
+        ``"ok"`` (applied), ``"skip"`` (zero update applied in-step), or
+        ``"rollback"`` (restore last intact checkpoint and replay)."""
+        self.trace(step, loss)
+        if not skipped:
+            self.detector.observe(loss)
+            self.consecutive_skips = 0
+            return "ok"
+        self.consecutive_skips += 1
+        log_event(
+            "anomaly_skip", step=step, loss=loss, grad_norm=grad_norm,
+            consecutive=self.consecutive_skips,
+            threshold=self.detector.threshold(), policy=self.policy,
+        )
+        if self.is_root:
+            print(
+                f"[resilience] step {step}: anomalous "
+                f"(loss {loss:.5g}, grad_norm {grad_norm:.5g}) — "
+                f"zero update applied "
+                f"({self.consecutive_skips} consecutive)"
+            )
+        if (self.policy == "rollback"
+                and self.consecutive_skips >= self.rollback_after):
+            self.consecutive_skips = 0
+            return "rollback"
+        return "skip"
+
+    def note_rollback(self, restored_step: int) -> None:
+        """Record a completed restore; refuse to thrash: two rollbacks
+        in a row landing on the same step means replay is deterministic
+        and the run cannot make progress."""
+        self.rollbacks += 1
+        self.detector = SpikeDetector(
+            self.detector.zscore, self.detector._window.maxlen,
+            self.detector.min_warm,
+        )
+        log_event("anomaly_rollback", restored_step=restored_step,
+                  rollbacks=self.rollbacks)
+        if self.is_root:
+            print(f"[resilience] rollback -> step {restored_step} "
+                  f"(#{self.rollbacks})")
+        if self._last_rollback_step == restored_step:
+            raise SystemExit(
+                f"anomaly rollback restored step {restored_step} twice "
+                "with no progress in between — the anomaly replays "
+                "deterministically; aborting instead of looping"
+            )
+        self._last_rollback_step = restored_step
+
+    # --- preemption -------------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> request a checkpoint at the next step
+        boundary instead of dying mid-write.  Main thread only (signal
+        module constraint); a second signal prints but still waits for
+        the boundary — the checkpoint is the whole point."""
+
+        def handler(signum, frame):
+            first = not self._preempt.is_set()
+            self._preempt.set()
+            self._signum = signum
+            log_event("preempt_requested", signum=signum, first=first)
+            if self.is_root:
+                name = signal.Signals(signum).name
+                print(
+                    f"[resilience] {name} received — checkpointing at "
+                    "the next step boundary"
+                    if first else
+                    f"[resilience] {name} again — still flushing"
+                )
+
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            self._prev_handlers[signum] = signal.signal(signum, handler)
+
+    def uninstall_signal_handlers(self) -> None:
+        for signum, prev in self._prev_handlers.items():
+            signal.signal(signum, prev)
+        self._prev_handlers.clear()
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempt.is_set()
+
+    # --- loss trace (chaos harness) ---------------------------------------
+
+    def trace(self, step: int, loss: float) -> None:
+        if self._trace_fh is not None:
+            self._trace_fh.write(
+                json.dumps({"step": int(step), "loss": float(loss)}) + "\n")
+            self._trace_fh.flush()
+
+    def close(self) -> None:
+        if self._trace_fh is not None:
+            self._trace_fh.close()
+            self._trace_fh = None
+
+
+def skip_batches(it, n: int, label: str = "resume") -> int:
+    """Deterministically fast-forward an epoch iterator by ``n`` batches
+    (mid-epoch resume and rollback replay).  Returns the count actually
+    skipped; a shorter-than-expected epoch logs an event rather than
+    raising — the loop simply sees an exhausted iterator."""
+    skipped = 0
+    for _ in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            log_event("data_fast_forward_short", wanted=n, got=skipped,
+                      label=label)
+            break
+        skipped += 1
+    if skipped:
+        log_event("data_fast_forward", batches=skipped, label=label)
+    return skipped
+
+
+def read_loss_trace(path) -> dict:
+    """{step: loss} from a DALLE_LOSS_TRACE file (last write wins)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                d = json.loads(line)
+                out[int(d["step"])] = float(d["loss"])
+    return out

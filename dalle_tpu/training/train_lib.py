@@ -266,6 +266,38 @@ def _compressed_loss_and_grads(
     return fn(params, key, tuple(rep_args), *batch_args)
 
 
+def _guarded_update(tx, params, opt_state, grads, loss, thresh):
+    """``lax.cond``-guarded optimizer update (anomaly path).
+
+    A step whose loss/grad-norm is non-finite, or whose loss exceeds the
+    host-computed spike threshold (a TRACED scalar — rolling median+MAD,
+    training/resilience.py), applies a ZERO update: params, opt_state and
+    the optimizer's step counter come back unchanged, inside the same
+    compiled program.  No recompile, no second step variant — the skip
+    decision is data, not code.  This must live inside the jit: the steps
+    donate params/opt_state, so by the time the host could inspect the
+    loss the input buffers are already invalidated.
+
+    Returns (new_params, new_opt_state, grad_norm, skipped).
+    """
+    g_norm = optax.global_norm(grads)
+    ok = jnp.isfinite(loss) & jnp.isfinite(g_norm) & (loss <= thresh)
+
+    def _apply(operand):
+        p, s, g = operand
+        updates, new_s = tx.update(g, s, p)
+        return optax.apply_updates(p, updates), new_s
+
+    def _skip(operand):
+        p, s, _ = operand
+        return p, s
+
+    new_params, new_opt_state = jax.lax.cond(
+        ok, _apply, _skip, (params, opt_state, grads)
+    )
+    return new_params, new_opt_state, g_norm, jnp.logical_not(ok)
+
+
 def make_dalle_train_step(
     model: DALLE,
     tx: optax.GradientTransformation,
@@ -273,6 +305,7 @@ def make_dalle_train_step(
     vae: Optional[DiscreteVAE] = None,
     with_metrics: bool = False,
     grad_comm: str = "f32",
+    anomaly: bool = False,
 ):
     """Returns ``step(params, opt_state, vae_params, text, images_or_codes,
     dropout_key) -> (params, opt_state, loss)`` — plus a ``{name: scalar}``
@@ -286,11 +319,20 @@ def make_dalle_train_step(
     ``grad_comm``: wire precision of the dp/fsdp gradient reduction —
     ``"f32"`` keeps XLA's inserted collectives; ``"bf16"``/``"int8"`` switch
     to the manual compressed reduction (``_compressed_loss_and_grads``).
+
+    ``anomaly``: the step takes two extra traced scalars —
+    ``thresh`` (host spike threshold; +inf = only non-finite skips) and
+    ``fault_scale`` (loss multiplier, 1.0 except under fault injection) —
+    guards the update with :func:`_guarded_update`, and additionally
+    returns ``(grad_norm, skipped)``.  With ``anomaly=False`` the step is
+    byte-identical to before: zero extra device work when the policy is
+    off.
     """
     _validate_grad_comm(grad_comm, mesh)
     bspec = batch_sharding(mesh)
 
-    def step(params, opt_state, vae_params, text, images, key):
+    def step(params, opt_state, vae_params, text, images, key,
+             thresh=None, fault_scale=None):
         if vae is not None:
             # method by NAME so any VAE flavor (DiscreteVAE / VQGAN /
             # OpenAIDiscreteVAE) dispatches to its own encoder
@@ -302,7 +344,7 @@ def make_dalle_train_step(
         else:
             codes = images
 
-        def loss_fn(p, t, c, k):
+        def loss_fn(p, t, c, k, scale=None):
             # mutable=["losses"] collects sown auxiliary losses (MoE load
             # balancing, models/moe.py); empty dict when the model has none.
             # "metrics" collects non-loss diagnostics when requested.
@@ -331,22 +373,35 @@ def make_dalle_train_step(
                 ]  # DictKeys only; drop the sow-tuple SequenceKey
                 by_name.setdefault(names[-1], []).append(jnp.mean(leaf))
             metrics = {k: jnp.mean(jnp.stack(v)) for k, v in by_name.items()}
-            return task_loss + aux, metrics
+            loss = task_loss + aux
+            if scale is not None:
+                # fault injection: scale=1.0 is bit-exact; NaN poisons
+                # the loss AND (through the chain rule) every gradient
+                loss = loss * scale
+            return loss, metrics
 
         if grad_comm == "f32":
             (loss, metrics), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, text, codes, key)
+                loss_fn, has_aux=True)(params, text, codes, key, fault_scale)
         else:
             loss, metrics, grads = _compressed_loss_and_grads(
-                lambda p, b, rep, k: loss_fn(p, b[0], b[1], k),
-                params, mesh, grad_comm, key, (text, codes))
-        updates, new_opt_state = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, loss, metrics
+                lambda p, b, rep, k: loss_fn(
+                    p, b[0], b[1], k, rep[0] if rep else None),
+                params, mesh, grad_comm, key, (text, codes),
+                rep_args=(() if fault_scale is None else (fault_scale,)))
+        if not anomaly:
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state, loss, metrics
+        new_params, new_opt_state, g_norm, skipped = _guarded_update(
+            tx, params, opt_state, grads, loss, thresh
+        )
+        return new_params, new_opt_state, loss, metrics, g_norm, skipped
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
 
-    def wrapped(params, opt_state, vae_params, text, images, key):
+    def wrapped(params, opt_state, vae_params, text, images, key,
+                thresh=float("inf"), fault_scale=1.0):
         text = jax.device_put(text, bspec)
         images = jax.device_put(images, bspec)
         # ambient mesh so ring attention's shard_map region resolves its
@@ -354,9 +409,18 @@ def make_dalle_train_step(
         from dalle_tpu.parallel.mesh import ambient
 
         with ambient(mesh):
+            if anomaly:
+                out = jstep(
+                    params, opt_state, vae_params, text, images, key,
+                    jnp.asarray(thresh, jnp.float32),
+                    jnp.asarray(fault_scale, jnp.float32),
+                )
+                # without metrics: (params, opt_state, loss, g_norm, skipped)
+                return out if with_metrics else out[:3] + out[4:]
             out = jstep(params, opt_state, vae_params, text, images, key)
         return out if with_metrics else out[:3]
 
+    wrapped._jstep = jstep  # compile-cache introspection (tests)
     return wrapped
 
 
@@ -384,7 +448,7 @@ def make_dalle_eval_step(model: DALLE, mesh, vae: Optional[DiscreteVAE] = None):
 
 
 def make_clip_train_step(clip, tx: optax.GradientTransformation, mesh,
-                         grad_comm: str = "f32"):
+                         grad_comm: str = "f32", anomaly: bool = False):
     """CLIP contrastive training step (the reference trains CLIP only via a
     README snippet, reference: README.md:210-235 — here it is a first-class
     jitted step): step(params, opt_state, text, images, key).
@@ -392,13 +456,18 @@ def make_clip_train_step(clip, tx: optax.GradientTransformation, mesh,
     NOTE the contrastive caveat under ``grad_comm != "f32"``: the manual
     step computes the InfoNCE loss over each device's LOCAL [b_loc, b_loc]
     similarity block (negatives don't cross shard boundaries), exactly like
-    per-replica contrastive training without a logit all-gather."""
+    per-replica contrastive training without a logit all-gather.
+
+    ``anomaly``: same contract as :func:`make_dalle_train_step` — extra
+    traced ``(thresh, fault_scale)`` operands, ``lax.cond``-guarded
+    update, extra ``(grad_norm, skipped)`` returns."""
     _validate_grad_comm(grad_comm, mesh)
     bspec = batch_sharding(mesh)
 
-    def step(params, opt_state, text, images, key):
-        def loss_fn(p, t, im, k):
-            return clip.apply(
+    def step(params, opt_state, text, images, key,
+             thresh=None, fault_scale=None):
+        def loss_fn(p, t, im, k, scale=None):
+            loss = clip.apply(
                 {"params": p},
                 t,
                 im,
@@ -406,39 +475,61 @@ def make_clip_train_step(clip, tx: optax.GradientTransformation, mesh,
                 deterministic=False,
                 rngs={"dropout": k},
             )
+            return loss if scale is None else loss * scale
 
         if grad_comm == "f32":
             loss, grads = jax.value_and_grad(loss_fn)(
-                params, text, images, key)
+                params, text, images, key, fault_scale)
         else:
             loss, _, grads = _compressed_loss_and_grads(
-                lambda p, b, rep, k: (loss_fn(p, b[0], b[1], k), {}),
-                params, mesh, grad_comm, key, (text, images))
-        updates, new_opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), new_opt_state, loss
+                lambda p, b, rep, k: (
+                    loss_fn(p, b[0], b[1], k, rep[0] if rep else None), {}),
+                params, mesh, grad_comm, key, (text, images),
+                rep_args=(() if fault_scale is None else (fault_scale,)))
+        if not anomaly:
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state, loss
+        new_params, new_opt_state, g_norm, skipped = _guarded_update(
+            tx, params, opt_state, grads, loss, thresh
+        )
+        return new_params, new_opt_state, loss, g_norm, skipped
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
 
-    def wrapped(params, opt_state, text, images, key):
+    def wrapped(params, opt_state, text, images, key,
+                thresh=float("inf"), fault_scale=1.0):
+        if anomaly:
+            return jstep(
+                params, opt_state, jax.device_put(text, bspec),
+                jax.device_put(images, bspec), key,
+                jnp.asarray(thresh, jnp.float32),
+                jnp.asarray(fault_scale, jnp.float32),
+            )
         return jstep(
             params, opt_state, jax.device_put(text, bspec),
             jax.device_put(images, bspec), key,
         )
 
+    wrapped._jstep = jstep
     return wrapped
 
 
 def make_vae_train_step(model: DiscreteVAE, tx: optax.GradientTransformation,
-                        mesh, grad_comm: str = "f32"):
+                        mesh, grad_comm: str = "f32", anomaly: bool = False):
     """Returns ``step(params, opt_state, images, temp, key) ->
     (params, opt_state, loss, recons)``.  Temperature is traced so Gumbel
-    annealing (reference: train_vae.py:218-221,269-271) never recompiles."""
+    annealing (reference: train_vae.py:218-221,269-271) never recompiles.
+
+    ``anomaly``: same contract as :func:`make_dalle_train_step` — extra
+    traced ``(thresh, fault_scale)`` operands, ``lax.cond``-guarded
+    update, extra ``(grad_norm, skipped)`` returns."""
     _validate_grad_comm(grad_comm, mesh)
     bspec = batch_sharding(mesh)
 
-    def step(params, opt_state, images, temp, key):
-        def loss_fn(p, im, t, k):
-            return model.apply(
+    def step(params, opt_state, images, temp, key,
+             thresh=None, fault_scale=None):
+        def loss_fn(p, im, t, k, scale=None):
+            loss, recons = model.apply(
                 {"params": p},
                 im,
                 return_loss=True,
@@ -446,24 +537,41 @@ def make_vae_train_step(model: DiscreteVAE, tx: optax.GradientTransformation,
                 temp=t,
                 rngs={"gumbel": k},
             )
+            return (loss if scale is None else loss * scale), recons
 
         if grad_comm == "f32":
             (loss, recons), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, images, temp, key)
+                loss_fn, has_aux=True)(params, images, temp, key, fault_scale)
         else:
             loss, recons, grads = _compressed_loss_and_grads(
-                lambda p, b, rep, k: loss_fn(p, b[0], rep[0], k),
-                params, mesh, grad_comm, key, (images,), rep_args=(temp,),
+                lambda p, b, rep, k: loss_fn(
+                    p, b[0], rep[0], k, rep[1] if len(rep) > 1 else None),
+                params, mesh, grad_comm, key, (images,),
+                rep_args=(
+                    (temp,) if fault_scale is None else (temp, fault_scale)),
                 aux_batch_sharded=True)
-        updates, new_opt_state = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, loss, recons
+        if not anomaly:
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state, loss, recons
+        new_params, new_opt_state, g_norm, skipped = _guarded_update(
+            tx, params, opt_state, grads, loss, thresh
+        )
+        return new_params, new_opt_state, loss, recons, g_norm, skipped
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
 
-    def wrapped(params, opt_state, images, temp, key):
+    def wrapped(params, opt_state, images, temp, key,
+                thresh=float("inf"), fault_scale=1.0):
+        if anomaly:
+            return jstep(
+                params, opt_state, jax.device_put(images, bspec), temp, key,
+                jnp.asarray(thresh, jnp.float32),
+                jnp.asarray(fault_scale, jnp.float32),
+            )
         return jstep(params, opt_state, jax.device_put(images, bspec), temp, key)
 
+    wrapped._jstep = jstep
     return wrapped
 
 

@@ -30,6 +30,48 @@ from dalle_tpu.training.checkpoint import is_checkpoint
 from dalle_tpu.tokenizers import get_tokenizer
 
 
+def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
+                        default_temperature=1.0, default_top_p=None):
+    """One JSONL serve line (already json-decoded) -> a validated
+    ``Request``.  Raises ValueError/TypeError on malformed input — the
+    serve loop converts that into a structured error record instead of
+    letting one bad client line kill the stream (docs/SERVING.md §5)."""
+    from dalle_tpu.serving import Request
+
+    if not isinstance(d, dict):
+        raise ValueError("request must be a JSON object")
+    text = d.get("text")
+    if not isinstance(text, str) or not text.strip():
+        raise ValueError("missing or empty 'text'")
+    temperature = float(d.get("temperature", default_temperature))
+    if not (temperature > 0):
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    # per-request top_p only in a top-p engine; otherwise the CLI's
+    # static sampling mode applies to everyone
+    top_p = (d.get("top_p", default_top_p)
+             if default_top_p is not None else None)
+    if top_p is not None:
+        top_p = float(top_p)
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    deadline_s = d.get("deadline_s")
+    if deadline_s is not None:
+        deadline_s = float(deadline_s)
+        if deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+    tokens = tokenizer.tokenize(
+        text, text_seq_len, truncate_text=True
+    ).astype(np.int32)[0]
+    return Request(
+        text_tokens=tokens,
+        seed=int(d.get("seed", default_seed + i)),
+        temperature=temperature,
+        top_p=top_p,
+        deadline_s=deadline_s,
+        request_id=str(d.get("id", f"req{i}")),
+    )
+
+
 def parse_args(argv=None):
     parser = argparse.ArgumentParser(description="Generate images from a trained DALL-E")
     parser.add_argument("--dalle_path", type=str, required=True)
@@ -380,6 +422,13 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
             print(f"[{req.request_id}] dropped: deadline {req.deadline_s}s "
                   "expired before admission")
             return
+        if req.error is not None:
+            print(f"[{req.request_id}] failed: {req.error}")
+            with open(outdir / "errors.jsonl", "a") as f:
+                f.write(json.dumps(
+                    {"id": req.request_id, "error": req.error}
+                ) + "\n")
+            return
         if req.image is not None:
             arr = (np.clip(req.image.astype(np.float32), 0, 1) * 255)
             Image.fromarray(arr.astype(np.uint8)).save(
@@ -405,6 +454,17 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
               f"{args.serve_policy}, stream "
               f"{'stdin' if args.serve == '-' else args.serve}")
 
+        errors_path = outdir / "errors.jsonl"
+
+        def reject(req_id, line_no, reason):
+            # a malformed request is the CLIENT's fault — emit a structured
+            # error record to the output stream + errors.jsonl and keep
+            # serving everyone else
+            rec = {"id": req_id, "line": line_no, "error": reason}
+            print(f"[{req_id}] rejected: {reason}")
+            with open(errors_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
         def feeder():
             stream = sys.stdin if args.serve == "-" else open(args.serve)
             try:
@@ -412,24 +472,23 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                     line = line.strip()
                     if not line:
                         continue
-                    d = json.loads(line)
-                    tokens = tokenizer.tokenize(
-                        d["text"], cfg.text_seq_len, truncate_text=True
-                    ).astype(np.int32)[0]
-                    # per-request top_p only in a top-p engine; otherwise
-                    # the CLI's static sampling mode applies to everyone
-                    top_p = (d.get("top_p", args.top_p)
-                             if args.top_p is not None else None)
-                    req_queue.submit(Request(
-                        text_tokens=tokens,
-                        seed=int(d.get("seed", args.seed + i)),
-                        temperature=float(
-                            d.get("temperature", args.temperature)
-                        ),
-                        top_p=top_p,
-                        deadline_s=d.get("deadline_s"),
-                        request_id=str(d.get("id", f"req{i}")),
-                    ))
+                    try:
+                        d = json.loads(line)
+                    except ValueError as e:
+                        reject(f"line{i}", i, f"bad JSON: {e}")
+                        continue
+                    req_id = (str(d.get("id", f"req{i}"))
+                              if isinstance(d, dict) else f"line{i}")
+                    try:
+                        req_queue.submit(parse_serve_request(
+                            d, i, tokenizer=tokenizer,
+                            text_seq_len=cfg.text_seq_len,
+                            default_seed=args.seed,
+                            default_temperature=args.temperature,
+                            default_top_p=args.top_p,
+                        ))
+                    except (TypeError, ValueError) as e:
+                        reject(req_id, i, str(e))
             finally:
                 if stream is not sys.stdin:
                     stream.close()

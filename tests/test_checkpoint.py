@@ -372,3 +372,205 @@ def test_mu_bf16_trains_and_restores(tmp_path, rng, devices):
         if any(getattr(p, "name", "") == "mu" for p in path)
     ]
     assert rmus and all(m.dtype == jnp.bfloat16 for m in rmus)
+
+
+# --- resilience: atomicity, corruption fallback, retry, retention ----------
+# (docs/RESILIENCE.md §3; fault injection via dalle_tpu/training/faults.py)
+
+
+import io
+import threading
+import time
+
+import pytest
+
+from dalle_tpu.training import faults
+from dalle_tpu.training.checkpoint import (
+    AsyncCheckpointWriter,
+    find_latest_checkpoint,
+    is_intact_checkpoint,
+    prune_checkpoints,
+    resolve_auto_resume,
+)
+from dalle_tpu.training.logging import set_event_sink
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture
+def events():
+    buf = io.StringIO()
+    set_event_sink(buf)
+    try:
+        yield lambda: [json.loads(l) for l in buf.getvalue().splitlines() if l]
+    finally:
+        set_event_sink(None)
+
+
+def _save(path, step=0, data_step=0, **kw):
+    return save_checkpoint(
+        str(path), params={"w": jnp.full((2,), float(step))},
+        hparams={"dim": 2}, step=step, data_step=data_step, **kw,
+    )
+
+
+def _corrupt(path):
+    """Simulate a torn write: marker gone, listed subtree gone."""
+    path = __import__("pathlib").Path(path)
+    (path / "COMPLETE").unlink()
+    import shutil as sh
+
+    sh.rmtree(path / "params")
+
+
+def test_marker_and_intact_detection(tmp_path):
+    p = _save(tmp_path / "ck-step1", step=1)
+    pp = __import__("pathlib").Path(p)
+    assert (pp / "COMPLETE").exists()
+    assert is_intact_checkpoint(p)
+    assert load_meta(p)["data_step"] == 0
+    # staging dirs are never intact, whatever they contain
+    assert not is_intact_checkpoint(str(pp) + ".tmp-123")
+    _corrupt(p)
+    assert not is_intact_checkpoint(p)
+
+
+def test_data_step_roundtrip(tmp_path):
+    p = _save(tmp_path / "ck-step3", step=3, data_step=17)
+    assert load_meta(p)["data_step"] == 17
+
+
+def test_find_latest_skips_corrupt_newest(tmp_path, events):
+    _save(tmp_path / "run-step1", step=1)
+    p2 = _save(tmp_path / "run-step2", step=2)
+    assert find_latest_checkpoint(tmp_path, "run").endswith("run-step2")
+    _corrupt(p2)
+    # corrupted newest -> auto-resume falls back to the older intact one,
+    # with a structured event recording the rejection
+    got = find_latest_checkpoint(tmp_path, "run")
+    assert got.endswith("run-step1")
+    ev = [e for e in events() if e["kind"] == "ckpt_corrupt_skipped"]
+    assert ev and ev[0]["path"].endswith("run-step2")
+
+
+def test_resolve_auto_resume_candidates_corrupt_fallback(tmp_path, events):
+    # train_vae's fixed names ("vae" in-loop, "vae-final") use the
+    # explicit-candidates path
+    _save(tmp_path / "vae", step=4)
+    pf = _save(tmp_path / "vae-final", step=9)
+    _corrupt(pf)
+    got = resolve_auto_resume(
+        None, True, str(tmp_path), "vae",
+        candidates=["vae", "vae-final"], is_root=False,
+    )
+    assert got.endswith("/vae")
+    assert any(e["kind"] == "ckpt_corrupt_skipped" for e in events())
+    # nothing intact -> fresh start, not a crash
+    _corrupt(tmp_path / "vae")
+    assert resolve_auto_resume(
+        None, True, str(tmp_path), "vae",
+        candidates=["vae", "vae-final"], is_root=False,
+    ) is None
+
+
+def test_prune_never_deletes_last_known_good(tmp_path):
+    p1 = _save(tmp_path / "run-step1", step=1)
+    p2 = _save(tmp_path / "run-step2", step=2)
+    _corrupt(p2)  # newer but torn
+    staging = tmp_path / "run-step3.tmp-999"
+    staging.mkdir()
+    (staging / "meta.json").write_text("{}")
+    prune_checkpoints(tmp_path, keep_n=1, pattern="run-*")
+    left = sorted(d.name for d in tmp_path.iterdir())
+    # intact-ness outranks step: the corrupt newer dir was pruned, the
+    # last-known-good survived, the in-flight staging dir was untouched
+    assert left == ["run-step1", "run-step3.tmp-999"]
+    assert is_intact_checkpoint(p1)
+
+
+def test_prune_keep_n_floors_at_one(tmp_path):
+    _save(tmp_path / "run-step1", step=1)
+    _save(tmp_path / "run-step2", step=2)
+    prune_checkpoints(tmp_path, keep_n=0, pattern="run-*")
+    left = sorted(d.name for d in tmp_path.iterdir())
+    assert left == ["run-step2"]
+
+
+def test_prune_tolerates_vanishing_dir(tmp_path, monkeypatch):
+    import dalle_tpu.training.checkpoint as ckpt_mod
+
+    for s in (1, 2, 3):
+        _save(tmp_path / f"run-step{s}", step=s)
+    real_rmtree = ckpt_mod.shutil.rmtree
+    calls = []
+
+    def flaky_rmtree(p, *a, **kw):
+        calls.append(str(p))
+        if len(calls) == 1:
+            raise FileNotFoundError(p)  # vanished under a concurrent prune
+        return real_rmtree(p, *a, **kw)
+
+    monkeypatch.setattr(ckpt_mod.shutil, "rmtree", flaky_rmtree)
+    prune_checkpoints(tmp_path, keep_n=1, pattern="run-*")
+    assert len(calls) == 2  # step2 raised (tolerated), step1 deleted
+
+
+def test_async_writer_retries_transient_io(tmp_path, events):
+    faults.configure("ckpt_fail@1")  # first write attempt raises OSError
+    w = AsyncCheckpointWriter(retries=2, backoff_s=0.01)
+    p = str(tmp_path / "ck-step1")
+    w.save(p, params={"w": jnp.ones((2,))}, hparams={}, step=1)
+    w.wait()  # retry succeeded: no raise
+    assert is_intact_checkpoint(p)
+    retries = [e for e in events() if e["kind"] == "ckpt_retry"]
+    assert len(retries) == 1 and retries[0]["attempt"] == 1
+
+
+def test_async_writer_exhausts_retries_and_recovers(tmp_path):
+    faults.configure("ckpt_fail@1-4")  # more failures than attempts
+    w = AsyncCheckpointWriter(retries=2, backoff_s=0.01)
+    w.save(str(tmp_path / "ck-step1"), params={"w": jnp.ones((2,))},
+           hparams={}, step=1)
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        w.wait()
+    faults.reset()
+    # the writer stays usable once the transient condition clears
+    p = str(tmp_path / "ck-step2")
+    w.save(p, params={"w": jnp.ones((2,))}, hparams={}, step=2)
+    w.wait()
+    assert is_intact_checkpoint(p)
+
+
+def test_no_partial_checkpoint_ever_observable(tmp_path):
+    """Enumerate the parent dir throughout a (deliberately slowed) save:
+    the final name must never be visible in a non-intact state — readers
+    only ever see the staging dir or the completed checkpoint."""
+    faults.configure("ckpt_delay@0.4")  # hold the pre-rename window open
+    target = tmp_path / "ck-step1"
+    seen_tmp, violations = [], []
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            for d in tmp_path.iterdir():
+                if ".tmp" in d.name:
+                    seen_tmp.append(d.name)
+                elif d.name == "ck-step1" and not is_intact_checkpoint(d):
+                    violations.append(sorted(x.name for x in d.iterdir()))
+            time.sleep(0.005)
+
+    t = threading.Thread(target=poll)
+    t.start()
+    try:
+        _save(target, step=1)
+    finally:
+        stop.set()
+        t.join()
+    assert is_intact_checkpoint(target)
+    assert seen_tmp, "delay fault should have exposed the staging window"
+    assert not violations, f"partial checkpoint observed: {violations[:3]}"

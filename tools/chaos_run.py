@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Chaos harness: kill a training run mid-epoch and prove the resumed
+trajectory matches an uninterrupted one (docs/RESILIENCE.md §5).
+
+The scenario the fault-tolerance stack must survive, end to end:
+
+1. **reference** — train DALL-E for one tiny epoch with a NaN-gradient
+   fault injected at ``nan_step`` and ``--anomaly_policy skip``; record
+   the per-step loss trace.  (The fault is in BOTH runs so the
+   comparison isolates the kill/resume machinery, not the skip.)
+2. **faulted** — same run, plus SIGTERM delivered at ``kill_step``.
+   Must exit 0 after flushing a preemption checkpoint.
+3. **resume** — relaunch with ``--auto_resume``; the loader is
+   fast-forwarded deterministically, so the merged
+   faulted+resumed trace must match the reference step for step.
+
+The gate: zero lost steps and per-step losses within ``rtol`` — run
+either as ``python tools/chaos_run.py --workdir /tmp/chaos`` or via
+``bench.py`` (the ``resilience`` rung) / ``tests/test_resilience.py``
+(both call :func:`run_chaos`).
+"""
+
+import argparse
+import json
+import math
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = Path(__file__).resolve().parent.parent
+
+# tiny-model flags shared with tests/test_cli.py — small enough that the
+# whole 3-subprocess scenario runs in ~2 min on 8 virtual CPU devices
+VAE_FLAGS = [
+    "--image_size", "16", "--batch_size", "4", "--num_tokens", "32",
+    "--num_layers", "2", "--num_resnet_blocks", "0",
+    "--emb_dim", "16", "--hidden_dim", "16",
+]
+DALLE_FLAGS = [
+    "--dim", "32", "--depth", "1", "--heads", "2", "--dim_head", "16",
+    "--text_seq_len", "16", "--truncate_captions", "--batch_size", "2",
+]
+
+
+def make_dataset(root: Path, n: int = 20) -> Path:
+    """n deterministic (png, txt) pairs — batch 2 → n/2 steps per epoch."""
+    import numpy as np
+    from PIL import Image
+
+    pairs = root / "pairs"
+    pairs.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(pairs / f"s{i:03d}.png")
+        (pairs / f"s{i:03d}.txt").write_text(f"a tiny test image number {i}")
+    return pairs
+
+
+def _run(cmd, *, env=None, expect=0, label=""):
+    e = dict(os.environ)
+    e.setdefault("JAX_PLATFORMS", "cpu")
+    # never let bench's persistent XLA compile cache into these
+    # subprocesses: deserialized executables have produced heap
+    # corruption on CPU here (SIGABRT double-free, or silent NaN params
+    # right after the restored run's first update) — and the whole point
+    # of this harness is a bit-exact trajectory comparison
+    e.pop("JAX_COMPILATION_CACHE_DIR", None)
+    if env:
+        e.update(env)
+    p = subprocess.run(
+        cmd, cwd=str(REPO), env=e, capture_output=True, text=True,
+        timeout=600,
+    )
+    if p.returncode != expect:
+        raise RuntimeError(
+            f"chaos[{label}]: exit {p.returncode} (wanted {expect})\n"
+            f"--- stdout ---\n{p.stdout[-4000:]}\n"
+            f"--- stderr ---\n{p.stderr[-4000:]}"
+        )
+    return p
+
+
+def run_chaos(workdir, steps: int = 10, nan_step: int = 3,
+              kill_step: int = 7, rtol: float = 2e-3) -> dict:
+    """Run the 3-phase scenario under ``workdir``; returns the verdict.
+
+    Raises RuntimeError when a subprocess exits non-zero; the returned
+    dict carries ``ok`` plus per-step traces for the bench rung."""
+    from dalle_tpu.training import resilience
+    from dalle_tpu.training.checkpoint import find_latest_checkpoint
+
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    pairs = make_dataset(workdir, n=2 * steps)
+
+    # one pretrained tiny VAE feeds every DALL-E run
+    vae_dir = workdir / "vae_ckpt"
+    if not (vae_dir / "vae-final").exists():
+        _run(
+            [sys.executable, "train_vae.py", "--image_folder", str(pairs),
+             "--output_path", str(vae_dir), "--no_wandb", "--epochs", "1",
+             *VAE_FLAGS],
+            label="vae",
+        )
+
+    def dalle_cmd(outdir, extra=()):
+        return [
+            sys.executable, "train_dalle.py",
+            "--image_text_folder", str(pairs),
+            "--vae_path", str(vae_dir / "vae-final"),
+            "--output_path", str(outdir), "--no_wandb", "--epochs", "1",
+            "--anomaly_policy", "skip", *DALLE_FLAGS, *extra,
+        ]
+
+    # phase 1: uninterrupted reference (NaN fault only)
+    ref_trace = workdir / "ref_trace.jsonl"
+    ref_trace.unlink(missing_ok=True)
+    _run(dalle_cmd(workdir / "ref"),
+         env={"DALLE_FAULTS": f"nan_grad@{nan_step}",
+              "DALLE_LOSS_TRACE": str(ref_trace)},
+         label="reference")
+    ref = resilience.read_loss_trace(ref_trace)
+    assert len(ref) == steps, f"reference ran {len(ref)} steps, wanted {steps}"
+
+    # phase 2: same faults + SIGTERM mid-epoch — must exit 0 with an
+    # intact preemption checkpoint on disk
+    chaos_dir = workdir / "chaos"
+    chaos_trace = workdir / "chaos_trace.jsonl"
+    chaos_trace.unlink(missing_ok=True)
+    _run(dalle_cmd(chaos_dir),
+         env={"DALLE_FAULTS": f"nan_grad@{nan_step},sigterm@{kill_step}",
+              "DALLE_LOSS_TRACE": str(chaos_trace)},
+         label="faulted")
+    ckpt = find_latest_checkpoint(chaos_dir, "dalle")
+    assert ckpt is not None, "no intact checkpoint after preemption"
+
+    # phase 3: resume the killed run; trace file appends
+    _run(dalle_cmd(chaos_dir, extra=["--auto_resume"]),
+         env={"DALLE_FAULTS": f"nan_grad@{nan_step}",
+              "DALLE_LOSS_TRACE": str(chaos_trace)},
+         label="resume")
+
+    merged = resilience.read_loss_trace(chaos_trace)
+    lost = sorted(set(ref) - set(merged))
+    mismatches = []
+    for step, ref_loss in sorted(ref.items()):
+        got = merged.get(step)
+        if got is None:
+            continue
+        both_nan = ref_loss != ref_loss and got != got
+        # NaN-safe: any one-sided non-finite is a mismatch (NaN compares
+        # False against every threshold, which would pass the gate)
+        finite = math.isfinite(ref_loss) and math.isfinite(got)
+        if not both_nan and (
+            not finite
+            or abs(got - ref_loss) > rtol * max(abs(ref_loss), 1e-12)
+        ):
+            mismatches.append(
+                {"step": step, "reference": ref_loss, "resumed": got}
+            )
+    return {
+        "ok": not lost and not mismatches,
+        "steps": steps,
+        "nan_step": nan_step,
+        "kill_step": kill_step,
+        "rtol": rtol,
+        "lost_steps": lost,
+        "mismatches": mismatches,
+        "checkpoint": str(ckpt),
+        "reference_trace": {str(k): v for k, v in sorted(ref.items())},
+        "resumed_trace": {str(k): v for k, v in sorted(merged.items())},
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="kill-and-resume chaos scenario for train_dalle.py"
+    )
+    ap.add_argument("--workdir", type=str, required=True)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--nan_step", type=int, default=3)
+    ap.add_argument("--kill_step", type=int, default=7)
+    ap.add_argument("--rtol", type=float, default=2e-3)
+    args = ap.parse_args(argv)
+    res = run_chaos(args.workdir, steps=args.steps, nan_step=args.nan_step,
+                    kill_step=args.kill_step, rtol=args.rtol)
+    print(json.dumps(res, indent=2))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

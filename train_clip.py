@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_tpu.data import DataLoader, TextImageDataset
-from dalle_tpu.data.prefetch import device_prefetch
+from dalle_tpu.data.prefetch import device_prefetch, watchdog_iter
 from dalle_tpu.models.clip import CLIP, CLIPConfig
 from dalle_tpu.parallel import backend as backend_lib
 from dalle_tpu.parallel.mesh import batch_sharding, mesh_kwargs_from_args
@@ -34,7 +34,8 @@ from dalle_tpu.training.checkpoint import (
     optimizer_meta_from_args,
     save_checkpoint,
 )
-from dalle_tpu.training.logging import Run
+from dalle_tpu.training import faults, resilience
+from dalle_tpu.training.logging import Run, log_event
 from dalle_tpu.training.precision import add_precision_args, policy_from_flags
 from dalle_tpu.tokenizers import get_tokenizer
 
@@ -122,6 +123,7 @@ def parse_args(argv=None):
     parser.add_argument("--auto_resume", action="store_true",
                         help="resume from the newest checkpoint in "
                              "--output_path if one exists")
+    resilience.add_resilience_args(parser)
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
 
@@ -136,6 +138,9 @@ def main(argv=None):
     distr.check_batch_size(args.batch_size)
     is_root = distr.is_root_worker()
     rank, world = distr.get_rank(), distr.get_world_size()
+
+    resil = resilience.Resilience.from_args(args, is_root=is_root)
+    resil.install_signal_handlers()
 
     tokenizer = get_tokenizer(
         bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese
@@ -239,7 +244,8 @@ def main(argv=None):
             lambda t: jax.tree_util.tree_map(jnp.copy, t)
         )((params, opt_state))
     step_fn = make_clip_train_step(clip, tx, distr.mesh,
-                                   grad_comm=args.grad_comm)
+                                   grad_comm=args.grad_comm,
+                                   anomaly=resil.active)
     if is_root:
         print(f"CLIP params: {count_params(params):,}; dataset: {len(ds)} pairs")
 
@@ -261,6 +267,8 @@ def main(argv=None):
         global_step = resume_meta.get("step", 0)
         resume_epoch = resume_meta.get("epoch", 0)
     start_epoch = resume_epoch
+    resume_data_step = resume_meta.get("data_step", 0) if resume_meta else 0
+    data_step = 0  # batches applied within the current epoch
 
     from dalle_tpu.training.checkpoint import make_async_writer
 
@@ -274,6 +282,7 @@ def main(argv=None):
             params=params, hparams=cfg.to_dict(),
             opt_state=opt_state, epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
+            data_step=data_step + (1 if in_loop else 0),
             optimizer_meta=optimizer_meta_from_args(args),
         )
         if ckpt_writer is not None:
@@ -292,15 +301,43 @@ def main(argv=None):
         samples_per_step=args.batch_size,
     )
     try:
-        for epoch in range(start_epoch, args.epochs):
+        epoch = start_epoch
+        while epoch < args.epochs:
             resume_epoch = epoch
             loader.set_epoch(epoch)
+            epoch_it = watchdog_iter(
+                iter(loader), timeout_s=args.data_watchdog_s, label="train_clip"
+            )
+            data_step = resilience.skip_batches(epoch_it, resume_data_step)
+            resume_data_step = 0
+            rollback = False
             for text, images in device_prefetch(
-                loader, batch_sharding(distr.mesh), depth=args.prefetch_depth
+                epoch_it, batch_sharding(distr.mesh), depth=args.prefetch_depth
             ):
-                params, opt_state, loss = step_fn(
-                    params, opt_state, text, images, jax.random.fold_in(rng, global_step)
-                )
+                faults.check_signal(global_step)
+                if resil.preempted:
+                    log_event("preempt_checkpoint", step=global_step,
+                              epoch=epoch, data_step=data_step)
+                    save(f"clip-step{global_step}")  # synchronous
+                    raise resilience.Preempted
+                step_key = jax.random.fold_in(rng, global_step)
+                action = "ok"
+                if resil.active:
+                    params, opt_state, loss, g_norm, skipped = step_fn(
+                        params, opt_state, text, images, step_key,
+                        thresh=resil.threshold(),
+                        fault_scale=faults.grad_scale(global_step),
+                    )
+                    action = resil.observe(
+                        global_step, float(loss), float(g_norm), bool(skipped)
+                    )
+                else:
+                    params, opt_state, loss = step_fn(
+                        params, opt_state, text, images, step_key
+                    )
+                if action == "rollback":
+                    rollback = True
+                    break
                 m = meter.step()
                 if m is not None:
                     loss_f = float(distr.average_all(loss))
@@ -319,19 +356,55 @@ def main(argv=None):
                 if global_step and global_step % args.save_every_n_steps == 0:
                     save(f"clip-step{global_step}", in_loop=True)
                 global_step += 1
+                data_step += 1
+
+            if rollback:
+                if ckpt_writer is not None:
+                    ckpt_writer.wait()
+                from dalle_tpu.training.checkpoint import find_latest_checkpoint
+
+                latest = find_latest_checkpoint(ckpt_dir, "clip")
+                if latest is None:
+                    raise SystemExit(
+                        "anomaly rollback requested but no intact "
+                        f"checkpoint exists under {ckpt_dir}"
+                    )
+                meta = load_meta(latest)
+                params, opt_state = restore_train_state(
+                    latest, meta, params, opt_state
+                )
+                # copy before the next donating step (same restore-path
+                # donation guard as the resume path above)
+                params, opt_state = jax.jit(
+                    lambda t: jax.tree_util.tree_map(jnp.copy, t)
+                )((params, opt_state))
+                global_step = meta.get("step", 0)
+                epoch = meta.get("epoch", epoch)
+                resume_data_step = meta.get("data_step", 0)
+                resil.note_rollback(global_step)
+                continue
+
             resume_epoch = epoch + 1
+            data_step = 0
             save(f"clip-epoch{epoch}")
+            epoch += 1
         save("clip-final")
+    except resilience.Preempted:
+        if is_root:
+            print("preempted: checkpoint flushed, exiting cleanly")
     finally:
         # drain the async writer on EVERY exit path — interpreter
         # shutdown tears down executors before the writer thread
         # joins, killing in-flight saves (ADVICE.md)
         if ckpt_writer is not None:
             ckpt_writer.wait()
+        resil.close()
+        resil.uninstall_signal_handlers()
     if is_root:
-        run.log_artifact(str(ckpt_dir / "clip-final"), name="trained-clip")
+        if not resil.preempted:
+            run.log_artifact(str(ckpt_dir / "clip-final"), name="trained-clip")
+            print(f"saved {ckpt_dir/'clip-final'}")
         run.finish()
-        print(f"saved {ckpt_dir/'clip-final'}")
 
 
 if __name__ == "__main__":

@@ -22,7 +22,7 @@ import numpy as np
 import optax
 
 from dalle_tpu.data import BatchedWebLoader, DataLoader, TextImageDataset, WebDataset
-from dalle_tpu.data.prefetch import device_prefetch, local_rows
+from dalle_tpu.data.prefetch import device_prefetch, local_rows, watchdog_iter
 from dalle_tpu.parallel.mesh import batch_sharding
 from dalle_tpu.models.dalle import DALLE, DALLEConfig
 from dalle_tpu.models.generate import generate_images
@@ -45,7 +45,8 @@ from dalle_tpu.training.checkpoint import (
     save_checkpoint,
     shape_dtype_of,
 )
-from dalle_tpu.training.logging import Run
+from dalle_tpu.training import faults, resilience
+from dalle_tpu.training.logging import Run, log_event
 from dalle_tpu.training.precision import add_precision_args, policy_from_flags
 from dalle_tpu.training.schedule import ReduceLROnPlateau
 from dalle_tpu.tokenizers import get_tokenizer
@@ -245,6 +246,7 @@ def parse_args(argv=None):
                              "command line (file wins, warns per override; "
                              "the reference's DeepSpeed-config precedence, "
                              "deepspeed_backend.py:66-133)")
+    resilience.add_resilience_args(parser)
     parser = backend_lib.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
@@ -325,6 +327,11 @@ def main(argv=None):
     is_root = distr.is_root_worker()
     rank, world = distr.get_rank(), distr.get_world_size()
 
+    # resilience: anomaly skip/rollback policy + preemption-safe shutdown
+    # (SIGTERM/SIGINT -> checkpoint at the next step boundary, exit 0)
+    resil = resilience.Resilience.from_args(args, is_root=is_root)
+    resil.install_signal_handlers()
+
     tokenizer = get_tokenizer(
         bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese
     )
@@ -346,6 +353,10 @@ def main(argv=None):
         # templates exist
         resume_meta = load_meta(args.dalle_path)
         start_epoch = resume_meta.get("epoch", 0)
+    # intra-epoch data position of the resumed checkpoint: the epoch's
+    # deterministic batch stream is fast-forwarded by this many batches so
+    # resume neither replays nor skips data (epoch-end saves store 0)
+    resume_data_step = resume_meta.get("data_step", 0) if resume_meta else 0
 
     vae, vae_params, vae_cfg = resolve_vae(args, resume_meta, distr.mesh)
 
@@ -526,7 +537,7 @@ def main(argv=None):
     want_metrics = cfg.moe_experts > 0
     step_fn = make_dalle_train_step(
         model, tx, distr.mesh, vae=vae, with_metrics=want_metrics,
-        grad_comm=args.grad_comm,
+        grad_comm=args.grad_comm, anomaly=resil.active,
     )
 
     sched = ReduceLROnPlateau(lr=args.learning_rate) if args.lr_decay else None
@@ -576,6 +587,7 @@ def main(argv=None):
             vae_hparams=vae_cfg.to_dict() if vae_cfg else None,
             epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
+            data_step=data_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict() if sched else None,
             optimizer_meta=optimizer_meta_from_args(args),
             keep_n=args.keep_n_checkpoints,
@@ -590,6 +602,10 @@ def main(argv=None):
             # upload and the fail-early contract read the dir right after
             ckpt_writer.wait()
         save_checkpoint(path, **kwargs)
+
+    # batches applied within the current epoch (rides in checkpoint meta
+    # so mid-epoch resume/rollback fast-forwards the data stream exactly)
+    data_step = 0
 
     # fail-early checkpoint (reference: train_dalle.py:561-563)
     save("init")
@@ -613,7 +629,8 @@ def main(argv=None):
     )
     lr = args.learning_rate
     try:
-        for epoch in range(start_epoch, args.epochs):
+        epoch = start_epoch
+        while epoch < args.epochs:
             resume_epoch = epoch
             if hasattr(loader, "set_epoch"):
                 loader.set_epoch(epoch)
@@ -622,27 +639,68 @@ def main(argv=None):
             # the host only syncs on the logging cadence and at epoch end
             loss_sum = None
             loss_count = 0
-            batches = device_prefetch(
-                loader, batch_sharding(distr.mesh), depth=args.prefetch_depth
+            epoch_it = watchdog_iter(
+                iter(loader), timeout_s=args.data_watchdog_s,
+                label="train_dalle",
             )
-            for i, (text, images) in enumerate(batches):
+            # mid-epoch resume / rollback replay: the loader's per-epoch
+            # stream is deterministic (seed+epoch), so skipping the batches
+            # the checkpoint already applied replays nothing and loses nothing
+            data_step = resilience.skip_batches(epoch_it, resume_data_step)
+            resume_data_step = 0
+            batches = device_prefetch(
+                epoch_it, batch_sharding(distr.mesh), depth=args.prefetch_depth
+            )
+            rollback = False
+            for text, images in batches:
+                faults.check_signal(global_step)
+                if resil.preempted:
+                    # synchronous: in_loop=False drains any async write
+                    # first, so the preemption checkpoint is on disk and
+                    # intact before the clean exit
+                    log_event("preempt_checkpoint", step=global_step,
+                              epoch=epoch, data_step=data_step)
+                    save(f"step{global_step}")
+                    raise resilience.Preempted
                 if args.flops_profiler and global_step == 200 and is_root:
                     jax.profiler.start_trace(str(ckpt_dir / "profile"))
-                out = step_fn(
-                    params, opt_state, vae_params, text, images,
-                    jax.random.fold_in(rng, global_step),
-                )
-                if want_metrics:
-                    params, opt_state, loss, step_metrics = out
+                step_key = jax.random.fold_in(rng, global_step)
+                action = "ok"
+                if resil.active:
+                    out = step_fn(
+                        params, opt_state, vae_params, text, images, step_key,
+                        thresh=resil.threshold(),
+                        fault_scale=faults.grad_scale(global_step),
+                    )
+                    if want_metrics:
+                        (params, opt_state, loss, step_metrics,
+                         g_norm, skipped) = out
+                    else:
+                        params, opt_state, loss, g_norm, skipped = out
+                        step_metrics = {}
+                    action = resil.observe(
+                        global_step, float(loss), float(g_norm), bool(skipped)
+                    )
                 else:
-                    params, opt_state, loss = out
-                    step_metrics = {}
-                if ema_step is not None:
+                    out = step_fn(
+                        params, opt_state, vae_params, text, images, step_key
+                    )
+                    if want_metrics:
+                        params, opt_state, loss, step_metrics = out
+                    else:
+                        params, opt_state, loss = out
+                        step_metrics = {}
+                if ema_step is not None and action == "ok":
+                    # a skipped step applied a zero update; the EMA must
+                    # not drift toward (identical) params as if it trained
                     ema_params = ema_step(ema_params, params)
                 if args.flops_profiler and global_step == 201 and is_root:
                     jax.block_until_ready(loss)
                     jax.profiler.stop_trace()
                     print(f"profiler trace written to {ckpt_dir/'profile'}")
+                if action == "rollback":
+                    rollback = True
+                    break
                 loss_sum = loss if loss_sum is None else loss_sum + loss
                 loss_count += 1
 
@@ -689,18 +747,61 @@ def main(argv=None):
                         captions=[caption],
                     )
                 global_step += 1
+                data_step += 1
+
+            if rollback:
+                # restore-from-last-good: K consecutive anomalous steps
+                # mean the live state is poisoned beyond skipping
+                if ckpt_writer is not None:
+                    ckpt_writer.wait()
+                from dalle_tpu.training.checkpoint import (
+                    find_latest_checkpoint,
+                    restore_train_state,
+                )
+
+                latest = find_latest_checkpoint(
+                    ckpt_dir, args.dalle_output_file_name
+                )
+                if latest is None:
+                    raise SystemExit(
+                        "anomaly rollback requested but no intact "
+                        f"checkpoint exists under {ckpt_dir}"
+                    )
+                meta = load_meta(latest)
+                params, opt_state = restore_train_state(
+                    latest, meta, params, opt_state
+                )
+                if ema_params is not None and "ema_params" in meta.get(
+                    "subtrees", ()
+                ):
+                    ema_params = load_subtree(
+                        latest, "ema_params", shape_dtype_of(ema_params)
+                    )
+                global_step = meta.get("step", 0)
+                epoch = meta.get("epoch", epoch)
+                resume_data_step = meta.get("data_step", 0)
+                resil.note_rollback(global_step)
+                continue  # re-enter the checkpointed epoch, fast-forwarded
 
             if sched is not None and loss_count:
                 lr = sched.step(float(loss_sum) / loss_count)
                 opt_state = set_learning_rate(opt_state, lr)
             resume_epoch = epoch + 1
+            data_step = 0
             save(f"epoch{epoch}")
             if is_root:
                 run.log_artifact(
                     str(ckpt_dir / f"{args.dalle_output_file_name}-epoch{epoch}"),
                     name="trained-dalle",
                 )
+            epoch += 1
         save("final")
+    except resilience.Preempted:
+        # the preemption checkpoint is already on disk (written before the
+        # raise); exiting 0 here is the contract — a preempted run is a
+        # clean shutdown, not a failure
+        if is_root:
+            print("preempted: checkpoint flushed, exiting cleanly")
     finally:
         # drain the async checkpoint writer on EVERY exit path:
         # without this, an exception (or plain interpreter exit)
@@ -710,6 +811,8 @@ def main(argv=None):
         # shutdown' (ADVICE.md)
         if ckpt_writer is not None:
             ckpt_writer.wait()
+        resil.close()
+        resil.uninstall_signal_handlers()
     if is_root:
         run.finish()
 

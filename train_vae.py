@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_tpu.data import DataLoader, ImageFolderDataset
-from dalle_tpu.data.prefetch import device_prefetch, local_rows
+from dalle_tpu.data.prefetch import device_prefetch, local_rows, watchdog_iter
 from dalle_tpu.parallel.mesh import batch_sharding
 from dalle_tpu.models.vae import DiscreteVAE, DiscreteVAEConfig
 from dalle_tpu.parallel import backend as backend_lib
@@ -35,7 +35,8 @@ from dalle_tpu.training.checkpoint import (
     optimizer_meta_from_args,
     save_checkpoint,
 )
-from dalle_tpu.training.logging import Run
+from dalle_tpu.training import faults, resilience
+from dalle_tpu.training.logging import Run, log_event
 from dalle_tpu.training.precision import add_precision_args, policy_from_flags
 from dalle_tpu.training.schedule import ExponentialDecay
 
@@ -108,6 +109,7 @@ def parse_args(argv=None):
     parser.add_argument("--auto_resume", action="store_true",
                         help="resume from the newest checkpoint in "
                              "--output_path if one exists")
+    resilience.add_resilience_args(parser)
     parser = backend_lib.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
@@ -124,6 +126,9 @@ def main(argv=None):
     distr.initialize(**mesh_kwargs_from_args(args))
     distr.check_batch_size(args.batch_size)
     is_root = distr.is_root_worker()
+
+    resil = resilience.Resilience.from_args(args, is_root=is_root)
+    resil.install_signal_handlers()
 
     from dalle_tpu.training.checkpoint import (
         load_meta,
@@ -222,7 +227,8 @@ def main(argv=None):
             lambda t: jax.tree_util.tree_map(jnp.copy, t)
         )((params, opt_state))
     step_fn = make_vae_train_step(vae, tx, distr.mesh,
-                                  grad_comm=args.grad_comm)
+                                  grad_comm=args.grad_comm,
+                                  anomaly=resil.active)
     encode_fn = jax.jit(
         lambda p, img: vae.apply({"params": p}, img, method=DiscreteVAE.get_codebook_indices)
     )
@@ -243,6 +249,9 @@ def main(argv=None):
     if resume_meta is not None:
         global_step = resume_meta.get("step", 0)
         start_epoch = resume_meta.get("epoch", 0)
+    resume_data_step = resume_meta.get("data_step", 0) if resume_meta else 0
+    data_step = 0  # batches applied within the current epoch
+    if resume_meta is not None:
         if resume_meta.get("scheduler_state"):
             sched.load_state_dict(resume_meta["scheduler_state"])
             opt_state = set_learning_rate(opt_state, sched.lr)
@@ -278,6 +287,7 @@ def main(argv=None):
             opt_state=opt_state,
             epoch=resume_epoch,
             step=global_step + (1 if in_loop else 0),
+            data_step=data_step + (1 if in_loop else 0),
             scheduler_state=sched.state_dict(),
             optimizer_meta=optimizer_meta_from_args(args),
         )
@@ -290,15 +300,43 @@ def main(argv=None):
         save_checkpoint(path, **kwargs)
 
     try:
-        for epoch in range(start_epoch, args.epochs):
+        epoch = start_epoch
+        while epoch < args.epochs:
             resume_epoch = epoch
             loader.set_epoch(epoch)
+            epoch_it = watchdog_iter(
+                iter(loader), timeout_s=args.data_watchdog_s, label="train_vae"
+            )
+            data_step = resilience.skip_batches(epoch_it, resume_data_step)
+            resume_data_step = 0
+            rollback = False
             for images in device_prefetch(
-                loader, batch_sharding(distr.mesh), depth=args.prefetch_depth
+                epoch_it, batch_sharding(distr.mesh), depth=args.prefetch_depth
             ):
-                params, opt_state, loss, recons = step_fn(
-                    params, opt_state, images, temp, jax.random.fold_in(rng, global_step)
-                )
+                faults.check_signal(global_step)
+                if resil.preempted:
+                    log_event("preempt_checkpoint", step=global_step,
+                              epoch=epoch, data_step=data_step)
+                    save("vae")  # synchronous; the usual in-loop name, so
+                    raise resilience.Preempted  # --auto_resume finds it
+                step_key = jax.random.fold_in(rng, global_step)
+                action = "ok"
+                if resil.active:
+                    params, opt_state, loss, recons, g_norm, skipped = step_fn(
+                        params, opt_state, images, temp, step_key,
+                        thresh=resil.threshold(),
+                        fault_scale=faults.grad_scale(global_step),
+                    )
+                    action = resil.observe(
+                        global_step, float(loss), float(g_norm), bool(skipped)
+                    )
+                else:
+                    params, opt_state, loss, recons = step_fn(
+                        params, opt_state, images, temp, step_key
+                    )
+                if action == "rollback":
+                    rollback = True
+                    break
                 if global_step % 100 == 0:
                     # temperature anneal (reference: train_vae.py:218-221,269-271)
                     temp = max(
@@ -340,16 +378,68 @@ def main(argv=None):
                     run.log({"loss": avg_loss, "epoch": epoch, "samples_per_sec": sps},
                             step=global_step)
                 global_step += 1
+                data_step += 1
+
+            if rollback:
+                if ckpt_writer is not None:
+                    ckpt_writer.wait()
+                from dalle_tpu.training.checkpoint import (
+                    is_intact_checkpoint,
+                    load_subtree,
+                    shape_dtype_of,
+                )
+
+                cands = [
+                    c for c in (f"{args.output_path}/vae",
+                                f"{args.output_path}/vae-final")
+                    if is_intact_checkpoint(c)
+                ]
+                if not cands:
+                    raise SystemExit(
+                        "anomaly rollback requested but no intact "
+                        f"checkpoint exists under {args.output_path}"
+                    )
+                latest = max(cands, key=lambda c: load_meta(c).get("step", 0))
+                meta = load_meta(latest)
+                params, opt_state = restore_train_state(
+                    latest, meta, params, opt_state
+                )
+                # copy before the next donating step (same restore-path
+                # donation guard as the resume path above)
+                params, opt_state = jax.jit(
+                    lambda t: jax.tree_util.tree_map(jnp.copy, t)
+                )((params, opt_state))
+                global_step = meta.get("step", 0)
+                epoch = meta.get("epoch", epoch)
+                resume_data_step = meta.get("data_step", 0)
+                if meta.get("scheduler_state"):
+                    sched.load_state_dict(meta["scheduler_state"])
+                    opt_state = set_learning_rate(opt_state, sched.lr)
+                temp = max(
+                    start_temp * math.exp(-args.anneal_rate * global_step),
+                    args.temp_min,
+                )
+                resil.note_rollback(global_step)
+                continue
+
             resume_epoch = epoch + 1
+            data_step = 0
+            epoch += 1
         save("vae-final")
+    except resilience.Preempted:
+        if is_root:
+            print("preempted: checkpoint flushed, exiting cleanly")
     finally:
         # drain the async writer on EVERY exit path — interpreter
         # shutdown tears down executors before the writer thread
         # joins, killing in-flight saves (ADVICE.md)
         if ckpt_writer is not None:
             ckpt_writer.wait()
+        resil.close()
+        resil.uninstall_signal_handlers()
     if is_root:
-        run.log_artifact(args.output_path + "/vae-final", name="trained-vae")
+        if not resil.preempted:
+            run.log_artifact(args.output_path + "/vae-final", name="trained-vae")
         run.finish()
 
 
