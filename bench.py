@@ -102,6 +102,14 @@ PHASES = [
     # trajectory must match the uninterrupted reference (rtol 2e-3, zero
     # lost steps).  Host-side subprocesses; records even on a wedged chip
     ("resilience", 900, False),
+    # serving-resilience evidence (docs/SERVING.md "Overload & failure
+    # semantics"): the serving chaos harness — a tick_fail engine crash
+    # mid-flight must recover with bitwise-identical replayed codes and
+    # zero hung result() waiters, a zero-restart-budget crash must
+    # fail-fast every request with a structured error, and a 10x flood
+    # against a bounded queue must shed (never grow) with admitted p99
+    # TTLT within 2x of the unflooded baseline.  Host-side
+    ("serving_resilience", 900, False),
 ]
 
 # phases that are their own hardened scripts (run via custom argv instead of
@@ -1293,6 +1301,37 @@ def _resilience_bench():
     return res
 
 
+def _serving_resilience_bench():
+    """Serving chaos rung (tools/serving_chaos.py, the ISSUE 5 pin).
+
+    Gate: crash_replay (zero hangs + bitwise replay after an injected
+    engine-tick failure), fail_fast (restart budget 0 still completes
+    every request with an error), and flood (10x burst vs a bounded
+    queue: pending bounded, shed > 0, admitted p99 TTLT <= 2x the
+    unflooded baseline).  A failed gate sets ``rung_failed``."""
+    from tools.serving_chaos import run_serving_chaos
+
+    t0 = time.time()
+    try:
+        verdict = run_serving_chaos()
+    except (RuntimeError, AssertionError) as e:
+        return {"rung_failed": f"serving chaos crashed: {e}"[:2000],
+                "wall_s": round(time.time() - t0, 1)}
+    _hb(
+        f"serving_resilience: ok={verdict['ok']} "
+        f"restarts={verdict['crash_replay']['engine_restarts']} "
+        f"shed={verdict['flood']['shed']} "
+        f"p99_ratio={verdict['flood']['p99_ratio']}"
+    )
+    res = dict(verdict)
+    res["wall_s"] = round(time.time() - t0, 1)
+    if not verdict["ok"]:
+        bad = [k for k in ("crash_replay", "fail_fast", "flood")
+               if not verdict[k]["ok"]]
+        res["rung_failed"] = f"serving chaos gates failed: {bad}"
+    return res
+
+
 PHASE_FNS = {
     "train_tiny": lambda: _train_bench(tiny=True),
     "train": _train_bench,
@@ -1308,6 +1347,7 @@ PHASE_FNS = {
     "serving_throughput": _serving_bench,
     "rainbow": _rainbow_bench,
     "resilience": _resilience_bench,
+    "serving_resilience": _serving_resilience_bench,
 }
 
 

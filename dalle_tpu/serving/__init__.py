@@ -12,14 +12,21 @@ image_seq_len generation).  See docs/SERVING.md §5.
 """
 
 from dalle_tpu.serving.engine import DecodeEngine, EngineState
-from dalle_tpu.serving.queue import Request, RequestQueue
+from dalle_tpu.serving.queue import (
+    Request,
+    RequestError,
+    RequestQueue,
+    SHED_POLICIES,
+)
 from dalle_tpu.serving.scheduler import (
     POLICIES,
+    DegradeController,
     Scheduler,
     TraceItem,
     load_trace,
     make_poisson_trace,
     replay_trace,
+    request_stats,
     save_trace,
 )
 
@@ -27,12 +34,16 @@ __all__ = [
     "DecodeEngine",
     "EngineState",
     "Request",
+    "RequestError",
     "RequestQueue",
+    "SHED_POLICIES",
     "Scheduler",
+    "DegradeController",
     "POLICIES",
     "TraceItem",
     "make_poisson_trace",
     "replay_trace",
+    "request_stats",
     "load_trace",
     "save_trace",
 ]

@@ -36,6 +36,7 @@ import numpy as np
 
 from dalle_tpu.models.dalle import DALLE
 from dalle_tpu.ops.sampling import sample_logits_per_slot
+from dalle_tpu.training import faults
 
 from dalle_tpu.serving.queue import Request
 
@@ -190,6 +191,42 @@ class DecodeEngine:
     def num_active(self) -> int:
         return sum(r is not None for r in self.slot_req)
 
+    def in_flight(self) -> List[Request]:
+        """Requests currently occupying slots (crash-recovery snapshot)."""
+        return [r for r in self.slot_req if r is not None]
+
+    def remaining_ticks(self, slot: int) -> Optional[int]:
+        """Decode ticks left before ``slot`` completes (None if free)."""
+        if self._slot_done[slot] is None:
+            return None
+        return max(0, self._slot_done[slot] - self.tick_count)
+
+    def evict(self, slot: int) -> Optional[Request]:
+        """Free ``slot`` mid-flight: deactivate the lane on device and
+        drop the host bookkeeping.  The evicted request's codes are
+        abandoned (the caller stamps the error).  One tiny [B]-bool
+        device update; the lane's cache rows are overwritten by the next
+        occupant's admission prefill, exactly like normal completion."""
+        req = self.slot_req[slot]
+        if req is None:
+            return None
+        self.state = self.state._replace(
+            active=self.state.active.at[slot].set(False)
+        )
+        self.slot_req[slot] = None
+        self._slot_done[slot] = None
+        return req
+
+    def reset(self) -> None:
+        """Crash recovery: rebuild a fresh EngineState from params (the
+        compiled tick/admit fns are kept — same shapes, no recompile) and
+        clear all slot bookkeeping.  Safe even when the previous state's
+        donated buffers were invalidated by a failed dispatch."""
+        self.state = self._init_state()
+        self.tick_count = 0
+        self.slot_req = [None] * self.num_slots
+        self._slot_done = [None] * self.num_slots
+
     def warmup(self):
         """Compile tick + admit up front (keeps XLA compile time out of
         the latency stats), then reset to a fresh state."""
@@ -255,6 +292,7 @@ class DecodeEngine:
         with ``codes`` ([image_seq_len] VQ codes) and ``finish_time``
         stamped.  Completion ticks are known host-side — the only device
         sync is fetching each finished slot's output row."""
+        faults.on_engine_tick()  # injected slow_tick / tick_fail (no-op off)
         self.state = self._tick_fn(self.params, self.state)
         self.tick_count += 1
         done = []

@@ -1,26 +1,43 @@
-"""Host-side request plumbing: `Request` + a thread-safe FIFO queue.
+"""Host-side request plumbing: `Request` + a thread-safe bounded queue.
 
 The engine/scheduler never see raw client payloads — a `Request` carries
 the tokenized text, the per-request sampling config and seed, and the
 latency bookkeeping the bench rung reads back (arrival/admit/finish
 timestamps, all `time.monotonic`).
+
+Overload control (docs/SERVING.md "Overload & failure semantics"): the
+queue is optionally bounded (``max_pending``) with a configurable shed
+policy — under sustained overload it sheds load with a structured error
+instead of growing without bound — and ``pop()`` serves
+earliest-deadline-first so deadline traffic is dequeued before it
+expires.
 """
 
 from __future__ import annotations
 
 import itertools
+import math
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import numpy as np
 
+from dalle_tpu.training.logging import log_event
+
 _ids = itertools.count()
 
+SHED_POLICIES = ("reject", "evict_oldest", "evict_latest_deadline")
 
-@dataclass
+
+class RequestError(RuntimeError):
+    """Raised by ``Request.result(raise_on_error=True)`` when the request
+    finished with an error (shed, evicted, crashed, or detok failure)."""
+
+
+@dataclass(eq=False)  # identity equality: requests hold numpy payloads
 class Request:
     """One image-generation request.
 
@@ -45,6 +62,8 @@ class Request:
     clip_score: Optional[float] = None
     dropped: bool = False
     error: Optional[str] = None  # detok-worker failure, request still completes
+    retries: int = 0  # crash-recovery replays consumed so far
+    service_tier: int = 0  # degradation tier the request was served at
     _done: threading.Event = field(
         default_factory=threading.Event, repr=False, compare=False
     )
@@ -60,41 +79,168 @@ class Request:
             return None
         return self.finish_time - self.arrival_time
 
-    def result(self, timeout: Optional[float] = None) -> "Request":
-        """Block until the request is fully processed (or dropped)."""
+    def deadline_abs(self) -> float:
+        """Absolute deadline on the monotonic clock (+inf when none)."""
+        if self.deadline_s is None or self.arrival_time is None:
+            return math.inf
+        return self.arrival_time + self.deadline_s
+
+    def result(self, timeout: Optional[float] = None,
+               raise_on_error: bool = False) -> "Request":
+        """Block until the request is fully processed (or dropped).
+
+        With ``raise_on_error=True``, a request that finished with
+        ``error`` set (shed, evicted mid-flight, engine crash past the
+        retry budget, detok failure) raises :class:`RequestError` instead
+        of returning a half-empty request."""
         self._done.wait(timeout)
+        if raise_on_error and self._done.is_set() and self.error is not None:
+            raise RequestError(f"{self.request_id}: {self.error}")
         return self
+
+    def _fail(self, reason: str, *, dropped: bool = True) -> None:
+        """Terminal failure: stamp the error (first one wins), mark
+        dropped, and release every ``result()`` waiter."""
+        if self.error is None:
+            self.error = reason
+        self.dropped = self.dropped or dropped
+        self._done.set()
 
 
 class RequestQueue:
-    """Thread-safe FIFO with close() semantics.
+    """Thread-safe request queue with close() + bounded-admission semantics.
 
-    Producers `submit()` from any thread; the scheduler `pop()`s batches.
-    `close()` signals no more submissions — the scheduler drains what is
-    left and exits.
+    Producers `submit()` from any thread; the scheduler `pop()`s batches
+    in earliest-deadline-first order (no-deadline requests rank last,
+    FIFO among equals).  `close()` signals no more submissions — the
+    scheduler drains what is left and exits.
+
+    With ``max_pending`` set, a submit that would exceed the bound sheds
+    one request according to ``shed_policy``:
+
+    * ``reject`` — the NEW arrival is shed (classic admission control);
+    * ``evict_oldest`` — the longest-queued request is shed to make room;
+    * ``evict_latest_deadline`` — the candidate (queued or the newcomer)
+      with the MOST deadline slack is shed: latest absolute deadline
+      first, no-deadline requests before any deadline-carrying one.
+
+    A shed request completes immediately with ``dropped=True`` and a
+    structured ``error`` — its ``result()`` never hangs — and is recorded
+    on ``self.shed`` plus a ``serve_shed`` event.  ``on_shed`` (if given)
+    is called with each shed request outside the queue lock.
     """
 
-    def __init__(self):
+    def __init__(self, max_pending: Optional[int] = None,
+                 shed_policy: str = "reject", on_shed=None):
+        assert shed_policy in SHED_POLICIES, (
+            f"shed_policy must be one of {SHED_POLICIES}, got {shed_policy!r}"
+        )
+        assert max_pending is None or max_pending >= 1, (
+            f"max_pending must be >= 1 (or None for unbounded), "
+            f"got {max_pending}"
+        )
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
+        self.max_pending = max_pending
+        self.shed_policy = shed_policy
+        self.on_shed = on_shed
+        self.shed: List[Request] = []
+        self.max_pending_seen = 0  # high-water mark of queue depth
+
+    # --- shedding --------------------------------------------------------
+    def _pick_victim(self, new: Request) -> Request:
+        """The request to shed so the queue stays within bounds.  Called
+        under the lock with the queue full."""
+        if self.shed_policy == "reject":
+            return new
+        if self.shed_policy == "evict_oldest":
+            return self._q[0]
+        # evict_latest_deadline: most slack loses; no-deadline == inf
+        # slack.  Ties (e.g. several no-deadline requests) shed the
+        # newest arrival, keeping the oldest work.
+        candidates = list(self._q) + [new]
+        return max(
+            candidates,
+            key=lambda r: (r.deadline_abs(), r.arrival_time or 0.0),
+        )
 
     def submit(self, req: Request) -> Request:
+        """Enqueue (or shed).  Always returns ``req``; callers detect a
+        shed newcomer via ``req.dropped``/``req.error``."""
+        victim = None
         with self._cv:
             if self._closed:
                 raise RuntimeError("RequestQueue is closed")
             if req.arrival_time is None:
                 req.arrival_time = time.monotonic()
-            self._q.append(req)
+            if (self.max_pending is not None
+                    and len(self._q) >= self.max_pending):
+                victim = self._pick_victim(req)
+                if victim is not req:
+                    self._q.remove(victim)
+                    self._q.append(req)
+                self.shed.append(victim)
+            else:
+                self._q.append(req)
+            self.max_pending_seen = max(self.max_pending_seen, len(self._q))
             self._cv.notify_all()
+        if victim is not None:
+            victim._fail(
+                f"shed: queue full (max_pending={self.max_pending}, "
+                f"policy={self.shed_policy})"
+            )
+            log_event(
+                "serve_shed", request_id=victim.request_id,
+                policy=self.shed_policy, max_pending=self.max_pending,
+                newcomer=victim is req,
+            )
+            if self.on_shed is not None:
+                try:
+                    self.on_shed(victim)
+                except Exception:
+                    pass  # a reporting callback must not break admission
         return req
 
+    # --- dequeue ---------------------------------------------------------
     def pop(self, max_n: int) -> list:
-        """FIFO-pop up to ``max_n`` requests (non-blocking)."""
+        """Pop up to ``max_n`` requests, earliest-deadline-first
+        (non-blocking).  Requests without a deadline rank after all
+        deadline-carrying ones; arrival order breaks ties — so a
+        deadline-free workload still pops FIFO."""
         with self._cv:
-            out = []
-            while self._q and len(out) < max_n:
-                out.append(self._q.popleft())
+            if not self._q or max_n <= 0:
+                return []
+            order = sorted(
+                range(len(self._q)),
+                key=lambda i: (self._q[i].deadline_abs(), i),
+            )
+            chosen = order[:max_n]
+            # EDF within the popped batch too, queue position breaking
+            # ties — so crash replays requeued at the front ARE served
+            # first among equal deadlines
+            out = [self._q[i] for i in chosen]
+            chosen = set(chosen)
+            self._q = deque(
+                r for i, r in enumerate(self._q) if i not in chosen
+            )
+            return out
+
+    def requeue(self, reqs: list) -> None:
+        """Put already-admitted requests back at the FRONT of the queue
+        (crash-recovery replay).  Never sheds — these passed admission
+        once; shedding a replay would break the replay guarantee."""
+        with self._cv:
+            for r in reversed(reqs):
+                self._q.appendleft(r)
+            self.max_pending_seen = max(self.max_pending_seen, len(self._q))
+            self._cv.notify_all()
+
+    def drain(self) -> list:
+        """Remove and return everything still queued (shutdown paths)."""
+        with self._cv:
+            out = list(self._q)
+            self._q.clear()
             return out
 
     def pending(self) -> int:

@@ -1,4 +1,6 @@
-"""Host-side scheduling: admission policies, the detok worker, trace replay.
+"""Host-side scheduling: admission policies, the detok worker, trace
+replay, and the overload/failure machinery (docs/SERVING.md "Overload &
+failure semantics").
 
 Three admission policies (the bench rung's three bars):
 
@@ -16,11 +18,22 @@ VAE decode + optional CLIP scoring run on a worker thread
 (``detok``) so the device step loop never blocks on detokenization;
 ``Request.finish_time`` (the TTLT endpoint) is stamped when the last
 token is sampled, before detok.
+
+Failure semantics: the scheduler tick runs under a supervisor.  An
+engine exception fails NO request silently — with restart budget left,
+the engine state is rebuilt (same compiled fns) and in-flight requests
+are deterministically replayed from their (text, seed, sampling) tuple
+(bounded per-request retries); past the budget, and on any exit path,
+every admitted-but-unfinished and still-queued request completes with
+``error`` set — ``result()`` can never hang.  Under sustained queue
+pressure the :class:`DegradeController` drops to cheaper service tiers
+(skip CLIP rerank, then skip VAE detok) with hysteresis.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import queue as pyqueue
 import threading
 import time
@@ -31,8 +44,92 @@ import numpy as np
 
 from dalle_tpu.serving.engine import DecodeEngine
 from dalle_tpu.serving.queue import Request, RequestQueue
+from dalle_tpu.training import faults
+from dalle_tpu.training.logging import log_event
 
 POLICIES = ("sequential", "full_batch", "continuous")
+
+
+def request_stats(completed: Sequence[Request], image_seq_len: int) -> dict:
+    """Throughput/latency stats over a completed-request list.
+
+    Module-level (not a Scheduler method) so the percentile math is
+    directly pinnable on hand-built lists — including the all-dropped
+    and single-request edge cases (tests/test_serving.py)."""
+    served = [r for r in completed if not r.dropped]
+    dropped = len(completed) - len(served)
+    out = {
+        "served": len(served),
+        "dropped": dropped,
+        "tokens": len(served) * image_seq_len,
+    }
+    if not served:
+        out.update(makespan_s=0.0, tokens_per_s=0.0,
+                   ttlt_p50_s=None, ttlt_p99_s=None)
+        return out
+    t0 = min(r.arrival_time for r in served)
+    t1 = max(r.finish_time for r in served)
+    makespan = max(t1 - t0, 1e-9)
+    tt = sorted(r.ttlt for r in served)
+
+    def pct(p):
+        i = min(len(tt) - 1, int(round(p / 100.0 * (len(tt) - 1))))
+        return tt[i]
+
+    out.update(
+        makespan_s=makespan,
+        tokens_per_s=out["tokens"] / makespan,
+        ttlt_p50_s=pct(50),
+        ttlt_p99_s=pct(99),
+    )
+    return out
+
+
+class DegradeController:
+    """EWMA queue-pressure → service tier, with hysteresis.
+
+    Pressure is the scheduler's backlog (pending admissions + detok
+    backlog), smoothed by an EWMA so one bursty tick never flips the
+    tier.  Tiers escalate one step per update when the EWMA exceeds
+    ``high`` and relax one step when it falls below ``low`` (< high —
+    the hysteresis band keeps the tier stable between the thresholds):
+
+    * tier 0 ``full``       — VAE detok + CLIP rerank
+    * tier 1 ``skip_clip``  — VAE detok only (no rerank score)
+    * tier 2 ``codes_only`` — no detok: the client gets VQ codes
+
+    Every transition logs a structured ``serve_degraded`` /
+    ``serve_restored`` event.
+    """
+
+    TIERS = ("full", "skip_clip", "codes_only")
+
+    def __init__(self, *, high: float, low: float, alpha: float = 0.25):
+        assert 0 <= low < high, (
+            f"hysteresis band needs 0 <= low < high, got low={low} "
+            f"high={high}"
+        )
+        assert 0 < alpha <= 1
+        self.high, self.low, self.alpha = high, low, alpha
+        self.ewma = 0.0
+        self.tier = 0
+        self.transitions = 0
+
+    def update(self, pressure: float) -> int:
+        self.ewma += self.alpha * (pressure - self.ewma)
+        if self.ewma > self.high and self.tier < len(self.TIERS) - 1:
+            self.tier += 1
+            self.transitions += 1
+            log_event("serve_degraded", tier=self.tier,
+                      service=self.TIERS[self.tier],
+                      pressure_ewma=round(self.ewma, 3))
+        elif self.ewma < self.low and self.tier > 0:
+            self.tier -= 1
+            self.transitions += 1
+            log_event("serve_restored", tier=self.tier,
+                      service=self.TIERS[self.tier],
+                      pressure_ewma=round(self.ewma, 3))
+        return self.tier
 
 
 class Scheduler:
@@ -50,6 +147,13 @@ class Scheduler:
         clip_params=None,
         on_result=None,
         idle_wait: float = 0.002,
+        max_engine_restarts: int = 2,
+        max_request_retries: int = 1,
+        degrade: bool = False,
+        degrade_high: Optional[float] = None,
+        degrade_low: Optional[float] = None,
+        detok_max: Optional[int] = 64,
+        evict_unmeetable: bool = True,
     ):
         assert policy in POLICIES, f"policy must be one of {POLICIES}"
         self.engine = engine
@@ -57,8 +161,31 @@ class Scheduler:
         self.policy = policy
         self.on_result = on_result
         self.idle_wait = idle_wait
+        self.max_engine_restarts = int(max_engine_restarts)
+        self.max_request_retries = int(max_request_retries)
+        self.evict_unmeetable = evict_unmeetable
         self.completed: List[Request] = []
-        self._detok_q: pyqueue.Queue = pyqueue.Queue()
+        # bounded: if the detok worker falls behind the decode loop the
+        # backlog is visible (detok_backlog_peak, degradation pressure)
+        # instead of growing silently; a FULL queue back-pressures the
+        # decode loop as a last resort (put blocks)
+        self._detok_q: pyqueue.Queue = pyqueue.Queue(
+            maxsize=0 if detok_max is None else int(detok_max)
+        )
+        self.detok_backlog_peak = 0
+        self.evicted = 0
+        self.replays = 0
+        self._engine_crashes = 0
+        self._fatal: Optional[str] = None
+        self._tick_ewma: Optional[float] = None  # seconds per engine tick
+        B = engine.num_slots
+        self._degrade = (
+            DegradeController(
+                high=2 * B if degrade_high is None else degrade_high,
+                low=max(1.0, B / 2) if degrade_low is None else degrade_low,
+            )
+            if degrade else None
+        )
         self._decode_fn = None
         self._clip_fn = None
         if vae is not None:
@@ -86,12 +213,19 @@ class Scheduler:
                 # one bad request (corrupt codes, a decode bug, an
                 # on_result callback that throws) must not kill the worker
                 # thread — that would wedge every later request's result()
+                tier = self._degrade.tier if self._degrade is not None else 0
+                req.service_tier = tier
                 try:
-                    if self._decode_fn is not None and req.codes is not None:
+                    faults.on_detok()  # injected detok_fail (no-op off)
+                    if (
+                        tier < 2
+                        and self._decode_fn is not None
+                        and req.codes is not None
+                    ):
                         req.image = np.asarray(
                             self._decode_fn(req.codes[None])
                         )[0]
-                        if self._clip_fn is not None:
+                        if tier < 1 and self._clip_fn is not None:
                             score = self._clip_fn(
                                 np.asarray(req.text_tokens, np.int32)[None],
                                 req.image[None],
@@ -140,70 +274,186 @@ class Scheduler:
                 and r.arrival_time is not None
                 and now > r.arrival_time + r.deadline_s
             ):
-                r.dropped = True
+                r._fail("dropped: deadline expired before admission")
                 self.completed.append(r)
-                r._done.set()
             else:
                 keep.append(r)
         return keep
 
+    def _evict_unmeetable_slots(self):
+        """Mid-flight eviction: a slot whose remaining decode time
+        provably exceeds its deadline is freed for admittable work.
+
+        'Provably' is conservative: an ALREADY-missed deadline always
+        evicts; a projected miss (remaining ticks x the measured per-tick
+        EWMA) evicts only when queued work is waiting for the slot."""
+        if not self.evict_unmeetable:
+            return
+        eng = self.engine
+        now = time.monotonic()
+        for b in range(eng.num_slots):
+            req = eng.slot_req[b]
+            if req is None or req.deadline_s is None:
+                continue
+            dl = req.deadline_abs()
+            rem = eng.remaining_ticks(b) or 0
+            missed = now > dl
+            projected_miss = (
+                self._tick_ewma is not None
+                and now + rem * self._tick_ewma > dl
+            )
+            if missed or (projected_miss and self.queue.pending() > 0):
+                eng.evict(b)
+                req._fail(
+                    f"evicted mid-flight: deadline {req.deadline_s}s "
+                    f"unmeetable ({rem} ticks remaining at "
+                    f"~{(self._tick_ewma or 0.0):.4f}s/tick)"
+                )
+                self.completed.append(req)
+                self.evicted += 1
+                log_event(
+                    "serve_evicted", request_id=req.request_id,
+                    deadline_s=req.deadline_s, remaining_ticks=rem,
+                    already_missed=missed,
+                )
+
+    # --- supervisor ------------------------------------------------------
+    def _recover(self, exc: BaseException) -> bool:
+        """Engine crash mid-flight: rebuild the engine and replay, or —
+        past the restart/retry budgets — fail fast.  Returns True when
+        serving can continue."""
+        eng = self.engine
+        self._engine_crashes += 1
+        in_flight = eng.in_flight()
+        log_event(
+            "engine_crash", error=f"{type(exc).__name__}: {exc}",
+            crash=self._engine_crashes,
+            in_flight=[r.request_id for r in in_flight],
+        )
+        if self._engine_crashes > self.max_engine_restarts:
+            self._fatal = f"{type(exc).__name__}: {exc}"
+            return False  # run() re-raises; the finally fails everyone
+        # fresh EngineState, same compiled fns — then deterministic
+        # replay: decode restarts from the (text, seed, sampling) tuple,
+        # so a replayed request's codes are bitwise what an uninterrupted
+        # run produces (the RNG ladder depends only on the seed)
+        eng.reset()
+        replayed, failed = [], []
+        for r in in_flight:
+            r.retries += 1
+            if r.retries > self.max_request_retries:
+                r._fail(
+                    f"engine crashed {r.retries}x during decode "
+                    f"(retry budget {self.max_request_retries}): {exc}"
+                )
+                self.completed.append(r)
+                failed.append(r.request_id)
+            else:
+                r.codes = None
+                r.finish_time = None
+                r.admit_time = None
+                replayed.append(r)
+        self.queue.requeue(replayed)
+        self.replays += len(replayed)
+        log_event(
+            "engine_restart", crash=self._engine_crashes,
+            replayed=[r.request_id for r in replayed], failed=failed,
+        )
+        return True
+
+    def _fail_unfinished(self):
+        """Exit-path guarantee: no admitted-but-unfinished or
+        still-queued request may hang a ``result()`` waiter."""
+        reason = (
+            f"scheduler exited before this request completed"
+            + (f" (engine: {self._fatal})" if self._fatal else "")
+        )
+        eng = self.engine
+        for b in range(eng.num_slots):
+            req = eng.slot_req[b]
+            eng.slot_req[b] = None
+            eng._slot_done[b] = None
+            if req is not None and not req._done.is_set():
+                req._fail(reason)
+                self.completed.append(req)
+        for req in self.queue.drain():
+            if not req._done.is_set():
+                req._fail(reason)
+                self.completed.append(req)
+
     # --- main loop -------------------------------------------------------
+    def _serve_tick(self) -> bool:
+        """One admission+decode iteration; True when fully drained."""
+        eng = self.engine
+        self._evict_unmeetable_slots()
+        want = self._want(len(eng.free_slots()))
+        if want:
+            reqs = self._drop_expired(self.queue.pop(want))
+            if reqs:
+                eng.admit(reqs)
+        drained = False
+        if eng.num_active:
+            t0 = time.monotonic()
+            done = eng.step()
+            dt = time.monotonic() - t0
+            self._tick_ewma = (
+                dt if self._tick_ewma is None
+                else 0.8 * self._tick_ewma + 0.2 * dt
+            )
+            for req in done:
+                self.completed.append(req)
+                self._detok_q.put(req)
+        elif self.queue.closed and self.queue.pending() == 0:
+            drained = True
+        else:
+            self.queue.wait(timeout=self.idle_wait)
+        backlog = self._detok_q.qsize()
+        self.detok_backlog_peak = max(self.detok_backlog_peak, backlog)
+        if self._degrade is not None:
+            self._degrade.update(self.queue.pending() + backlog)
+        return drained
+
     def run(self) -> dict:
         """Serve until the queue is closed AND drained AND all slots are
-        idle.  Returns `stats()`."""
+        idle.  Returns `stats()`.  Never orphans a request: every exit
+        path (including a re-raised engine crash) releases all pending
+        ``result()`` waiters, with ``error`` set on the unfinished."""
         worker = threading.Thread(target=self._detok_loop, daemon=True)
         worker.start()
-        eng = self.engine
         try:
             while True:
-                want = self._want(len(eng.free_slots()))
-                if want:
-                    reqs = self._drop_expired(self.queue.pop(want))
-                    if reqs:
-                        eng.admit(reqs)
-                if eng.num_active:
-                    for req in eng.step():
-                        self.completed.append(req)
-                        self._detok_q.put(req)
-                elif self.queue.closed and self.queue.pending() == 0:
-                    return self.stats()
-                else:
-                    self.queue.wait(timeout=self.idle_wait)
+                try:
+                    if self._serve_tick():
+                        return self.stats()
+                except Exception as e:
+                    if not self._recover(e):
+                        raise
         finally:
             self._detok_q.put(None)
             worker.join()
+            self._fail_unfinished()
 
     # --- metrics ---------------------------------------------------------
     def stats(self) -> dict:
-        S = self.engine.S
-        served = [r for r in self.completed if not r.dropped]
-        dropped = len(self.completed) - len(served)
         out = {
             "policy": self.policy,
             "num_slots": self.engine.num_slots,
-            "served": len(served),
-            "dropped": dropped,
             "ticks": self.engine.tick_count,
-            "tokens": len(served) * S,
+            **request_stats(self.completed, self.engine.S),
         }
-        if not served:
-            out.update(makespan_s=0.0, tokens_per_s=0.0,
-                       ttlt_p50_s=None, ttlt_p99_s=None)
-            return out
-        t0 = min(r.arrival_time for r in served)
-        t1 = max(r.finish_time for r in served)
-        makespan = max(t1 - t0, 1e-9)
-        tt = sorted(r.ttlt for r in served)
-
-        def pct(p):
-            i = min(len(tt) - 1, int(round(p / 100.0 * (len(tt) - 1))))
-            return tt[i]
-
         out.update(
-            makespan_s=makespan,
-            tokens_per_s=out["tokens"] / makespan,
-            ttlt_p50_s=pct(50),
-            ttlt_p99_s=pct(99),
+            shed=len(self.queue.shed),
+            max_pending_seen=self.queue.max_pending_seen,
+            evicted_midflight=self.evicted,
+            engine_restarts=self._engine_crashes,
+            replays=self.replays,
+            detok_backlog_peak=self.detok_backlog_peak,
+            degrade_tier=(
+                self._degrade.tier if self._degrade is not None else 0
+            ),
+            degrade_transitions=(
+                self._degrade.transitions if self._degrade is not None else 0
+            ),
         )
         return out
 
@@ -290,6 +540,9 @@ def replay_trace(
     vae_params=None,
     clip=None,
     clip_params=None,
+    max_pending: Optional[int] = None,
+    shed_policy: str = "reject",
+    **scheduler_kwargs,
 ) -> dict:
     """Replay a recorded arrival trace against a fresh engine.
 
@@ -297,17 +550,19 @@ def replay_trace(
     by ``time_scale``); the scheduler serves until the trace drains.  The
     engine is warmed up first so XLA compile time never lands in the
     latency numbers.  ``sequential`` forces a single-slot engine
-    (batch-of-1 by construction)."""
+    (batch-of-1 by construction).  ``max_pending``/``shed_policy`` bound
+    the queue (overload experiments); extra keyword arguments reach the
+    :class:`Scheduler` (degradation, restart budgets, ...)."""
     B = 1 if policy == "sequential" else num_slots
     engine = DecodeEngine(
         model, params, num_slots=B, filter_thres=filter_thres,
         use_top_p=any(it.top_p is not None for it in trace),
     )
     engine.warmup()
-    q = RequestQueue()
+    q = RequestQueue(max_pending=max_pending, shed_policy=shed_policy)
     sched = Scheduler(
         engine, q, policy=policy, vae=vae, vae_params=vae_params,
-        clip=clip, clip_params=clip_params,
+        clip=clip, clip_params=clip_params, **scheduler_kwargs,
     )
 
     def feeder():

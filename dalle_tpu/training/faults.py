@@ -21,6 +21,21 @@ Spec grammar — comma-separated events::
     loader_stall@5:2.5  the data loader sleeps 2.5 s before producing
                         batch 5 (exercises the data watchdog)
 
+Serving-side events (tools/serving_chaos.py, docs/SERVING.md):
+
+    tick_fail@4         the 4th engine decode tick (process-wide,
+                        1-based) raises RuntimeError before dispatch —
+                        an engine/device crash mid-flight
+    detok_fail@2        the 2nd detok-worker job raises RuntimeError
+                        (VAE decode failure on one request)
+    slow_tick@3:0.2     the 3rd engine tick sleeps 0.2 s first (a slow
+                        device step; exercises deadline eviction)
+    slow_tick@1-8:0.2   same, for every tick in the 1..8 range (ranges
+                        as in ckpt_fail)
+    flood@0.5:32        0.5 s into the serve run, burst-submit 32 extra
+                        requests (consumed by the chaos harness feeder
+                        via :func:`flood_events` — overload exercise)
+
 Zero overhead when off: every hook first checks a module bool that is
 False unless a schedule was configured — one attribute load per call,
 no device work ever.
@@ -31,7 +46,7 @@ from __future__ import annotations
 import os
 import signal
 import time
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 _ENV = "DALLE_FAULTS"
 
@@ -50,6 +65,11 @@ class FaultPlan:
         self.ckpt_fail_attempts: Set[int] = set()  # 1-based write attempts
         self.ckpt_delay_s: float = 0.0
         self.loader_stalls: Dict[int, float] = {}  # batch index -> seconds
+        # serving-side (all tick/detok counters process-wide, 1-based)
+        self.tick_fails: Set[int] = set()
+        self.detok_fails: Set[int] = set()
+        self.slow_ticks: Dict[int, float] = {}  # tick -> seconds
+        self.floods: List[Tuple[float, int]] = []  # (offset_s, n_requests)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -75,6 +95,22 @@ class FaultPlan:
             elif name == "loader_stall":
                 batch, _, secs = arg.partition(":")
                 plan.loader_stalls[int(batch)] = float(secs) if secs else 1.0
+            elif name == "tick_fail":
+                plan.tick_fails.add(int(arg))
+            elif name == "detok_fail":
+                plan.detok_fails.add(int(arg))
+            elif name == "slow_tick":
+                tick, _, secs = arg.partition(":")
+                dur = float(secs) if secs else 1.0
+                if "-" in tick:
+                    lo, hi = tick.split("-")
+                    for t in range(int(lo), int(hi) + 1):
+                        plan.slow_ticks[t] = dur
+                else:
+                    plan.slow_ticks[int(tick)] = dur
+            elif name == "flood":
+                offset, _, n = arg.partition(":")
+                plan.floods.append((float(offset), int(n) if n else 1))
             else:
                 raise ValueError(f"unknown fault event {tok!r} in {spec!r}")
         return plan
@@ -84,22 +120,27 @@ _active = False
 _plan: Optional[FaultPlan] = None
 _parsed = False
 _ckpt_attempts = 0
+_engine_ticks = 0
+_detok_jobs = 0
 
 
 def configure(spec: Optional[str]) -> Optional[FaultPlan]:
     """Install a fault schedule (None/"" clears it).  Resets counters."""
-    global _active, _plan, _parsed, _ckpt_attempts
+    global _active, _plan, _parsed, _ckpt_attempts, _engine_ticks, _detok_jobs
     _plan = FaultPlan.parse(spec) if spec else None
     _active = _plan is not None
     _parsed = True
     _ckpt_attempts = 0
+    _engine_ticks = 0
+    _detok_jobs = 0
     return _plan
 
 
 def reset():
     """Forget everything, including the cached env parse (tests)."""
-    global _active, _plan, _parsed, _ckpt_attempts
+    global _active, _plan, _parsed, _ckpt_attempts, _engine_ticks, _detok_jobs
     _active, _plan, _parsed, _ckpt_attempts = False, None, False, 0
+    _engine_ticks = _detok_jobs = 0
 
 
 def plan() -> Optional[FaultPlan]:
@@ -165,3 +206,42 @@ def loader_stall(batch_index: int) -> None:
     secs = _plan.loader_stalls.get(batch_index)
     if secs:
         time.sleep(secs)
+
+
+def on_engine_tick() -> None:
+    """Called at the top of every ``DecodeEngine.step`` (process-wide
+    1-based counter, so an engine rebuilt after a crash does NOT replay
+    the fault).  ``slow_tick`` sleeps first, then ``tick_fail`` raises —
+    before any device dispatch, so the engine state is untouched."""
+    if not active():
+        return
+    global _engine_ticks
+    _engine_ticks += 1
+    secs = _plan.slow_ticks.get(_engine_ticks)
+    if secs:
+        time.sleep(secs)
+    if _engine_ticks in _plan.tick_fails:
+        raise RuntimeError(
+            f"injected engine tick failure (tick {_engine_ticks})"
+        )
+
+
+def on_detok() -> None:
+    """Called per detok-worker job (process-wide 1-based): raises the
+    injected VAE-decode failure on scheduled jobs."""
+    if not active():
+        return
+    global _detok_jobs
+    _detok_jobs += 1
+    if _detok_jobs in _plan.detok_fails:
+        raise RuntimeError(
+            f"injected detok failure (job {_detok_jobs})"
+        )
+
+
+def flood_events() -> List[Tuple[float, int]]:
+    """Scheduled ``flood@T:R`` bursts — (offset_s, n_requests) pairs for
+    a serve feeder (the chaos harness) to inject as overload traffic."""
+    if not active():
+        return []
+    return list(_plan.floods)

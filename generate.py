@@ -72,6 +72,25 @@ def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
     )
 
 
+def validate_serve_flags(args) -> list:
+    """Serve-mode flag validation (beyond argparse choices).  Returns a
+    list of error strings; ``main`` mirrors each into
+    ``<outputs_dir>/serve/errors.jsonl`` before exiting non-zero, so an
+    operator scripting the server finds misconfigurations in the same
+    structured stream as malformed requests."""
+    errors = []
+    if args.max_queue is not None and args.max_queue < 1:
+        errors.append(
+            f"--max_queue must be >= 1, got {args.max_queue}"
+        )
+    if args.shed_policy != "reject" and args.max_queue is None:
+        errors.append(
+            f"--shed_policy {args.shed_policy} requires --max_queue "
+            "(an unbounded queue never sheds)"
+        )
+    return errors
+
+
 def parse_args(argv=None):
     parser = argparse.ArgumentParser(description="Generate images from a trained DALL-E")
     parser.add_argument("--dalle_path", type=str, required=True)
@@ -93,6 +112,27 @@ def parse_args(argv=None):
                         choices=("continuous", "full_batch", "sequential"),
                         help="admission policy (sequential/full_batch exist "
                              "for comparison; continuous is the lever)")
+    # overload controls (docs/SERVING.md "Overload & failure semantics"):
+    # bounded admission + load shedding, and graceful degradation tiers
+    parser.add_argument("--max_queue", type=int, default=None,
+                        help="bound the pending-request queue at N; an "
+                             "over-bound submit sheds one request per "
+                             "--shed_policy with a structured error "
+                             "(default: unbounded)")
+    parser.add_argument("--shed_policy", type=str, default="reject",
+                        choices=("reject", "evict_oldest",
+                                 "evict_latest_deadline"),
+                        help="with --max_queue: which request to shed when "
+                             "the queue is full — the newcomer (reject), "
+                             "the longest-queued (evict_oldest), or the "
+                             "one with the most deadline slack "
+                             "(evict_latest_deadline)")
+    parser.add_argument("--degrade", action="store_true",
+                        help="under sustained queue pressure, drop to "
+                             "cheaper service tiers (skip CLIP rerank, "
+                             "then skip VAE detok — codes only) with "
+                             "hysteresis; serve_degraded/serve_restored "
+                             "events record every transition")
     parser.add_argument("--num_images", type=int, default=128)
     parser.add_argument("--batch_size", type=int, default=4)
     parser.add_argument("--top_k", type=float, default=0.9,
@@ -184,6 +224,20 @@ def main(argv=None):
             "--serve does not compose with --gentxt/--prime_image "
             "(per-request text only)"
         )
+        flag_errors = validate_serve_flags(args)
+        if flag_errors:
+            import json as _json
+            import sys as _sys
+
+            outdir = Path(args.outputs_dir) / "serve"
+            outdir.mkdir(parents=True, exist_ok=True)
+            with open(outdir / "errors.jsonl", "a") as f:
+                for msg in flag_errors:
+                    print(f"[serve] invalid flags: {msg}", file=_sys.stderr)
+                    f.write(_json.dumps(
+                        {"id": "cli", "error": msg}
+                    ) + "\n")
+            raise SystemExit(2)
     tokenizer = get_tokenizer(bpe_path=args.bpe_path, hug=args.hug, chinese=args.chinese)
 
     if args.dalle_path.endswith(".pt"):
@@ -439,22 +493,38 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
         print(f"[{req.request_id}] done: ttlt={req.ttlt:.3f}s{score}")
 
     try:
+        errors_path = outdir / "errors.jsonl"
+
+        def on_shed(req):
+            # load shedding is an OVERLOAD outcome, not a client fault —
+            # but it lands in the same structured stream so nothing is
+            # silently lost
+            with open(errors_path, "a") as f:
+                f.write(json.dumps(
+                    {"id": req.request_id, "error": req.error}
+                ) + "\n")
+            print(f"[{req.request_id}] shed: {req.error}")
+
         engine = DecodeEngine(
             model, params, num_slots=args.serve_slots,
             filter_thres=args.top_k, use_top_p=args.top_p is not None,
         )
         engine.warmup()
-        req_queue = RequestQueue()
+        req_queue = RequestQueue(
+            max_pending=args.max_queue, shed_policy=args.shed_policy,
+            on_shed=on_shed,
+        )
         sched = Scheduler(
             engine, req_queue, policy=args.serve_policy,
             vae=vae, vae_params=vae_params, clip=clip,
             clip_params=clip_params, on_result=on_result,
+            degrade=args.degrade,
         )
         print(f"serving: {args.serve_slots} slots, policy "
-              f"{args.serve_policy}, stream "
+              f"{args.serve_policy}, "
+              f"max_queue={args.max_queue or 'unbounded'} "
+              f"shed={args.shed_policy} degrade={args.degrade}, stream "
               f"{'stdin' if args.serve == '-' else args.serve}")
-
-        errors_path = outdir / "errors.jsonl"
 
         def reject(req_id, line_no, reason):
             # a malformed request is the CLIENT's fault — emit a structured

@@ -143,12 +143,59 @@ def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
     generate.main([
         "--dalle_path", dalle_out + "/dalle-final",
         "--serve", str(stream), "--serve_slots", "2",
+        "--max_queue", "8", "--shed_policy", "evict_latest_deadline",
+        "--degrade",
         "--outputs_dir", s_dir,
     ])
     served = sorted(p.name for p in (Path(s_dir) / "serve").glob("*.jpg"))
     assert served == ["a.jpg", "b.jpg", "c.jpg"]
+    assert not (Path(s_dir) / "serve" / "errors.jsonl").exists()
     img = Image.open(Path(s_dir) / "serve" / "a.jpg")
     assert img.size == (16, 16)
+
+
+def test_serve_flag_validation_errors(tmp_path):
+    """Bad overload-control flags fail fast (exit 2) BEFORE any
+    checkpoint load, and the message is mirrored into the serve
+    stream's errors.jsonl so a supervisor tailing it sees why."""
+    import json
+    from pathlib import Path
+
+    import generate
+
+    stream = tmp_path / "requests.jsonl"
+    stream.write_text(json.dumps({"text": "x", "id": "a"}) + "\n")
+    out = str(tmp_path / "out")
+
+    with pytest.raises(SystemExit) as exc:
+        generate.main([
+            "--dalle_path", str(tmp_path / "missing-ckpt"),
+            "--serve", str(stream),
+            "--max_queue", "0",
+            "--outputs_dir", out,
+        ])
+    assert exc.value.code == 2
+    recs = [
+        json.loads(l) for l in
+        (Path(out) / "serve" / "errors.jsonl").read_text().splitlines()
+    ]
+    assert recs and recs[0]["id"] == "cli"
+    assert "--max_queue must be >= 1" in recs[0]["error"]
+
+    # shed policies other than reject are meaningless without a bound
+    with pytest.raises(SystemExit) as exc:
+        generate.main([
+            "--dalle_path", str(tmp_path / "missing-ckpt"),
+            "--serve", str(stream),
+            "--shed_policy", "evict_oldest",
+            "--outputs_dir", out,
+        ])
+    assert exc.value.code == 2
+    recs = [
+        json.loads(l) for l in
+        (Path(out) / "serve" / "errors.jsonl").read_text().splitlines()
+    ]
+    assert any("requires --max_queue" in r["error"] for r in recs)
 
 
 def test_train_dalle_webdataset_cli(tmp_path):

@@ -36,6 +36,7 @@ from dalle_tpu.serving import (
     load_trace,
     make_poisson_trace,
     replay_trace,
+    request_stats,
     save_trace,
 )
 
@@ -422,3 +423,63 @@ def test_trace_roundtrip_and_replay(rng, tmp_path):
     )
     assert stats["served"] == 4 and stats["dropped"] == 0
     assert stats["tokens"] == 4 * N_IMG
+
+
+# --- request_stats percentile math (pinned on hand-built lists) --------
+
+
+def _done_req(arrival, finish, *, dropped=False, i=0):
+    r = Request(text_tokens=np.zeros(T, np.int32), request_id=f"s{i}")
+    r.arrival_time, r.finish_time, r.dropped = arrival, finish, dropped
+    return r
+
+
+def test_request_stats_pinned_values():
+    # 5 served requests with TTLTs 1..5s over a 9s makespan
+    completed = [
+        _done_req(float(i), float(i) + (i + 1.0), i=i) for i in range(5)
+    ]
+    s = request_stats(completed, image_seq_len=N_IMG)
+    assert s["served"] == 5 and s["dropped"] == 0
+    assert s["tokens"] == 5 * N_IMG
+    assert s["makespan_s"] == pytest.approx(9.0)  # min arrival 0, max finish 9
+    assert s["tokens_per_s"] == pytest.approx(5 * N_IMG / 9.0)
+    # sorted TTLTs [1,2,3,4,5]: p50 -> index round(.5*4)=2 -> 3.0,
+    # p99 -> index min(4, round(.99*4)) = 4 -> 5.0
+    assert s["ttlt_p50_s"] == pytest.approx(3.0)
+    assert s["ttlt_p99_s"] == pytest.approx(5.0)
+
+
+def test_request_stats_all_dropped():
+    completed = [
+        _done_req(0.0, None, dropped=True, i=i) for i in range(3)
+    ]
+    s = request_stats(completed, image_seq_len=N_IMG)
+    assert s == {
+        "served": 0, "dropped": 3, "tokens": 0,
+        "makespan_s": 0.0, "tokens_per_s": 0.0,
+        "ttlt_p50_s": None, "ttlt_p99_s": None,
+    }
+
+
+def test_request_stats_single_request():
+    s = request_stats([_done_req(2.0, 4.5)], image_seq_len=N_IMG)
+    assert s["served"] == 1 and s["dropped"] == 0
+    # both percentiles collapse to the one sample; makespan is clamped
+    # to the finish-arrival span of that sample
+    assert s["ttlt_p50_s"] == s["ttlt_p99_s"] == pytest.approx(2.5)
+    assert s["makespan_s"] == pytest.approx(2.5)
+    assert s["tokens_per_s"] == pytest.approx(N_IMG / 2.5)
+
+
+def test_request_stats_mixed_served_dropped():
+    completed = [
+        _done_req(0.0, 1.0, i=0),
+        _done_req(0.0, None, dropped=True, i=1),
+        _done_req(0.5, 2.0, i=2),
+    ]
+    s = request_stats(completed, image_seq_len=N_IMG)
+    assert s["served"] == 2 and s["dropped"] == 1
+    assert s["tokens"] == 2 * N_IMG
+    assert s["ttlt_p50_s"] == pytest.approx(1.0)
+    assert s["ttlt_p99_s"] == pytest.approx(1.5)

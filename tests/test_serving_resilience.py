@@ -1,0 +1,416 @@
+"""Overload/crash serving tests (ISSUE 5, docs/SERVING.md "Overload &
+failure semantics").
+
+Covers, fast (tier-1):
+
+* bounded admission — every shed policy's victim choice, structured shed
+  errors, ``max_pending_seen`` accounting;
+* EDF pop order (deadline-free workloads still FIFO);
+* the orphaned-``result()`` fix — a dying scheduler fails every admitted
+  AND still-queued request, and ``result(raise_on_error=True)`` raises;
+* engine crash recovery — a ``tick_fail@2`` mid-flight crash recovers
+  with bitwise-identical greedy codes (the replay-determinism pin);
+* mid-flight eviction of provably-unmeetable deadlines;
+* DegradeController hysteresis + scheduler degradation tiers;
+* the extended serving fault grammar (tick_fail/detok_fail/slow_tick/
+  flood) and the detok backlog stat.
+
+Slow: the full serving chaos harness (tools/serving_chaos.py) end to end.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.generate import generate_image_codes
+from dalle_tpu.serving import (
+    SHED_POLICIES,
+    DecodeEngine,
+    DegradeController,
+    Request,
+    RequestError,
+    RequestQueue,
+    Scheduler,
+)
+from dalle_tpu.training import faults
+
+T, F = 4, 2
+N_IMG = F * F
+GREEDY = dict(temperature=1e-8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DALLE_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def build(rng):
+    cfg = DALLEConfig(
+        num_text_tokens=30, text_seq_len=T, num_image_tokens=20,
+        image_fmap_size=F, dim=32, depth=2, heads=2, dim_head=16,
+    )
+    text = jax.random.randint(rng, (3, T), 1, 30)
+    codes = jax.random.randint(rng, (3, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    return model, params, text
+
+
+def mk_req(i=0, deadline_s=None, arrival=None):
+    r = Request(
+        text_tokens=np.full(T, 1 + i, np.int32), seed=i,
+        request_id=f"r{i}", deadline_s=deadline_s, **GREEDY,
+    )
+    if arrival is not None:
+        r.arrival_time = arrival
+    return r
+
+
+# --- bounded admission / shed policies ---------------------------------
+
+
+def test_reject_policy_sheds_newcomer():
+    shed_cb = []
+    q = RequestQueue(max_pending=2, shed_policy="reject",
+                     on_shed=shed_cb.append)
+    a, b, c = mk_req(0), mk_req(1), mk_req(2)
+    q.submit(a), q.submit(b)
+    q.submit(c)
+    assert c.dropped and c._done.is_set()
+    assert "shed: queue full" in c.error
+    assert "policy=reject" in c.error
+    assert shed_cb == [c] and q.shed == [c]
+    assert q.pending() == 2 and q.max_pending_seen == 2
+    # the shed newcomer's result() returns immediately and can raise
+    with pytest.raises(RequestError, match="queue full"):
+        c.result(timeout=0, raise_on_error=True)
+    assert [r.request_id for r in q.pop(10)] == ["r0", "r1"]
+
+
+def test_evict_oldest_policy_sheds_head():
+    q = RequestQueue(max_pending=2, shed_policy="evict_oldest")
+    a, b, c = mk_req(0), mk_req(1), mk_req(2)
+    q.submit(a), q.submit(b), q.submit(c)
+    assert a.dropped and a._done.is_set() and "queue full" in a.error
+    assert not c.dropped
+    assert [r.request_id for r in q.pop(10)] == ["r1", "r2"]
+
+
+def test_evict_latest_deadline_sheds_most_slack():
+    q = RequestQueue(max_pending=2, shed_policy="evict_latest_deadline")
+    tight = mk_req(0, deadline_s=0.5)
+    loose = mk_req(1, deadline_s=100.0)
+    mid = mk_req(2, deadline_s=5.0)
+    q.submit(tight), q.submit(loose)
+    q.submit(mid)  # loose has the most slack -> it is the victim
+    assert loose.dropped and not mid.dropped and not tight.dropped
+    assert [r.request_id for r in q.pop(10)] == ["r0", "r2"]
+    # a no-deadline request loses to any deadline-carrying one
+    q2 = RequestQueue(max_pending=1, shed_policy="evict_latest_deadline")
+    nodl = mk_req(3)
+    q2.submit(nodl)
+    q2.submit(mk_req(4, deadline_s=1.0))
+    assert nodl.dropped
+    assert q2.pop(10)[0].request_id == "r4"
+
+
+def test_shed_policies_exported_and_validated():
+    assert set(SHED_POLICIES) == {
+        "reject", "evict_oldest", "evict_latest_deadline"
+    }
+    with pytest.raises(AssertionError):
+        RequestQueue(max_pending=2, shed_policy="nope")
+    with pytest.raises(AssertionError):
+        RequestQueue(max_pending=0)
+
+
+def test_requeue_never_sheds_and_goes_to_front():
+    q = RequestQueue(max_pending=1, shed_policy="reject")
+    q.submit(mk_req(0))
+    replay = [mk_req(8), mk_req(9)]
+    for r in replay:
+        r.arrival_time = time.monotonic()
+    q.requeue(replay)  # over the bound on purpose: replays must survive
+    assert q.pending() == 3
+    assert [r.request_id for r in q.pop(10)] == ["r8", "r9", "r0"]
+    assert all(r.error is None for r in replay)
+
+
+# --- EDF pop order -----------------------------------------------------
+
+
+def test_pop_is_earliest_deadline_first():
+    q = RequestQueue()
+    now = time.monotonic()
+    late = mk_req(0, deadline_s=50.0, arrival=now)
+    none_ = mk_req(1, arrival=now)  # no deadline -> last
+    soon = mk_req(2, deadline_s=1.0, arrival=now)
+    for r in (late, none_, soon):
+        q.submit(r)
+    assert [r.request_id for r in q.pop(2)] == ["r2", "r0"]
+    assert [r.request_id for r in q.pop(10)] == ["r1"]
+
+
+def test_pop_without_deadlines_stays_fifo():
+    q = RequestQueue()
+    for i in range(4):
+        q.submit(mk_req(i))
+    assert [r.request_id for r in q.pop(10)] == ["r0", "r1", "r2", "r3"]
+
+
+# --- orphaned result() fix ---------------------------------------------
+
+
+def test_scheduler_crash_fails_all_requests_no_hang(rng):
+    """Restart budget 0: run() re-raises AND every request — in flight
+    or still queued — completes with a structured error."""
+    model, params, _ = build(rng)
+    eng = DecodeEngine(model, params, num_slots=2)
+    eng.warmup()
+    q = RequestQueue()
+    reqs = [mk_req(i) for i in range(5)]  # 2 in flight + 3 queued
+    for r in reqs:
+        q.submit(r)
+    q.close()
+    faults.configure("tick_fail@2")
+    sched = Scheduler(eng, q, max_engine_restarts=0)
+    with pytest.raises(RuntimeError, match="injected engine tick"):
+        sched.run()
+    for r in reqs:
+        assert r._done.is_set(), f"{r.request_id} hung"
+        assert r.error is not None and "scheduler exited" in r.error
+        with pytest.raises(RequestError):
+            r.result(timeout=0, raise_on_error=True)
+    # waiters blocked in result() were released, not timed out
+    t0 = time.monotonic()
+    reqs[-1].result(timeout=5.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+# --- engine crash recovery (the fast tier-1 pin) -----------------------
+
+
+def test_tick_fail_recovery_replays_bitwise(rng):
+    model, params, text = build(rng)
+    n = 3
+    solo = [
+        np.asarray(
+            generate_image_codes(
+                model, params, np.asarray(text[i % 3])[None],
+                jax.random.PRNGKey(i), filter_thres=0.0,
+                temperature=GREEDY["temperature"],
+            )
+        )[0]
+        for i in range(n)
+    ]
+
+    faults.configure("tick_fail@2")  # crash on the 2nd engine tick ever
+    eng = DecodeEngine(model, params, num_slots=2, filter_thres=0.0)
+    eng.warmup()  # warmup calls _tick_fn directly: no fault consumed
+    q = RequestQueue()
+    reqs = [
+        Request(text_tokens=np.asarray(text[i % 3]), seed=i,
+                request_id=f"r{i}", **GREEDY)
+        for i in range(n)
+    ]
+    for r in reqs:
+        q.submit(r)
+    q.close()
+    sched = Scheduler(eng, q, max_engine_restarts=2, max_request_retries=1)
+    stats = sched.run()
+
+    assert stats["engine_restarts"] == 1
+    assert stats["replays"] == 2  # both in-flight slots replayed
+    assert stats["served"] == n and stats["dropped"] == 0
+    for i, r in enumerate(reqs):
+        assert r.error is None and r._done.is_set()
+        assert r.retries == (1 if i < 2 else 0)
+        np.testing.assert_array_equal(np.asarray(r.codes), solo[i])
+
+
+def test_retry_budget_exhausted_fails_request_only(rng):
+    """Crashes beyond max_request_retries fail the REQUEST (structured
+    error), not the whole scheduler."""
+    model, params, _ = build(rng)
+    faults.configure("tick_fail@2,tick_fail@3")
+    eng = DecodeEngine(model, params, num_slots=1)
+    eng.warmup()
+    q = RequestQueue()
+    r = mk_req(0)
+    q.submit(r)
+    q.close()
+    sched = Scheduler(eng, q, max_engine_restarts=5, max_request_retries=1)
+    stats = sched.run()
+    assert stats["engine_restarts"] == 2
+    assert r._done.is_set() and "retry budget" in r.error
+    assert stats["served"] == 0 and stats["dropped"] == 1
+
+
+# --- mid-flight eviction -----------------------------------------------
+
+
+def test_unmeetable_deadline_evicted_midflight(rng):
+    model, params, _ = build(rng)
+    faults.configure(f"slow_tick@1-{4 * N_IMG}:0.05")
+    eng = DecodeEngine(model, params, num_slots=1)
+    eng.warmup()
+    q = RequestQueue()
+    doomed = mk_req(0, deadline_s=0.12)  # ~N_IMG*0.05s needed: unmeetable
+    live = mk_req(1)  # queued behind it, no deadline
+    q.submit(doomed), q.submit(live)
+    q.close()
+    sched = Scheduler(eng, q, evict_unmeetable=True)
+    stats = sched.run()
+    assert doomed._done.is_set() and "evicted mid-flight" in doomed.error
+    assert live.error is None and live.codes is not None
+    assert stats["evicted_midflight"] == 1
+    assert stats["served"] == 1 and stats["dropped"] == 1
+
+
+# --- graceful degradation ----------------------------------------------
+
+
+def test_degrade_controller_hysteresis():
+    dc = DegradeController(high=4.0, low=1.0, alpha=1.0)  # no smoothing
+    assert dc.update(2.0) == 0  # inside the band: hold
+    assert dc.update(5.0) == 1  # above high: one tier per update
+    assert dc.update(5.0) == 2
+    assert dc.update(5.0) == 2  # already at the last tier
+    assert dc.update(2.0) == 2  # inside the band: hold (hysteresis)
+    assert dc.update(0.5) == 1  # below low: relax one tier
+    assert dc.update(0.5) == 0
+    assert dc.transitions == 4
+    assert DegradeController.TIERS == ("full", "skip_clip", "codes_only")
+    with pytest.raises(AssertionError):
+        DegradeController(high=1.0, low=2.0)
+
+
+def test_scheduler_degrades_to_codes_only_under_pressure(rng):
+    model, params, _ = build(rng)
+    eng = DecodeEngine(model, params, num_slots=1)
+    eng.warmup()
+    q = RequestQueue()
+    reqs = [mk_req(i) for i in range(6)]
+    for r in reqs:
+        q.submit(r)  # burst: pending starts at 6 >> high threshold
+    q.close()
+    sched = Scheduler(eng, q, degrade=True, degrade_high=0.5,
+                      degrade_low=0.1)
+    calls = {"vae": 0, "clip": 0}
+
+    def fake_decode(codes):
+        calls["vae"] += 1
+        return np.zeros((1, 2 * F, 2 * F, 3), np.float32)
+
+    def fake_clip(text, img):
+        calls["clip"] += 1
+        return np.zeros((1,), np.float32)
+
+    sched._decode_fn = fake_decode
+    sched._clip_fn = fake_clip
+    stats = sched.run()
+    assert stats["degrade_tier"] >= 1  # may have relaxed as load drained
+    assert stats["degrade_transitions"] >= 2
+    tiers = {r.service_tier for r in reqs}
+    assert 2 in tiers  # later requests served codes-only
+    for r in reqs:
+        assert r.codes is not None and r.error is None
+        if r.service_tier >= 2:
+            assert r.image is None
+        if r.service_tier >= 1:
+            assert r.clip_score is None
+
+
+# --- serving fault grammar ---------------------------------------------
+
+
+def test_serving_fault_grammar_parse():
+    p = faults.FaultPlan.parse(
+        "tick_fail@4,detok_fail@2,slow_tick@3:0.25,slow_tick@5,"
+        "flood@0.5:32,flood@1.25:8"
+    )
+    assert p.tick_fails == {4}
+    assert p.detok_fails == {2}
+    assert p.slow_ticks == {3: 0.25, 5: 1.0}  # bare slow_tick: 1 s
+    ranged = faults.FaultPlan.parse("slow_tick@2-4:0.1")
+    assert ranged.slow_ticks == {2: 0.1, 3: 0.1, 4: 0.1}
+    assert p.floods == [(0.5, 32), (1.25, 8)]
+
+
+def test_tick_fail_counter_is_process_wide():
+    """tick_fail@N counts engine ticks across rebuilds: a recovered
+    engine must not replay an already-fired fault."""
+    faults.configure("tick_fail@2")
+    faults.on_engine_tick()  # tick 1: fine
+    with pytest.raises(RuntimeError, match="injected engine tick"):
+        faults.on_engine_tick()  # tick 2: scheduled failure
+    faults.on_engine_tick()  # tick 3 (post-"rebuild"): fine again
+    faults.reset()
+    faults.configure(None)
+    for _ in range(5):
+        faults.on_engine_tick()  # off -> inert
+
+
+def test_detok_fail_fails_request_not_worker(rng):
+    model, params, _ = build(rng)
+    faults.configure("detok_fail@1")
+    eng = DecodeEngine(model, params, num_slots=1)
+    eng.warmup()
+    q = RequestQueue()
+    a, b = mk_req(0), mk_req(1)
+    q.submit(a), q.submit(b)
+    q.close()
+    stats = Scheduler(eng, q).run()
+    assert a._done.is_set() and "injected detok failure" in a.error
+    assert b.error is None and b.codes is not None
+    assert stats["served"] == 2  # detok failure completes the request
+
+
+def test_flood_events_exposed_for_feeders():
+    faults.configure("flood@0.1:16")
+    assert faults.flood_events() == [(0.1, 16)]
+    faults.configure(None)
+    assert faults.flood_events() == []
+
+
+# --- detok backlog stat ------------------------------------------------
+
+
+def test_detok_backlog_stat_visible(rng):
+    model, params, _ = build(rng)
+    eng = DecodeEngine(model, params, num_slots=2)
+    eng.warmup()
+    q = RequestQueue()
+    gate = threading.Event()
+    reqs = [mk_req(i) for i in range(4)]
+    for r in reqs:
+        q.submit(r)
+    q.close()
+    sched = Scheduler(eng, q, detok_max=8,
+                      on_result=lambda r: gate.wait(0.02))
+    stats = sched.run()
+    assert sched._detok_q.maxsize == 8
+    assert stats["detok_backlog_peak"] >= 1
+    assert all(r._done.is_set() for r in reqs)
+
+
+# --- the full chaos harness (slow) -------------------------------------
+
+
+@pytest.mark.slow
+def test_serving_chaos_end_to_end():
+    from tools.serving_chaos import run_serving_chaos
+
+    verdict = run_serving_chaos()
+    assert verdict["crash_replay"]["ok"], verdict["crash_replay"]
+    assert verdict["fail_fast"]["ok"], verdict["fail_fast"]
+    assert verdict["flood"]["ok"], verdict["flood"]
+    assert verdict["ok"]

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Serving chaos harness: prove the serving stack is overload-safe and
+crash-tolerant (docs/SERVING.md "Overload & failure semantics").
+
+Three scenarios against the continuous-batching engine (tiny
+randomly-initialized model — the properties under test are host-side
+protocol guarantees, not model quality):
+
+1. **crash_replay** — a ``tick_fail@N`` engine crash mid-flight with
+   recovery on: every request's ``result()`` returns (zero hangs), no
+   request carries an error, and the replayed greedy requests' codes are
+   **bitwise identical** to an uninterrupted baseline run.
+2. **fail_fast** — the same crash with the restart budget at zero: the
+   scheduler re-raises, and every request still completes with a
+   structured error (the orphaned-``result()`` hang is fixed
+   independently of recovery).
+3. **flood** — a 10x overload burst (the ``flood@T:R`` fault grammar)
+   against a bounded queue: pending never exceeds ``max_pending``, the
+   excess is shed with structured errors, and the p99 TTLT of *admitted*
+   requests stays within ``p99_gate`` (2x) of the unflooded baseline.
+
+Run directly (``python tools/serving_chaos.py``), as the
+``serving_resilience`` bench rung, or via
+``tests/test_serving_resilience.py`` (slow-marked e2e + fast unit pins).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GREEDY = dict(temperature=1e-8, filter_thres=0.0)
+
+
+def _quick_model(seed=0):
+    from tools.serving_bench import _quick_model as qm
+
+    return qm(seed)
+
+
+def _mk_requests(cfg, n, *, seed0=100):
+    import numpy as np
+
+    from dalle_tpu.serving import Request
+
+    rng = np.random.RandomState(7)
+    texts = rng.randint(1, cfg.num_text_tokens, size=(n, cfg.text_seq_len))
+    return [
+        Request(
+            text_tokens=texts[i].astype(np.int32), seed=seed0 + i,
+            temperature=GREEDY["temperature"], request_id=f"c{i}",
+        )
+        for i in range(n)
+    ]
+
+
+def _serve(model, params, reqs, **sched_kw):
+    """Submit ``reqs`` as a burst, serve until drained, return stats."""
+    from dalle_tpu.serving import DecodeEngine, RequestQueue, Scheduler
+
+    engine = DecodeEngine(
+        model, params, num_slots=sched_kw.pop("num_slots", 3),
+        filter_thres=GREEDY["filter_thres"],
+    )
+    engine.warmup()
+    q = RequestQueue(
+        max_pending=sched_kw.pop("max_pending", None),
+        shed_policy=sched_kw.pop("shed_policy", "reject"),
+    )
+    for r in reqs:
+        q.submit(r)
+    q.close()
+    sched = Scheduler(engine, q, policy="continuous", **sched_kw)
+    return sched.run()
+
+
+def scenario_crash_replay(model, params, *, slots=3, n_req=6) -> dict:
+    """tick_fail mid-flight + recovery: zero hangs, bitwise replay."""
+    import numpy as np
+
+    from dalle_tpu.training import faults
+
+    cfg = model.cfg
+    baseline = _mk_requests(cfg, n_req)
+    faults.reset()
+    _serve(model, params, baseline, num_slots=slots)
+    assert all(r._done.is_set() and r.error is None for r in baseline)
+
+    # crash mid-first-wave: every slot is in flight at the failing tick
+    fail_tick = cfg.image_seq_len // 2
+    faults.configure(f"tick_fail@{fail_tick}")
+    try:
+        faulted = _mk_requests(cfg, n_req)
+        stats = _serve(model, params, faulted, num_slots=slots,
+                       max_engine_restarts=2, max_request_retries=1)
+    finally:
+        faults.reset()
+
+    hangs = [r.request_id for r in faulted if not r._done.is_set()]
+    errors = {r.request_id: r.error for r in faulted if r.error is not None}
+    mismatches = [
+        r.request_id
+        for r, b in zip(faulted, baseline)
+        if r.codes is None or not np.array_equal(r.codes, b.codes)
+    ]
+    ok = (not hangs and not errors and not mismatches
+          and stats["engine_restarts"] == 1 and stats["replays"] == slots)
+    return {
+        "ok": ok,
+        "fail_tick": fail_tick,
+        "hangs": hangs,
+        "errors": errors,
+        "replay_mismatches": mismatches,
+        "engine_restarts": stats["engine_restarts"],
+        "replays": stats["replays"],
+        "served": stats["served"],
+    }
+
+
+def scenario_fail_fast(model, params, *, slots=3, n_req=5) -> dict:
+    """tick_fail with the restart budget at 0: the scheduler re-raises
+    and every request completes with an error — zero hangs."""
+    from dalle_tpu.training import faults
+
+    reqs = _mk_requests(model.cfg, n_req)
+    faults.configure("tick_fail@2")
+    raised = None
+    try:
+        _serve(model, params, reqs, num_slots=slots, max_engine_restarts=0)
+    except RuntimeError as e:
+        raised = str(e)
+    finally:
+        faults.reset()
+
+    hangs = [r.request_id for r in reqs if not r._done.is_set()]
+    unerrored = [
+        r.request_id for r in reqs if r.codes is None and r.error is None
+    ]
+    ok = raised is not None and not hangs and not unerrored
+    return {
+        "ok": ok,
+        "re_raised": raised,
+        "hangs": hangs,
+        "completed_without_error_or_codes": unerrored,
+    }
+
+
+def scenario_flood(model, params, *, slots=4, max_pending=2, n_base=8,
+                   flood_factor=10, p99_gate=2.0) -> dict:
+    """10x overload burst vs a bounded queue: shed, don't grow; admitted
+    p99 TTLT within ``p99_gate`` of the unflooded baseline."""
+    from dalle_tpu.serving import DecodeEngine, RequestQueue, Scheduler
+    from dalle_tpu.training import faults
+
+    cfg = model.cfg
+
+    def feed_and_run(*, max_pending, rate_hz, flood_events=()):
+        """A timed feeder (base Poisson-ish stream + scheduled flood
+        bursts) against a fresh bounded-queue scheduler."""
+        engine = DecodeEngine(
+            model, params, num_slots=slots,
+            filter_thres=GREEDY["filter_thres"],
+        )
+        engine.warmup()
+        q = RequestQueue(max_pending=max_pending, shed_policy="reject")
+        base = _mk_requests(cfg, n_base)
+        floods = []
+
+        def feeder():
+            t0 = time.monotonic()
+            bursts = sorted(flood_events)
+            bi = 0
+            for i, r in enumerate(base):
+                target = t0 + i / rate_hz
+                while bi < len(bursts) and bursts[bi][0] + t0 <= target:
+                    off, count = bursts[bi]
+                    wait = t0 + off - time.monotonic()
+                    if wait > 0:
+                        time.sleep(wait)
+                    burst = _mk_requests(cfg, count, seed0=10_000)
+                    floods.extend(burst)
+                    for fr in burst:
+                        q.submit(fr)
+                    bi += 1
+                wait = target - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+                q.submit(r)
+            while bi < len(bursts):
+                off, count = bursts[bi]
+                wait = t0 + off - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+                burst = _mk_requests(cfg, count, seed0=10_000)
+                floods.extend(burst)
+                for fr in burst:
+                    q.submit(fr)
+                bi += 1
+            q.close()
+
+        sched = Scheduler(engine, q, policy="continuous")
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        stats = sched.run()
+        th.join()
+        return stats, base, floods, q
+
+    # calibrate: one solo request's decode time sets the light-load rate
+    solo = _mk_requests(cfg, 1)
+    _serve(model, params, solo, num_slots=slots)
+    service_s = max(solo[0].ttlt, 1e-3)
+
+    # baseline: light load (half a request per service time per slot-pool)
+    base_rate = 0.5 / service_s
+    base_stats, _, _, _ = feed_and_run(
+        max_pending=None, rate_hz=base_rate)
+    p99_base = base_stats["ttlt_p99_s"]
+
+    # flood: a burst of flood_factor x the pool's per-service capacity,
+    # delivered mid-run via the flood@T:R fault grammar
+    burst_n = flood_factor * slots
+    faults.configure(f"flood@{service_s * 0.5:.3f}:{burst_n}")
+    try:
+        flood_stats, base, floods, q = feed_and_run(
+            max_pending=max_pending, rate_hz=base_rate,
+            flood_events=faults.flood_events(),
+        )
+    finally:
+        faults.reset()
+
+    hangs = [r.request_id for r in base + floods if not r._done.is_set()]
+    p99_flood = flood_stats["ttlt_p99_s"]
+    ratio = (p99_flood / p99_base) if p99_base else None
+    ok = (
+        not hangs
+        and flood_stats["max_pending_seen"] <= max_pending
+        and flood_stats["shed"] > 0
+        and ratio is not None and ratio <= p99_gate
+    )
+    return {
+        "ok": ok,
+        "slots": slots,
+        "max_pending": max_pending,
+        "burst_n": burst_n,
+        "hangs": hangs,
+        "service_s": round(service_s, 4),
+        "baseline_p99_s": p99_base,
+        "flood_p99_s": p99_flood,
+        "p99_ratio": round(ratio, 3) if ratio is not None else None,
+        "p99_gate": p99_gate,
+        "max_pending_seen": flood_stats["max_pending_seen"],
+        "shed": flood_stats["shed"],
+        "served_under_flood": flood_stats["served"],
+    }
+
+
+def run_serving_chaos(*, slots=3, n_req=6, p99_gate=2.0) -> dict:
+    """All three scenarios; ``ok`` iff every gate holds."""
+    model, params = _quick_model()
+    crash = scenario_crash_replay(model, params, slots=slots, n_req=n_req)
+    fail_fast = scenario_fail_fast(model, params, slots=slots)
+    flood = scenario_flood(model, params, p99_gate=p99_gate)
+    return {
+        "ok": crash["ok"] and fail_fast["ok"] and flood["ok"],
+        "crash_replay": crash,
+        "fail_fast": fail_fast,
+        "flood": flood,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="overload/crash chaos scenarios for the serving stack"
+    )
+    ap.add_argument("--slots", type=int, default=3)
+    ap.add_argument("--n_req", type=int, default=6)
+    ap.add_argument("--p99_gate", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    res = run_serving_chaos(
+        slots=args.slots, n_req=args.n_req, p99_gate=args.p99_gate,
+    )
+    print(json.dumps(res, indent=2))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
