@@ -92,6 +92,13 @@ PHASES = [
     # (dalle_tpu/serving/) — gates continuous >= 2x sequential tokens/s
     # and full-batch p99 TTLT strictly worse than continuous
     ("serving_throughput", 900, True),
+    # decode-tick evidence: tokens/s at FIXED slots for baseline vs
+    # --fused_decode vs --fused_decode --kv_int8 on the serving trace
+    # (the Pallas decode-attention kernel, ops/flash.py
+    # flash_decode_attention).  On TPU gates fused+kv_int8 >= 1.5x
+    # baseline tokens/s; off-chip gates bitwise decode parity + the
+    # analytic >=40% attention wire-byte cut per tick
+    ("decode_speed", 900, True),
     # extra-credit final rung: real LEARNING on the bench device — the
     # reference's rainbow-notebook workflow (synthetic shapes -> VAE ->
     # DALLE -> generated-token accuracy, SURVEY.md §4.2) trained for real
@@ -1130,6 +1137,141 @@ def _serving_bench():
     return res
 
 
+def _decode_speed_bench():
+    """Fused decode tick evidence (ops/flash.py flash_decode_attention +
+    ops/sampling.py sort-free nucleus).
+
+    Replays one saturated burst trace (all requests at t=0, continuous
+    policy, FIXED slots) through three engine builds sharing one set of
+    params: baseline, --fused_decode, and --fused_decode --kv_int8.
+
+    Gates:
+      * on TPU: fused+kv_int8 tokens/s >= 1.5x baseline (the rung's
+        reason to exist — the kernel reads int8 cache rows + scales once
+        instead of round-tripping a dequantized cache copy);
+      * off-chip (CPU/interpret — kernel timing is meaningless): the
+        fused engine's greedy codes must be BITWISE the baseline's
+        (lax-fallback parity), and the analytic decode-tick attention
+        wire model (profiler.decode_tick_attn_bytes) must show >= 40%
+        fewer bytes for fused+kv_int8 vs baseline kv_int8.
+
+    The chosen decode-kernel block config (DALLE_TPU_DECODE_BLOCK_K/_H,
+    tools/flash_tune.py --kernel decode) is recorded either way.
+    """
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.quantize import fused_decode_model, kv_int8_model
+    from dalle_tpu.ops.flash import default_decode_block
+    from dalle_tpu.serving import make_poisson_trace, replay_trace
+    from dalle_tpu.training.profiler import decode_tick_attn_bytes
+
+    smoke = _smoke()
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = DALLEConfig(
+        num_text_tokens=64,
+        text_seq_len=16,
+        num_image_tokens=128,
+        image_fmap_size=8,
+        dim=32 if smoke else 128,
+        depth=2 if smoke else 4,
+        heads=2 if smoke else 4,
+        dim_head=16 if smoke else 32,
+    )
+    key = jax.random.PRNGKey(0)
+    base = DALLE(cfg)
+    text = jax.random.randint(
+        key, (2, cfg.text_seq_len), 1, cfg.num_text_tokens
+    )
+    codes = jax.random.randint(
+        key, (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = base.init({"params": key}, text, codes)["params"]
+    slots = 8
+    n_req = 16 if smoke else 32
+
+    # saturated burst: everything arrives at t=0, so the engine runs at
+    # full occupancy and tokens/s is pure decode-tick throughput
+    trace = make_poisson_trace(
+        n_req, 1e5, cfg.text_seq_len, cfg.num_text_tokens, seed=0
+    )
+    variants = {
+        "baseline": base,
+        "fused": fused_decode_model(base),
+        "fused_kv_int8": fused_decode_model(kv_int8_model(base)),
+    }
+    stats = {}
+    for name, model in variants.items():
+        st = replay_trace(model, params, trace, policy="continuous",
+                          num_slots=slots)
+        _hb(f"decode_speed[{name}]: {st['tokens_per_s']:.1f} tok/s")
+        stats[name] = st
+    ratio = stats["fused_kv_int8"]["tokens_per_s"] / max(
+        stats["baseline"]["tokens_per_s"], 1e-9
+    )
+
+    # analytic per-tick attention bytes (the off-chip proxy; recorded on
+    # TPU too as the model the measurement should track)
+    qcfg = dataclasses.replace(cfg, kv_int8=True)
+    bytes_base = decode_tick_attn_bytes(qcfg, slots, fused=False)
+    bytes_fused = decode_tick_attn_bytes(qcfg, slots, fused=True)
+    byte_cut = 1.0 - bytes_fused / bytes_base
+
+    res = {
+        "smoke": smoke,
+        "on_tpu": on_tpu,
+        "num_slots": slots,
+        "n_requests": n_req,
+        "image_seq_len": cfg.image_seq_len,
+        "tokens_per_s": {k: round(v["tokens_per_s"], 2)
+                         for k, v in stats.items()},
+        "fused_kv_int8_vs_baseline": round(ratio, 3),
+        "attn_bytes_per_tick": {"baseline_kv_int8": bytes_base,
+                                "fused_kv_int8": bytes_fused},
+        "attn_byte_reduction": round(byte_cut, 4),
+        "decode_block_k": default_decode_block("k"),
+        "decode_block_h": default_decode_block("h"),
+        "speed_gate": 1.5,
+        "byte_gate": 0.4,
+    }
+    if on_tpu:
+        if ratio < 1.5:
+            res["rung_failed"] = (
+                f"fused+kv_int8 {ratio:.2f}x baseline tokens/s (gate 1.5x)"
+            )
+        return res
+    # off-chip: bitwise parity of a greedy engine tick sequence stands in
+    # for speed (the fused path dispatches its lax fallback here)
+    from dalle_tpu.serving.engine import DecodeEngine, Request
+
+    def greedy_codes(model):
+        eng = DecodeEngine(model, params, num_slots=2, filter_thres=0.0)
+        eng.warmup()
+        reqs = [Request(text_tokens=np.asarray(text[i]), seed=i,
+                        temperature=1e-8, request_id=f"r{i}")
+                for i in range(2)]
+        eng.admit(reqs)
+        while eng.num_active:
+            eng.step()
+        return [r.codes for r in reqs]
+
+    want = greedy_codes(base)
+    got = greedy_codes(variants["fused"])
+    parity = all(
+        np.array_equal(a, b) for a, b in zip(want, got)
+    )
+    res["fused_greedy_bitwise"] = bool(parity)
+    if not parity or byte_cut < 0.4:
+        res["rung_failed"] = (
+            f"fused_greedy_bitwise={parity}, "
+            f"attn_byte_reduction={byte_cut:.3f} (gate 0.40)"
+        )
+    return res
+
+
 def _bytes_budget_bench():
     """Per-policy step HBM-byte budget (ISSUE: bf16 activation streaming +
     fused GEGLU FF + selective remat).  Two bodies of evidence:
@@ -1345,6 +1487,7 @@ PHASE_FNS = {
     "bytes_budget": _bytes_budget_bench,
     "comms_budget": _comms_budget_bench,
     "serving_throughput": _serving_bench,
+    "decode_speed": _decode_speed_bench,
     "rainbow": _rainbow_bench,
     "resilience": _resilience_bench,
     "serving_resilience": _serving_resilience_bench,

@@ -123,6 +123,10 @@ class DALLEConfig:
     kv_int8: bool = False
     # fused GEGLU FF (ops/fused_ff.py) — compute policy like use_flash
     fused_ff: bool = False
+    # fused Pallas decode tick (ops/flash.py flash_decode_attention):
+    # full-type layers' decode_step reads the (optionally int8) KV cache
+    # natively in one kernel per layer — compute policy like fused_ff
+    fused_decode: bool = False
     # decomposed tp collective-matmul rings (parallel/overlap.py) — compute
     # policy; needs tp>1 in the mesh and no sp, falls back silently else
     tp_overlap: bool = False
@@ -196,6 +200,7 @@ class DALLEConfig:
             quant_mode=self.quant_mode,
             kv_int8=self.kv_int8,
             fused_ff=self.fused_ff,
+            fused_decode=self.fused_decode,
             tp_overlap=self.tp_overlap,
             fsdp_prefetch=self.fsdp_prefetch,
             dtype=self.dtype,
@@ -212,6 +217,7 @@ class DALLEConfig:
         d.pop("stream_dtype")
         d.pop("use_flash")
         d.pop("fused_ff")
+        d.pop("fused_decode")
         d.pop("tp_overlap")
         d.pop("fsdp_prefetch")
         d["attn_types"] = list(self.attn_types)
@@ -223,6 +229,7 @@ class DALLEConfig:
         # pre-r5 checkpoints serialized use_flash; it is compute policy now
         d.pop("use_flash", None)
         d.pop("fused_ff", None)
+        d.pop("fused_decode", None)
         d.pop("tp_overlap", None)
         d.pop("fsdp_prefetch", None)
         d.pop("stream_dtype", None)

@@ -79,6 +79,18 @@ def kv_int8_model(model):
     return DALLE(dataclasses.replace(model.cfg, kv_int8=True))
 
 
+def fused_decode_model(model):
+    """Rebuild a DALLE with the fused Pallas decode tick on
+    (transformer.py fused_decode).  No param change — it is a compute
+    policy.  The shared idiom behind generate.py --fused_decode and the
+    bench decode_speed rung; composes with :func:`kv_int8_model` (the
+    kernel reads the int8 cache natively) and
+    :func:`quantize_for_decode`."""
+    from dalle_tpu.models.dalle import DALLE
+
+    return DALLE(dataclasses.replace(model.cfg, fused_decode=True))
+
+
 def quant_model_config(cfg, mode: str = "dynamic"):
     """The decode-time config for a trained ``DALLEConfig``: int8
     projections on, training-only features untouched.  ``mode``:

@@ -154,6 +154,14 @@ class TransformerConfig:
     # XLA elsewhere).  Compute policy like use_flash — never an hparam.
     # Requires ff_dropout inactive; the unfused path serves dropout.
     fused_ff: bool = False
+    # fused decode tick (ops/flash.py flash_decode_attention): full-type
+    # causal layers' decode_step runs one Pallas kernel per layer — each
+    # slot's single query row attends its fixed-length cache at its own
+    # vector position, int8 KV rows + scales read natively in-kernel (no
+    # materialized dequantized cache copy).  Off-TPU the checkpointed lax
+    # fallback is bitwise-identical to the unfused path.  Compute policy
+    # like use_flash/fused_ff — never an hparam, popped in to_dict.
+    fused_decode: bool = False
     # decomposed tp collective-matmul (parallel/overlap.py): shard_map
     # ppermute rings overlap the per-chunk projection dots with the tp
     # all-gather / reduce-scatter hops, with the residual stream
@@ -952,7 +960,6 @@ class JointAttention(nn.Module):
             if c.rotary_v:
                 v = apply_rotary(v, ang)
         new_cache = self._cache_store(cache, k, v, idx)
-        ck, cv = self._cache_kv(new_cache)  # [b, kv, n, d]
         mask_table = jnp.asarray(_static_mask(c, self.attn_type))
         if per_slot:
             mask = mask_table[idx][:, None, None, :]  # [b,1,1,n] per-lane rows
@@ -965,7 +972,23 @@ class JointAttention(nn.Module):
         # is element-for-element the plain MHA read, same head-major layout.
         g = c.heads // c.num_kv_heads
         qg = q[:, :, 0].reshape(b, c.num_kv_heads, g, c.dim_head)
-        out = attn_ops._sdpa(qg, ck, cv, mask)  # [b,kv,g,d]
+        if c.fused_decode and c.causal and self.attn_type == "full":
+            # fused decode tick: one kernel reads the cache at its stored
+            # width (int8 + scales under kv_int8) with each slot masked at
+            # its own position — the full-causal mask row IS `key <= pos`,
+            # so the kernel's in-kernel tail mask is exact.  Scalar idx
+            # broadcasts to the vector-pos layout (same kernel, no retrace
+            # across scalar/vector call sites beyond the batch shape).
+            pos_vec = idx if per_slot else jnp.full((b,), idx, jnp.int32)
+            out = flash_ops.flash_decode_attention(
+                qg, new_cache["k"], new_cache["v"], pos_vec,
+                k_scale=new_cache.get("k_scale"),
+                v_scale=new_cache.get("v_scale"),
+                mask=mask,
+            )
+        else:
+            ck, cv = self._cache_kv(new_cache)  # [b, kv, n, d]
+            out = attn_ops._sdpa(qg, ck, cv, mask)  # [b,kv,g,d]
         return self.to_out(out.reshape(b, -1)), new_cache
 
 

@@ -50,7 +50,29 @@ _LANES = 128  # f32 scratch lane width for the (m, l) running stats
 
 
 def _interpret() -> bool:
+    """Should a ``pallas_call`` run under the interpret-mode executor?
+
+    ``DALLE_TPU_PALLAS_INTERPRET`` is the one switch shared by every Pallas
+    kernel in the repo (flash fwd/bwd, the decode kernel below, fused_ff,
+    quant): ``1`` forces interpret mode (tier-1's ``pallas_interpret``
+    conftest fixture), ``0`` forces the compiled path, unset defers to the
+    backend (interpret everywhere but real TPU)."""
+    import os
+
+    env = os.environ.get("DALLE_TPU_PALLAS_INTERPRET", "")
+    if env == "0":
+        return False
     return jax.default_backend() != "tpu"
+
+
+def interpret_forced() -> bool:
+    """True iff ``DALLE_TPU_PALLAS_INTERPRET=1``: kernels that normally
+    dispatch to an XLA fallback off-TPU (weight-only dequant, the decode
+    kernel) must run their Pallas body (in interpret mode) instead — the
+    CPU-parity switch the ``pallas_interpret`` test fixture flips."""
+    import os
+
+    return os.environ.get("DALLE_TPU_PALLAS_INTERPRET", "") == "1"
 
 
 def pick_block(n: int, target: int = 128) -> int:
@@ -556,6 +578,196 @@ def block_layout_from_mask(mask: np.ndarray, bq: int, bk: int) -> np.ndarray:
     nqb, nkb = n // bq, n // bk
     blocks = mask.reshape(nqb, bq, nkb, bk)
     return blocks.any(axis=(1, 3))
+
+
+# --------------------------------------------------------------------------
+# fused decode tick (serving hot path)
+# --------------------------------------------------------------------------
+
+
+def default_decode_block(which: str) -> int:
+    """Decode-kernel tile defaults: ``DALLE_TPU_DECODE_BLOCK_K`` is the
+    kv-block length streamed per grid step (built-in 128),
+    ``DALLE_TPU_DECODE_BLOCK_H`` the kv heads tiled per grid step
+    (built-in 1).  ``tools/flash_tune.py --kernel decode`` sweeps both and
+    prints the winning exports."""
+    assert which in ("k", "h"), which
+    return env_block_default(
+        f"DALLE_TPU_DECODE_BLOCK_{which.upper()}", 128 if which == "k" else 1
+    )
+
+
+def _decode_kernel(
+    pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_scr, l_scr, acc_scr,
+    *, nkb, bk, gp, scale, quantized,
+):
+    """One query row per slot (grouped [gp, d] for GQA) against its cached
+    K/V, online softmax over streamed kv blocks.  With ``quantized`` the
+    cache blocks arrive int8 and the per-(token, head) scales are folded
+    into the QK scores (``s *= k_scale[j]``) and the AV probabilities
+    (``p *= v_scale[j]``) — dequantization happens inside the dots, no
+    f32 cache copy ever exists."""
+    bi, kb = pl.program_id(0), pl.program_id(2)
+    pos = pos_ref[bi]  # this slot's write position (attend keys 0..pos)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kb * bk <= pos)
+    def _attend():
+        bh = q_ref.shape[1]
+        q = q_ref[0].astype(jnp.float32) * scale  # [bh, gp, d]
+        k_blk = k_ref[0].astype(jnp.float32)  # [bh, bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [bh, gp, bk]
+        if quantized:
+            s = s * ks_ref[0][:, None, :]
+        ki = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bh, gp, bk), 2)
+        s = jnp.where(ki <= pos, s, NEG_INF)  # not-yet-written cache tail
+        m_prev = m_scr[...]  # [bh, gp, LANES] (lane-replicated)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(s - m_new[..., :1])
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=2, keepdims=True)
+        if quantized:
+            p = p * vs_ref[0][:, None, :]
+        acc_scr[...] = acc_scr[...] * corr[..., :1] + jax.lax.dot_general(
+            p, v_blk, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(kb == nkb - 1)
+    def _emit():
+        l_safe = jnp.maximum(l_scr[...][..., :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_scales_arg(kernel, ks, vs, bh, bk):
+    """Like :func:`_mask_arg` for the decode kernel's scale operands: the
+    non-quantized cache omits them (and their DMAs) entirely."""
+    if ks is not None:
+        spec = [pl.BlockSpec(
+            (1, bh, bk), lambda bi, hi, j: (bi, hi, j),
+            memory_space=pltpu.VMEM,
+        )] * 2
+        return kernel, spec, (ks, vs)
+
+    def no_scale_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, **kw):
+        return kernel(pos_ref, q_ref, k_ref, v_ref, None, None, *rest, **kw)
+
+    return no_scale_kernel, [], ()
+
+
+def _decode_fallback(q, k, v, k_scale, v_scale, mask):
+    """Checkpointed lax fallback: literally the pre-fused decode path
+    (dequantize the cache, dense sdpa) so greedy decode is bitwise-equal
+    to the flag-off engine; ``jax.checkpoint`` keeps the materialized
+    dequantized cache out of any residual set if the tick is ever
+    differentiated."""
+
+    def run(q, k, v, k_scale, v_scale, mask):
+        from dalle_tpu.ops import attention as attn_ops
+
+        if k_scale is not None:
+            from dalle_tpu.ops.quant import dequantize_rows
+
+            k = dequantize_rows(k, k_scale, q.dtype)
+            v = dequantize_rows(v, v_scale, q.dtype)
+        return attn_ops._sdpa(q, k, v, mask)
+
+    return jax.checkpoint(run)(q, k, v, k_scale, v_scale, mask)
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    pos: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    mask: Optional[jnp.ndarray] = None,
+    block_k: Optional[int] = None,
+    block_kv_heads: Optional[int] = None,
+    force_kernel: bool = False,
+) -> jnp.ndarray:
+    """Fused decode-tick attention: ``q`` [b, kv, g, d] — ONE grouped query
+    timestep per slot — against the slot's fixed-length KV cache
+    ``k``/``v`` [b, kv, n, d], each slot at its own vector position
+    ``pos`` [b] (keys 0..pos inclusive are attended; the not-yet-written
+    tail is masked in-kernel).  Returns [b, kv, g, d] in ``q.dtype``.
+
+    With ``k_scale``/``v_scale`` ([b, kv, n, 1] f32, ops/quant per-row
+    scales) the cache is int8 and dequantization is fused into the dots —
+    the tick reads 1 byte/element + 4 bytes/row instead of writing and
+    re-reading a full-width cache copy.
+
+    Dispatch: the Pallas kernel on TPU (or under the shared
+    ``DALLE_TPU_PALLAS_INTERPRET=1`` toggle / ``force_kernel``, in
+    interpret mode off-TPU); otherwise the checkpointed lax fallback,
+    which is bitwise-identical to the unfused decode path (``mask`` is the
+    caller's dense mask rows, used only by the fallback — the kernel
+    rebuilds the same causal geometry from ``pos``)."""
+    b, kv, g, d = q.shape
+    assert k.shape == v.shape == (b, kv, k.shape[2], d), (q.shape, k.shape)
+    n = k.shape[2]
+    quantized = k_scale is not None
+    if not (force_kernel or jax.default_backend() == "tpu"
+            or interpret_forced()):
+        return _decode_fallback(q, k, v, k_scale, v_scale, mask)
+    bk = pick_block(
+        n, block_k if block_k is not None else default_decode_block("k")
+    )
+    bh = (block_kv_heads if block_kv_heads is not None
+          else default_decode_block("h"))
+    if kv % bh:
+        bh = 1
+    gp = max(8, ((g + 7) // 8) * 8)  # pad grouped query rows to the f32 tile
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, gp - g), (0, 0))) if gp != g else q
+    pos = pos.astype(jnp.int32)
+    ks = vs = None
+    if quantized:
+        ks = k_scale.reshape(b, kv, n).astype(jnp.float32)
+        vs = v_scale.reshape(b, kv, n).astype(jnp.float32)
+    kernel = functools.partial(
+        _decode_kernel, nkb=n // bk, bk=bk, gp=gp, scale=d ** -0.5,
+        quantized=quantized,
+    )
+    kernel, scale_specs, scale_args = _decode_scales_arg(kernel, ks, vs, bh, bk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, kv // bh, n // bk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bh, gp, d), lambda bi, hi, j: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bh, bk, d), lambda bi, hi, j: (bi, hi, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bh, bk, d), lambda bi, hi, j: (bi, hi, j, 0),
+                         memory_space=pltpu.VMEM),
+        ] + scale_specs,
+        out_specs=pl.BlockSpec(
+            (1, bh, gp, d), lambda bi, hi, j: (bi, hi, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bh, gp, _LANES), jnp.float32),
+            pltpu.VMEM((bh, gp, _LANES), jnp.float32),
+            pltpu.VMEM((bh, gp, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(pos, qp, k, v, *scale_args)
+    return out[:, :, :g]
 
 
 def flash_plan(mask: np.ndarray, prefer: Optional[int] = None):

@@ -116,7 +116,7 @@ def weight_only_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
     tp-sharded weights (generate.py rejects --int8_mode weight_only with
     --mesh_*).
     """
-    from dalle_tpu.ops.flash import _interpret
+    from dalle_tpu.ops.flash import _interpret, interpret_forced
 
     block_m = _wo_default("m", 256) if block_m is None else block_m
     block_f = _wo_default("f", 512) if block_f is None else block_f
@@ -127,7 +127,7 @@ def weight_only_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
     m = x2.shape[0]
     if m == 0:
         return jnp.zeros((*lead, f), dtype)
-    if _interpret() and not force_kernel:
+    if _interpret() and not force_kernel and not interpret_forced():
         # off-TPU: interpreter-mode pallas would unroll the whole grid into
         # the jaxpr; the jnp expression is the same math
         out = x2 @ (w_q.astype(dtype) * w_scale.astype(dtype)[None, :])

@@ -1,9 +1,19 @@
-"""Sampling helpers: fractional top-k filtering + temperature sampling.
+"""Sampling helpers: fractional top-k filtering + sort-free nucleus + fused
+Gumbel draw.
 
 jit-safe re-design of the reference's helpers (reference:
 dalle_pytorch/dalle_pytorch.py:50-56 ``top_k``; generation loop :483-498):
-static k, categorical sampling via Gumbel-max (``jax.random.categorical``)
-instead of ``torch.multinomial``.
+static k, Gumbel-max sampling instead of ``torch.multinomial``.
+
+The nucleus filter is SORT-FREE: instead of sorting the 16k-entry vocab
+row per slot per tick (XLA's TPU sort is ~log²(V) vector passes plus a
+gather-back), the kept set is found by a 32-step binary search over an
+order-preserving integer recoding of the logits — ~32 masked-sum passes,
+branch-free, exact (see ``top_p_filter``).  Everything runs in f32
+regardless of the residual-stream dtype: under ``--precision bf16_stream``
+the old logits→softmax→cumsum chain degraded in bf16 and the ``1e-6``
+temperature floor lost precision — the cast happens ONCE at the head of
+each entry point and filters always return f32.
 """
 
 from __future__ import annotations
@@ -13,35 +23,86 @@ import math
 import jax
 import jax.numpy as jnp
 
+# top-k prefix length used to bracket the nucleus threshold search: when
+# the top-_PREFIX_K logits already cover ``top_p`` (the overwhelmingly
+# common case), the search starts at the prefix's k-th value instead of 0
+_PREFIX_K = 128
+
+
+def _sort_keys(l32: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving uint32 recoding of f32 values: a > b (as floats,
+    -inf included) ⟺ key(a) > key(b) (as uint32).  The standard radix
+    trick — flip all bits of negatives, set the sign bit of positives —
+    makes float order searchable with integer bisection."""
+    bi = jax.lax.bitcast_convert_type(l32, jnp.int32)
+    flipped = jnp.where(bi < 0, ~bi, bi | jnp.int32(-(2 ** 31)))
+    return jax.lax.bitcast_convert_type(flipped, jnp.uint32)
+
 
 def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
     """Keep the top ``ceil((1 - thres) * vocab)`` logits, -inf the rest.
 
     Matches the reference's fractional-threshold semantics
-    (reference: dalle_pytorch.py:50-56).  ``thres`` is static.
+    (reference: dalle_pytorch.py:50-56).  ``thres`` is static.  Computes
+    and returns f32 whatever the input dtype (bf16 residual streams must
+    not degrade the kept-set boundary).
     """
+    l32 = logits.astype(jnp.float32)
     vocab = logits.shape[-1]
     k = max(int(math.ceil((1 - thres) * vocab)), 1)
-    kth = jax.lax.top_k(logits, k)[0][..., -1:]
-    return jnp.where(logits < kth, -jnp.inf, logits)
+    kth = jax.lax.top_k(l32, k)[0][..., -1:]
+    return jnp.where(l32 < kth, -jnp.inf, l32)
 
 
 def top_p_filter(logits: jnp.ndarray, top_p: float = 0.9) -> jnp.ndarray:
-    """Nucleus filtering: keep the smallest logit set whose probability
-    mass reaches ``top_p``, -inf the rest.  Beyond-reference (the reference
-    offers only fractional top-k); jit-safe — a sort, a cumsum, and a
-    gather-back, no dynamic shapes."""
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # position i is kept iff the mass BEFORE it is < top_p (so the token
-    # that crosses the threshold is included)
-    keep_sorted = (cum - probs) < top_p
-    # threshold value = smallest kept logit; everything below is cut
-    kth = jnp.min(
-        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-    )
-    return jnp.where(logits < kth, -jnp.inf, logits)
+    """Nucleus filtering WITHOUT the full-vocab sort: keep the smallest
+    logit set whose probability mass reaches ``top_p``, -inf the rest.
+
+    A value x is in the nucleus iff the mass STRICTLY above x is < top_p
+    (so the token that crosses the threshold is included, and ties of the
+    boundary value are all kept — the sort+cumsum filter's exact
+    semantics).  Mass-above is monotone in x, so the boundary is found by
+    binary search: logits are recoded to order-preserving uint32 keys
+    (``_sort_keys``) and 32 fixed bisection steps find the largest cutoff
+    B with mass-above(B) >= top_p; the kept set is ``keys > B``.  Each
+    step is one masked sum over the row — no sort, no cumsum, no
+    gather-back, and ``top_p`` stays a traced operand.
+
+    The search bracket starts at the ``_PREFIX_K``-th largest value
+    (one ``lax.top_k`` prefix): every value strictly above it lies inside
+    the prefix, so when the prefix's strictly-above mass already reaches
+    ``top_p`` the boundary provably sits at or above that value and the
+    bisection skips the empty bottom of the key space.
+
+    Computes and returns f32 whatever the input dtype.
+    """
+    l32 = logits.astype(jnp.float32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    lse = jax.scipy.special.logsumexp(l32, axis=-1, keepdims=True)
+    probs = jnp.exp(l32 - lse)
+    keys = _sort_keys(l32)
+
+    kp = min(_PREFIX_K, l32.shape[-1])
+    pref = jax.lax.top_k(l32, kp)[0]
+    kth = pref[..., -1:]
+    strong = jnp.sum(
+        jnp.where(pref > kth, jnp.exp(pref - lse), 0.0), axis=-1
+    )  # mass strictly above the kp-th value == full-row mass above it
+    covered = strong >= top_p
+    lo = jnp.where(covered, _sort_keys(kth[..., 0]), jnp.uint32(0))
+    hi = jnp.full_like(lo, jnp.uint32(0xFFFFFFFF))
+
+    def step(_, lo_hi):
+        lo, hi = lo_hi
+        mid = lo + (hi - lo) // jnp.uint32(2)
+        mass = jnp.sum(
+            jnp.where(keys > mid[..., None], probs, 0.0), axis=-1
+        )
+        above = mass >= top_p
+        return jnp.where(above, mid, lo), jnp.where(above, hi, mid)
+
+    lo, _ = jax.lax.fori_loop(0, 32, step, (lo, hi))
+    return jnp.where(keys > lo[..., None], l32, -jnp.inf)
 
 
 def sample_logits(
@@ -52,24 +113,29 @@ def sample_logits(
     filter_thres: float = 0.5,
     top_p: float | None = None,
 ) -> jnp.ndarray:
-    """(Top-p | top-k) filter → temperature → categorical sample.
+    """(Top-p | top-k) filter → temperature → fused Gumbel-max draw.
 
     ``top_p`` (nucleus) takes precedence over the reference's fractional
     top-k when given.  ``temperature`` and ``top_p`` may be traced scalars
     (jit operands — no recompile per sampling config); only the top-k
     fraction ``filter_thres`` must be static (it sets the shape of the
-    ``top_k`` call).  Returns int32 ids."""
+    ``top_k`` call).  The draw is argmax(filtered/t + Gumbel noise) in one
+    fused pass — filtered-out lanes carry -inf and can never win.  All
+    arithmetic is f32 regardless of the logits dtype (cast once at the
+    head).  Returns int32 ids."""
+    l32 = logits.astype(jnp.float32)
     if top_p is not None:
         if isinstance(top_p, (int, float)):  # traced values skip the check
             assert 0.0 < top_p <= 1.0, (
                 f"top_p must be in (0, 1], got {top_p} — <=0 would silence "
                 "every token and always emit id 0"
             )
-        filtered = top_p_filter(logits, top_p)
+        filtered = top_p_filter(l32, top_p)
     else:
-        filtered = top_k_filter(logits, filter_thres)
-    t = jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
-    return jax.random.categorical(key, filtered / t, axis=-1)
+        filtered = top_k_filter(l32, filter_thres)
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    z = filtered / t + jax.random.gumbel(key, filtered.shape, jnp.float32)
+    return jnp.argmax(z, axis=-1).astype(jnp.int32)
 
 
 def sample_logits_per_slot(
@@ -86,10 +152,11 @@ def sample_logits_per_slot(
     ``temperature`` and ``top_p`` broadcast from scalars or come in as [b]
     per-slot vectors.  Each lane is bitwise-identical to
     ``sample_logits(keys[i], logits[i:i+1], ...)[0]``: the threefry bits,
-    per-row top-k/sort reductions, and the Gumbel-max argmax all batch
-    exactly under vmap.  ``filter_thres`` stays static (top-k shape)."""
+    per-row top-k/threshold-search reductions, and the Gumbel-max argmax
+    all batch exactly under vmap.  ``filter_thres`` stays static (top-k
+    shape)."""
     b = logits.shape[0]
-    temp = jnp.broadcast_to(jnp.asarray(temperature, logits.dtype), (b,))
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
     if top_p is None:
         def one(key, row, t):
             return sample_logits(
@@ -97,7 +164,7 @@ def sample_logits_per_slot(
             )[0]
 
         return jax.vmap(one)(keys, logits, temp)
-    tp = jnp.broadcast_to(jnp.asarray(top_p, logits.dtype), (b,))
+    tp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
 
     def one(key, row, t, p):
         return sample_logits(

@@ -214,6 +214,56 @@ def dalle_step_wire_bytes(cfg, batch: int) -> dict:
     return out
 
 
+def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool) -> float:
+    """Analytic HBM attention bytes for ONE engine decode tick at full
+    occupancy (the byte-side model behind bench.py's ``decode_speed``
+    rung, same term-by-term discipline as :func:`dalle_step_wire_bytes`).
+
+    Decode is cache-bandwidth-bound: every tick re-reads each slot's
+    whole K/V cache per full-attention layer.  Counted per layer:
+
+      * cache rows at their storage width — int8 + one f32 scale per row
+        under ``kv_int8``, else the compute dtype;
+      * the BASELINE kv_int8 path additionally round-trips a dequantized
+        f32/bf16 cache copy through HBM (``dequantize_rows`` feeds a dot:
+        the [b, kv, n, d] operand materializes at compute width, write +
+        read, for K and V) and round-trips the [h, n] f32 score rows
+        (softmax r/w);
+      * the FUSED kernel reads int8 rows + scales once and keeps scores,
+        softmax stats, and the dequantized values in VMEM — nothing else
+        touches HBM.
+
+    Non-"full" layers (mlp/sparse/axial) are counted identically on both
+    sides (the fused path only rewires full attention).  Query/output
+    vectors (one row per slot) are negligible and counted symmetrically.
+    """
+    import jax.numpy as jnp
+
+    n = cfg.total_seq_len
+    h, dh = cfg.heads, cfg.dim_head
+    kv = getattr(cfg, "kv_heads", None) or h
+    s_act = 2 if cfg.dtype == jnp.bfloat16 else 4
+    quant = bool(getattr(cfg, "kv_int8", False))
+
+    cache_row = kv * n * dh * (1 if quant else s_act)  # K or V storage
+    scale_row = kv * n * 4 if quant else 0
+    qo = 2 * h * dh * s_act  # one query row in, one attn-out row
+
+    total = 0.0
+    for i in range(cfg.depth):
+        at = cfg.attn_types[i % len(cfg.attn_types)]
+        layer = 2 * (cache_row + scale_row) + qo  # K + V streamed once
+        if at == "full" and fused:
+            pass  # kernel: everything else stays in VMEM
+        else:
+            if quant:
+                # dequantized cache copy materializes: write + read, K and V
+                layer += 2 * 2 * (kv * n * dh * s_act)
+            layer += 2 * h * n * 4  # score rows f32 w + r
+        total += layer
+    return float(total * slots)
+
+
 # Approximate per-chip aggregate ICI bandwidth, GB/s (public figures rounded;
 # override via the ici_gbps argument of dalle_step_comm_time).  These feed a
 # planning model, not a benchmark: the *ratios* between axes and levers are

@@ -203,6 +203,16 @@ def parse_args(argv=None):
                              "+ per-token scales, dequantized into the "
                              "attention dot.  No extra params; composes "
                              "with --int8 and --mesh_*")
+    parser.add_argument("--fused_decode", action="store_true",
+                        help="fused Pallas decode tick (ops/flash.py): "
+                             "full-type layers' per-token attention runs "
+                             "one kernel per layer, reading the KV cache "
+                             "natively (int8 rows + scales under "
+                             "--kv_int8 — no dequantized cache copy).  "
+                             "Compute policy: no extra params, any "
+                             "checkpoint works; off-TPU a bitwise-equal "
+                             "lax fallback runs.  Composes with --serve, "
+                             "--int8, --kv_int8")
     # sharded inference (beyond-reference: the reference generates on one
     # GPU only, generate.py:93-95): shard params over a device mesh and run
     # the scan decode under it — needed for models too big for one chip
@@ -251,6 +261,7 @@ def main(argv=None):
         model, params, vae, vae_params, cfg = _load_reference_pt(args)
         model, params = _maybe_int8(args, model, params)
         model = _maybe_kv_int8(args, model)
+        model = _maybe_fused_decode(args, model)
         loop = _serve_loop if args.serve else _generate_loop
         loop(args, tokenizer, model, params, vae, vae_params,
              cfg, clip=None, clip_params=None)
@@ -327,6 +338,7 @@ def main(argv=None):
 
     model, params = _maybe_int8(args, model, params)
     model = _maybe_kv_int8(args, model)
+    model = _maybe_fused_decode(args, model)
     loop = _serve_loop if args.serve else _generate_loop
     loop(args, tokenizer, model, params, vae, vae_params, cfg,
          clip, clip_params)
@@ -367,6 +379,19 @@ def _maybe_kv_int8(args, model):
 
     print("int8 KV cache: decode cache stored int8 + per-token scales")
     return kv_int8_model(model)
+
+
+def _maybe_fused_decode(args, model):
+    """--fused_decode: rebuild the model with the fused Pallas decode tick
+    on (params unchanged — it is a compute policy; transformer.py
+    fused_decode)."""
+    if not args.fused_decode:
+        return model
+    from dalle_tpu.models.quantize import fused_decode_model
+
+    print("fused decode: per-layer Pallas decode-attention kernel "
+          "(lax fallback off-TPU)")
+    return fused_decode_model(model)
 
 
 def _load_reference_pt(args):

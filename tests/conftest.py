@@ -34,3 +34,14 @@ def devices():
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    """One switch for every Pallas kernel in tier-1: force the Pallas body
+    to run (interpret mode on this CPU rig) even where an XLA fallback
+    would normally dispatch off-TPU — flash fwd/bwd, the decode kernel,
+    fused_ff, and the weight-only dequant all consult
+    ``DALLE_TPU_PALLAS_INTERPRET`` via ``ops/flash.py:_interpret`` /
+    ``interpret_forced``."""
+    monkeypatch.setenv("DALLE_TPU_PALLAS_INTERPRET", "1")

@@ -261,3 +261,131 @@ def test_flash_block_env_knob_errors_name_the_var(monkeypatch):
     monkeypatch.setenv("DALLE_TPU_FLASH_BLOCK_Q", "-64")
     with pytest.raises(ValueError, match="DALLE_TPU_FLASH_BLOCK_Q"):
         env_block_default("DALLE_TPU_FLASH_BLOCK_Q", 128)
+
+
+# --- decode kernel: one query row per slot against the cached KV ---------
+
+
+def _decode_case(rng, *, b=3, kv=2, g=2, d=16, n=N, pos=(0, 5, 63),
+                 quantized=False):
+    """Random decode-tick inputs + the dense oracle's answer.
+
+    Cache layout matches `_cache_store`: [b, kv_heads, n, d] with rows past
+    each slot's `pos` uninitialized garbage (here: filled with large values
+    so a masking bug can't hide)."""
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, kv, g, d))
+    k = jax.random.normal(ks[1], (b, kv, n, d))
+    v = jax.random.normal(ks[2], (b, kv, n, d))
+    pos = jnp.asarray(pos, jnp.int32)
+    # poison the unwritten tail: kernel + oracle must both ignore it
+    tail = jnp.arange(n)[None, None, :, None] > pos[:, None, None, None]
+    k = jnp.where(tail, 1e4, k)
+    v = jnp.where(tail, 1e4, v)
+    k_scale = v_scale = None
+    if quantized:
+        from dalle_tpu.ops.quant import dequantize_rows, quantize_rows
+
+        k, k_scale = quantize_rows(k)
+        v, v_scale = quantize_rows(v)
+        kd = dequantize_rows(k, k_scale)
+        vd = dequantize_rows(v, v_scale)
+    else:
+        kd, vd = k, v
+    mask = (jnp.arange(n)[None, :] <= pos[:, None])[:, None, None, :]
+    want = A._sdpa(q, kd, vd, mask)
+    return q, k, v, pos, k_scale, v_scale, mask, want
+
+
+@pytest.mark.parametrize(
+    "layout",
+    ["full", "gqa", "kv_int8", "gqa_int8"],
+)
+def test_flash_decode_matches_dense(rng, pallas_interpret, layout):
+    """The Pallas decode kernel (interpret mode on CPU) vs the dense
+    oracle across cache layouts and STAGGERED vector positions — including
+    int8 KV rows dequantized inside the kernel's dots."""
+    from dalle_tpu.ops.flash import flash_decode_attention
+
+    quantized = layout.endswith("int8")
+    g = 1 if layout.startswith("gqa") else 2
+    kv = 4 if layout.startswith("gqa") else 2
+    q, k, v, pos, ks, vs, _, want = _decode_case(
+        rng, kv=kv, g=g, quantized=quantized
+    )
+    got = flash_decode_attention(
+        q, k, v, pos, k_scale=ks, v_scale=vs, block_k=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, err_msg=layout
+    )
+
+
+def test_flash_decode_head_tiling_matches_dense(rng, pallas_interpret):
+    """block_kv_heads > 1 (several kv heads per grid step) is the same
+    math — the autotuner's head-tiling axis must not change numerics."""
+    from dalle_tpu.ops.flash import flash_decode_attention
+
+    q, k, v, pos, ks, vs, _, want = _decode_case(rng, quantized=True)
+    got = flash_decode_attention(
+        q, k, v, pos, k_scale=ks, v_scale=vs, block_k=16, block_kv_heads=2
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_flash_decode_pos_zero_and_full(rng, pallas_interpret):
+    """Edge positions: a slot at pos=0 (sees exactly one key) and a slot
+    at pos=n-1 (sees the whole cache) in the same batch."""
+    from dalle_tpu.ops.flash import flash_decode_attention
+
+    q, k, v, pos, _, _, _, want = _decode_case(
+        rng, b=2, pos=(0, N - 1)
+    )
+    got = flash_decode_attention(q, k, v, pos, block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_flash_decode_fallback_bitwise(rng):
+    """Off-TPU without the interpret toggle, `flash_decode_attention`
+    dispatches to the checkpointed lax fallback — BITWISE equal to the
+    baseline dequantize+sdpa path (the greedy-parity guarantee)."""
+    from dalle_tpu.ops.flash import flash_decode_attention
+    from dalle_tpu.ops.quant import dequantize_rows
+
+    q, k, v, pos, ks, vs, mask, _ = _decode_case(rng, quantized=True)
+    got = flash_decode_attention(q, k, v, pos, k_scale=ks, v_scale=vs,
+                                 mask=mask)
+    want = A._sdpa(q, dequantize_rows(k, ks), dequantize_rows(v, vs), mask)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_decode_block_env_knobs(rng, pallas_interpret, monkeypatch):
+    """DALLE_TPU_DECODE_BLOCK_K/_H set the decode kernel's defaults
+    (tools/flash_tune.py --kernel decode prints the exports) without
+    changing numerics."""
+    from dalle_tpu.ops.flash import default_decode_block, flash_decode_attention
+
+    assert default_decode_block("k") == 128 and default_decode_block("h") == 1
+    q, k, v, pos, ks, vs, _, _ = _decode_case(rng, n=128, pos=(0, 5, 127),
+                                              quantized=True)
+    want = flash_decode_attention(q, k, v, pos, k_scale=ks, v_scale=vs)
+    monkeypatch.setenv("DALLE_TPU_DECODE_BLOCK_K", "32")
+    monkeypatch.setenv("DALLE_TPU_DECODE_BLOCK_H", "2")
+    assert default_decode_block("k") == 32 and default_decode_block("h") == 2
+    got = flash_decode_attention(q, k, v, pos, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_decode_bf16(rng, pallas_interpret):
+    """bf16 q/cache through the kernel: f32 accumulation inside, bf16 out."""
+    from dalle_tpu.ops.flash import flash_decode_attention
+
+    q, k, v, pos, _, _, _, want = _decode_case(rng)
+    got = flash_decode_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16), pos, block_k=16,
+    )
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=3e-2
+    )

@@ -172,6 +172,62 @@ def test_sample_logits_top_p_respects_nucleus(rng):
     assert set(ids) <= {0, 1}
 
 
+def test_sampling_computes_in_f32_for_bf16_logits(rng):
+    """bf16 residual streams must not degrade sampling: the filters cast
+    ONCE at the head and return f32, and the draw for bf16-cast logits is
+    bitwise the draw for those same (rounded) values fed in as f32."""
+    from dalle_tpu.ops.sampling import top_p_filter
+
+    l32 = jax.random.normal(rng, (4, 64), jnp.float32)
+    lb = l32.astype(jnp.bfloat16)
+    for filt in (lambda x: top_k_filter(x, thres=0.9),
+                 lambda x: top_p_filter(x, top_p=0.8)):
+        out = filt(lb)
+        assert out.dtype == jnp.float32
+        # bf16 in ≡ its f32 upcast in: all math happens post-cast
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(filt(lb.astype(jnp.float32)))
+        )
+    ids_b = sample_logits(rng, lb, temperature=0.7, top_p=0.9)
+    ids_f = sample_logits(rng, lb.astype(jnp.float32), temperature=0.7,
+                          top_p=0.9)
+    np.testing.assert_array_equal(np.asarray(ids_b), np.asarray(ids_f))
+
+
+def test_top_p_filter_matches_sort_reference(rng):
+    """The sort-free threshold search reproduces the sort→cumsum nucleus
+    semantics — keep x ⟺ mass strictly above x < top_p (so the crossing
+    token is included and boundary ties are all kept) — on random rows
+    across a sweep of top_p values, checked against an exact f64 oracle.
+    Tokens whose strictly-above mass equals top_p to within f32 rounding
+    are exempt: there the f32 summation ORDER picks the side, for the
+    sorted filter just as for this one."""
+    from dalle_tpu.ops.sampling import top_p_filter
+
+    logits = jax.random.normal(rng, (8, 257), jnp.float32) * 3.0
+    # include exact ties at the nucleus boundary
+    logits = logits.at[0, :5].set(2.5)
+    l64 = np.asarray(logits, np.float64)
+    p64 = np.exp(l64 - l64.max(-1, keepdims=True))
+    p64 /= p64.sum(-1, keepdims=True)
+    for tp in (0.05, 0.3, 0.8, 0.95, 0.999, 1.0):
+        got = np.isfinite(np.asarray(top_p_filter(logits, top_p=tp)))
+        # exact strictly-above mass per token (f64, ties share one value)
+        above = np.stack([
+            np.where(l64[r][None, :] > l64[r][:, None], p64[r][None, :], 0.0)
+            .sum(-1)
+            for r in range(l64.shape[0])
+        ])
+        want = above < tp
+        ambiguous = np.abs(above - tp) < 1e-5  # f32 sum can't split these
+        np.testing.assert_array_equal(
+            got | ambiguous, want | ambiguous,
+            err_msg=f"kept set differs at top_p={tp}",
+        )
+        # and every row keeps at least one token
+        assert got.any(-1).all()
+
+
 
 
 class TestBlockCausal:

@@ -305,6 +305,48 @@ def test_engine_no_recompile_across_occupancy(rng):
     assert engine._admit_fn._cache_size() == 1
 
 
+@pytest.mark.parametrize("kv_int8", [False, True])
+def test_engine_fused_decode_bitwise_and_no_recompile(rng, kv_int8):
+    """`--fused_decode` under the engine: greedy codes are BITWISE the
+    flag-off engine's (off-TPU the fused path runs the checkpointed lax
+    fallback — same dequant+sdpa math), and occupancy churn still reuses
+    ONE compiled tick (the vector-pos kernel path has no
+    occupancy-dependent shapes)."""
+    from dalle_tpu.models.quantize import fused_decode_model
+
+    model, params, _ = build(rng, kv_int8=kv_int8)
+    fused = fused_decode_model(model)
+    assert fused.cfg.fused_decode and not model.cfg.fused_decode
+    texts = jax.random.randint(rng, (4, T), 1, 30)
+
+    def run(m):
+        engine = DecodeEngine(m, params, num_slots=3, filter_thres=0.0)
+        engine.warmup()
+        reqs = [
+            Request(text_tokens=np.asarray(texts[i]), seed=i,
+                    temperature=1e-8, request_id=f"r{i}")
+            for i in range(4)
+        ]
+        pending = list(reqs)
+        engine.admit([pending.pop(0), pending.pop(0)])
+        while pending or engine.num_active:
+            if engine.tick_count >= 2 and pending:
+                free = engine.free_slots()
+                take = min(len(free), len(pending))
+                if take:
+                    engine.admit([pending.pop(0) for _ in range(take)])
+            engine.step()
+        assert engine._tick_fn._cache_size() == 1
+        return [r.codes for r in reqs]
+
+    base_codes = run(model)
+    fused_codes = run(fused)
+    for i, (a, b) in enumerate(zip(base_codes, fused_codes)):
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"request {i} fused != baseline (kv_int8={kv_int8})"
+        )
+
+
 # --- 3. scan_decode: sampling config is traced --------------------------
 
 

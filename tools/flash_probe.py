@@ -50,6 +50,9 @@ CASES = [
     # (n=320 = flagship 1280 / sp4), causal diagonal + full off-diagonal
     # variants, INCLUDING the dlse backward (the logsumexp-merge VJP)
     ("ring_lse_bf16_320", 320, 64, "bfloat16", False, False),
+    # the decode-tick kernel: one query row per slot over an int8 KV cache
+    # (ops/flash.py flash_decode_attention; the --fused_decode hot path)
+    ("decode_int8_1280", 1280, 64, "bfloat16", False, False),
     ("causal_bf16_4096", 4096, 64, "bfloat16", False, False),  # VQGAN-f8 scale
 ]
 
@@ -171,12 +174,64 @@ def _run_lse_case(name: str) -> dict:
     return rec
 
 
+def _run_decode_case(name: str) -> dict:
+    """flash_decode_attention compile+run+numerics at the serving shape:
+    8 slots x 8 kv heads x n-token int8 cache, staggered positions (the
+    engine tick's exact call).  Fwd-only — decode has no backward."""
+    jax, jnp, import_s = _import_jax_for_probe()
+
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops.flash import flash_decode_attention
+    from dalle_tpu.ops.quant import dequantize_rows, quantize_rows
+
+    platform = jax.default_backend()
+    n, d = next((n_, d_) for nm, n_, d_, *_ in CASES if nm == name)
+    b, kv, g = 8, 8, 1
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, kv, g, d), jnp.bfloat16)
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, kv, n, d))
+    kq, ks = quantize_rows(kc)
+    vq, vs = quantize_rows(vc)
+    pos = jnp.arange(b, dtype=jnp.int32) * ((n - 1) // (b - 1))
+
+    fn = jax.jit(lambda q: flash_decode_attention(
+        q, kq, vq, pos, k_scale=ks, v_scale=vs, force_kernel=True))
+    t0 = time.perf_counter()
+    out = fn(q)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(q)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+
+    mask = (jnp.arange(n)[None, :] <= pos[:, None])[:, None, None, :]
+    want = A._sdpa(q, dequantize_rows(kq, ks), dequantize_rows(vq, vs), mask)
+    err = float(jnp.max(jnp.abs(
+        out.astype(jnp.float32) - want.astype(jnp.float32))))
+    return {
+        "case": name, "slots": b, "kv_heads": kv, "n": n, "d": d,
+        "dtype": "bfloat16", "platform": platform,
+        "interpret": platform != "tpu",
+        "import_s": round(import_s, 1),
+        "fwd_compile_s": round(compile_s, 2),
+        "fwd_ms": round(ms, 3),
+        "fwd_max_err": round(err, 6),
+        "numerics_ok": bool(err < 3e-2),
+    }
+
+
 def run_case(name: str) -> dict:
     """Child entry: compile+run fwd and bwd for one case, check numerics."""
     if name.startswith("dequant_int8"):
         return _run_dequant_case(name)
     if name.startswith("ring_lse"):
         return _run_lse_case(name)
+    if name.startswith("decode_int8"):
+        return _run_decode_case(name)
     n, d, dtype_name, sparse, masked = next(
         (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
     )

@@ -121,6 +121,71 @@ def run_dequant_sweep(args) -> dict:
     return summary
 
 
+def run_decode_sweep(args) -> dict:
+    """--kernel decode: sweep the decode-attention kernel's (kv-block
+    length x kv-head tiling) at the serving shape — one query row per slot
+    against an int8 KV cache (ops/flash.py flash_decode_attention; the
+    engine's per-tick hot loop).  Winners print as
+    DALLE_TPU_DECODE_BLOCK_K/_H exports, which the kernel reads as its
+    defaults (``default_decode_block``) and bench.py's decode_speed rung
+    records alongside its tokens/s."""
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dalle_tpu.ops.flash import flash_decode_attention
+    from dalle_tpu.ops.quant import quantize_rows
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    b, kv, g, d, n = args.slots, args.kv_heads, args.gq, args.d, args.n
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, kv, g, d), dtype)
+    kc = jax.random.normal(jax.random.fold_in(rng, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(rng, 2), (b, kv, n, d))
+    kq, ks = quantize_rows(kc)
+    vq, vs = quantize_rows(vc)
+    # staggered occupancy: slots spread across the whole cache depth
+    pos = (jnp.arange(b, dtype=jnp.int32) * ((n - 1) // max(b - 1, 1)))
+
+    bks = [bk for bk in (64, 128, 256, 512) if bk <= n and n % bk == 0]
+    bhs = [bh for bh in (1, 2, 4, 8) if bh <= kv and kv % bh == 0]
+    if args.smoke:
+        bks, bhs = bks[:2], bhs[:2]
+    os.makedirs(os.path.dirname(args.log), exist_ok=True)
+    results = []
+    for bk, bh in itertools.product(bks, bhs):
+        rec = {"kernel": "decode", "bk": bk, "bh": bh, "slots": b,
+               "kv_heads": kv, "gq": g, "n": n, "d": d, "dtype": args.dtype,
+               "on_tpu": on_tpu, "t": time.time()}
+        try:
+            tick = jax.jit(lambda q, _bk=bk, _bh=bh: flash_decode_attention(
+                q, kq, vq, pos, k_scale=ks, v_scale=vs, block_k=_bk,
+                block_kv_heads=_bh, force_kernel=not on_tpu))
+            rec["compile_s"], rec["tick_ms"] = _time_case(tick, (q,), args.iters)
+            rec["ok"] = True
+        except Exception as e:
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"[-300:]
+        results.append(rec)
+        _record(args.log, rec,
+                f"bk={bk} bh={bh}: "
+                + (f"{rec.get('tick_ms')}ms" if rec["ok"] else rec["error"]))
+    ok = [r for r in results if r.get("ok")]
+    summary = {"tool": "flash_tune", "kernel": "decode", "slots": b,
+               "kv_heads": kv, "gq": g, "n": n, "d": d, "on_tpu": on_tpu,
+               "configs_ok": len(ok), "configs_total": len(results)}
+    if ok:
+        best = min(ok, key=lambda r: r["tick_ms"])
+        summary["best"] = {k: best[k] for k in ("bk", "bh", "tick_ms")}
+        summary["export"] = (
+            f"export DALLE_TPU_DECODE_BLOCK_K={best['bk']} "
+            f"DALLE_TPU_DECODE_BLOCK_H={best['bh']}"
+        )
+    return summary
+
+
 def run_sweep(args) -> dict:
     import jax
     import jax.numpy as jnp
@@ -196,24 +261,37 @@ def main():
     ap.add_argument("--log", default=DEFAULT_LOG)
     ap.add_argument("--smoke", action="store_true",
                     help="2x2 configs at the given shapes (harness check)")
-    ap.add_argument("--kernel", choices=("flash", "dequant"),
+    ap.add_argument("--kernel", choices=("flash", "dequant", "decode"),
                     default="flash",
                     help="which Pallas kernel to sweep: flash attention "
-                         "blocks, or the weight-only int8 dequant matmul")
+                         "blocks, the weight-only int8 dequant matmul, or "
+                         "the decode-attention kernel (kv block x head "
+                         "tiling)")
     ap.add_argument("--m", type=int, default=512,
                     help="dequant sweep: activation rows (batch*tokens)")
     ap.add_argument("--dq_d", type=int, default=512,
                     help="dequant sweep: input features")
     ap.add_argument("--dq_f", type=int, default=2048,
                     help="dequant sweep: output features (FF inner dim)")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="decode sweep: engine slots (batch lanes)")
+    ap.add_argument("--kv_heads", type=int, default=8,
+                    help="decode sweep: kv heads in the cache")
+    ap.add_argument("--gq", type=int, default=1,
+                    help="decode sweep: query heads per kv head (GQA group)")
     args = ap.parse_args()
     if os.environ.get("BENCH_SMOKE"):
         # bench harness smoke (CPU interpret): tiny shapes, 2x2 configs —
         # validates the rung end to end without minutes-per-config cost
         args.n, args.d, args.bh, args.iters, args.smoke = 256, 32, 8, 2, True
         args.m, args.dq_d, args.dq_f = 256, 128, 512
+        args.slots, args.kv_heads = 4, 2
     if args.kernel == "dequant":
         summary = run_dequant_sweep(args)
+        print(json.dumps(summary))
+        return 0 if summary["configs_ok"] else 2
+    if args.kernel == "decode":
+        summary = run_decode_sweep(args)
         print(json.dumps(summary))
         return 0 if summary["configs_ok"] else 2
     summary = run_sweep(args)
