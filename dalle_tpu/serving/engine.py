@@ -281,6 +281,7 @@ class DecodeEngine:
             self.slot_req[slot] = req
             self._slot_done[slot] = self.tick_count + S
             req.admit_time = now
+            req.slot = slot  # trace track: decode occupancy lands here
         self.state = self._admit_fn(
             self.params, self.state, jnp.asarray(texts), jnp.asarray(base),
             jnp.asarray(temps), jnp.asarray(tps), jnp.asarray(src),

@@ -64,6 +64,7 @@ class Request:
     error: Optional[str] = None  # detok-worker failure, request still completes
     retries: int = 0  # crash-recovery replays consumed so far
     service_tier: int = 0  # degradation tier the request was served at
+    slot: Optional[int] = None  # engine slot last occupied (trace track)
     _done: threading.Event = field(
         default_factory=threading.Event, repr=False, compare=False
     )
@@ -131,7 +132,7 @@ class RequestQueue:
     """
 
     def __init__(self, max_pending: Optional[int] = None,
-                 shed_policy: str = "reject", on_shed=None):
+                 shed_policy: str = "reject", on_shed=None, metrics=None):
         assert shed_policy in SHED_POLICIES, (
             f"shed_policy must be one of {SHED_POLICIES}, got {shed_policy!r}"
         )
@@ -147,6 +148,10 @@ class RequestQueue:
         self.on_shed = on_shed
         self.shed: List[Request] = []
         self.max_pending_seen = 0  # high-water mark of queue depth
+        # MetricsRegistry (dalle_tpu/telemetry): the Scheduler ties the
+        # queue to its own registry unless one was passed, so the
+        # serve_submitted / serve_shed counters reconcile with stats()
+        self.metrics = metrics
 
     # --- shedding --------------------------------------------------------
     def _pick_victim(self, new: Request) -> Request:
@@ -185,7 +190,11 @@ class RequestQueue:
                 self._q.append(req)
             self.max_pending_seen = max(self.max_pending_seen, len(self._q))
             self._cv.notify_all()
+        if self.metrics is not None:
+            self.metrics.counter("serve_submitted").inc()
         if victim is not None:
+            if self.metrics is not None:
+                self.metrics.counter("serve_shed").inc()
             victim._fail(
                 f"shed: queue full (max_pending={self.max_pending}, "
                 f"policy={self.shed_policy})"

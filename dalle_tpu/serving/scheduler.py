@@ -42,8 +42,10 @@ from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
+from dalle_tpu import telemetry
 from dalle_tpu.serving.engine import DecodeEngine
 from dalle_tpu.serving.queue import Request, RequestQueue
+from dalle_tpu.telemetry import MetricsRegistry
 from dalle_tpu.training import faults
 from dalle_tpu.training.logging import log_event
 
@@ -154,6 +156,8 @@ class Scheduler:
         degrade_low: Optional[float] = None,
         detok_max: Optional[int] = 64,
         evict_unmeetable: bool = True,
+        metrics: Optional[MetricsRegistry] = None,
+        tracer=None,
     ):
         assert policy in POLICIES, f"policy must be one of {POLICIES}"
         self.engine = engine
@@ -173,11 +177,43 @@ class Scheduler:
             maxsize=0 if detok_max is None else int(detok_max)
         )
         self.detok_backlog_peak = 0
-        self.evicted = 0
-        self.replays = 0
-        self._engine_crashes = 0
         self._fatal: Optional[str] = None
         self._tick_ewma: Optional[float] = None  # seconds per engine tick
+        # Request-lifecycle counters live in a MetricsRegistry so stats()
+        # is a registry read (docs/OBSERVABILITY.md).  Default: the global
+        # telemetry registry when a session is live, else a private
+        # always-on registry — counters are a lock + int add, so the
+        # scheduler can afford exact counts even with telemetry off.
+        if metrics is None:
+            metrics = (telemetry.registry() if telemetry.enabled()
+                       else MetricsRegistry())
+        self.metrics = metrics
+        self.tracer = tracer if tracer is not None else telemetry.tracer()
+        if getattr(req_queue, "metrics", None) is None:
+            req_queue.metrics = metrics  # shed counts land in one registry
+        self._c_admitted = metrics.counter("serve_admitted")
+        self._c_completed = metrics.counter("serve_completed")
+        self._c_failed = metrics.counter("serve_failed")
+        self._c_evicted = metrics.counter("serve_evicted")
+        self._c_replays = metrics.counter("serve_replays")
+        self._c_restarts = metrics.counter("serve_engine_restarts")
+        self._h_tick = metrics.histogram("serve_tick_s")
+        self._h_queue_wait = metrics.histogram("serve_queue_wait_s")
+        self._h_decode = metrics.histogram("serve_decode_s")
+        self._h_detok = metrics.histogram("serve_detok_s")
+        self._h_ttlt = metrics.histogram("serve_ttlt_s")
+        try:  # live gauge backed by the analytic decode byte model
+            from dalle_tpu.training.profiler import decode_tick_attn_bytes
+
+            metrics.gauge("decode_modeled_attn_bytes_per_tick").set(
+                decode_tick_attn_bytes(
+                    engine.model.cfg, engine.num_slots,
+                    fused=bool(getattr(engine.model.cfg, "fused_decode",
+                                       False)),
+                )
+            )
+        except Exception:
+            pass  # smoke configs may predate some model fields
         B = engine.num_slots
         self._degrade = (
             DegradeController(
@@ -216,27 +252,38 @@ class Scheduler:
                 tier = self._degrade.tier if self._degrade is not None else 0
                 req.service_tier = tier
                 try:
-                    faults.on_detok()  # injected detok_fail (no-op off)
-                    if (
-                        tier < 2
-                        and self._decode_fn is not None
-                        and req.codes is not None
-                    ):
-                        req.image = np.asarray(
-                            self._decode_fn(req.codes[None])
-                        )[0]
-                        if tier < 1 and self._clip_fn is not None:
-                            score = self._clip_fn(
-                                np.asarray(req.text_tokens, np.int32)[None],
-                                req.image[None],
-                            )
-                            req.clip_score = float(
-                                np.asarray(score).reshape(-1)[0]
-                            )
+                    with self.tracer.span("detok", track="detok",
+                                          request_id=req.request_id,
+                                          tier=tier):
+                        faults.on_detok()  # injected detok_fail (no-op off)
+                        if (
+                            tier < 2
+                            and self._decode_fn is not None
+                            and req.codes is not None
+                        ):
+                            req.image = np.asarray(
+                                self._decode_fn(req.codes[None])
+                            )[0]
+                            if tier < 1 and self._clip_fn is not None:
+                                with self.tracer.span(
+                                    "clip_rerank", track="detok",
+                                    request_id=req.request_id,
+                                ):
+                                    score = self._clip_fn(
+                                        np.asarray(
+                                            req.text_tokens, np.int32
+                                        )[None],
+                                        req.image[None],
+                                    )
+                                req.clip_score = float(
+                                    np.asarray(score).reshape(-1)[0]
+                                )
                     req.detok_time = time.monotonic()
                 except Exception as e:
                     req.error = f"{type(e).__name__}: {e}"
                     req.detok_time = time.monotonic()
+                if req.finish_time is not None:
+                    self._h_detok.observe(req.detok_time - req.finish_time)
                 if self.on_result is not None:
                     try:
                         self.on_result(req)
@@ -275,6 +322,7 @@ class Scheduler:
                 and now > r.arrival_time + r.deadline_s
             ):
                 r._fail("dropped: deadline expired before admission")
+                self._c_failed.inc()
                 self.completed.append(r)
             else:
                 keep.append(r)
@@ -310,7 +358,14 @@ class Scheduler:
                     f"~{(self._tick_ewma or 0.0):.4f}s/tick)"
                 )
                 self.completed.append(req)
-                self.evicted += 1
+                self._c_evicted.inc()
+                self._c_failed.inc()
+                if req.admit_time is not None:
+                    self.tracer.complete(
+                        "decode(evicted)", req.admit_time, time.monotonic(),
+                        track=f"slot{req.slot}", request_id=req.request_id,
+                        remaining_ticks=rem,
+                    )
                 log_event(
                     "serve_evicted", request_id=req.request_id,
                     deadline_s=req.deadline_s, remaining_ticks=rem,
@@ -323,14 +378,15 @@ class Scheduler:
         past the restart/retry budgets — fail fast.  Returns True when
         serving can continue."""
         eng = self.engine
-        self._engine_crashes += 1
+        self._c_restarts.inc()
+        crashes = self._c_restarts.value
         in_flight = eng.in_flight()
         log_event(
             "engine_crash", error=f"{type(exc).__name__}: {exc}",
-            crash=self._engine_crashes,
+            crash=crashes,
             in_flight=[r.request_id for r in in_flight],
         )
-        if self._engine_crashes > self.max_engine_restarts:
+        if crashes > self.max_engine_restarts:
             self._fatal = f"{type(exc).__name__}: {exc}"
             return False  # run() re-raises; the finally fails everyone
         # fresh EngineState, same compiled fns — then deterministic
@@ -346,6 +402,7 @@ class Scheduler:
                     f"engine crashed {r.retries}x during decode "
                     f"(retry budget {self.max_request_retries}): {exc}"
                 )
+                self._c_failed.inc()
                 self.completed.append(r)
                 failed.append(r.request_id)
             else:
@@ -354,9 +411,9 @@ class Scheduler:
                 r.admit_time = None
                 replayed.append(r)
         self.queue.requeue(replayed)
-        self.replays += len(replayed)
+        self._c_replays.inc(len(replayed))
         log_event(
-            "engine_restart", crash=self._engine_crashes,
+            "engine_restart", crash=crashes,
             replayed=[r.request_id for r in replayed], failed=failed,
         )
         return True
@@ -375,10 +432,12 @@ class Scheduler:
             eng._slot_done[b] = None
             if req is not None and not req._done.is_set():
                 req._fail(reason)
+                self._c_failed.inc()
                 self.completed.append(req)
         for req in self.queue.drain():
             if not req._done.is_set():
                 req._fail(reason)
+                self._c_failed.inc()
                 self.completed.append(req)
 
     # --- main loop -------------------------------------------------------
@@ -390,17 +449,43 @@ class Scheduler:
         if want:
             reqs = self._drop_expired(self.queue.pop(want))
             if reqs:
-                eng.admit(reqs)
+                with self.tracer.span("admit", track="scheduler",
+                                      n=len(reqs)):
+                    eng.admit(reqs)
+                self._c_admitted.inc(len(reqs))
+                for r in reqs:
+                    # retrospective span: enqueue -> admission (EDF wait)
+                    self._h_queue_wait.observe(r.admit_time - r.arrival_time)
+                    self.tracer.complete(
+                        "queue_wait", r.arrival_time, r.admit_time,
+                        track="queue", request_id=r.request_id,
+                        slot=r.slot,
+                    )
         drained = False
         if eng.num_active:
             t0 = time.monotonic()
             done = eng.step()
             dt = time.monotonic() - t0
+            self._h_tick.observe(dt)
             self._tick_ewma = (
                 dt if self._tick_ewma is None
                 else 0.8 * self._tick_ewma + 0.2 * dt
             )
             for req in done:
+                self._c_completed.inc()
+                # one retrospective span per request covers the whole
+                # decode occupancy (per-tick spans would be pure
+                # overhead at ~S ticks/request); tick cadence rides
+                # along as args
+                self.tracer.complete(
+                    "decode", req.admit_time, req.finish_time,
+                    track=f"slot{req.slot}", request_id=req.request_id,
+                    seed=req.seed, ticks=eng.S,
+                    tick_ewma_s=round(self._tick_ewma, 6),
+                )
+                self._h_decode.observe(req.finish_time - req.admit_time)
+                if req.ttlt is not None:
+                    self._h_ttlt.observe(req.ttlt)
                 self.completed.append(req)
                 self._detok_q.put(req)
         elif self.queue.closed and self.queue.pending() == 0:
@@ -409,6 +494,12 @@ class Scheduler:
             self.queue.wait(timeout=self.idle_wait)
         backlog = self._detok_q.qsize()
         self.detok_backlog_peak = max(self.detok_backlog_peak, backlog)
+        g = self.metrics.gauge
+        g("serve_pending").set(self.queue.pending())
+        g("serve_detok_backlog").set(backlog)
+        g("serve_occupancy").set(eng.num_active)
+        if self._tick_ewma is not None:
+            g("serve_tick_ewma_s").set(self._tick_ewma)
         if self._degrade is not None:
             self._degrade.update(self.queue.pending() + backlog)
         return drained
@@ -434,7 +525,20 @@ class Scheduler:
             self._fail_unfinished()
 
     # --- metrics ---------------------------------------------------------
+    @property
+    def evicted(self) -> int:
+        return self._c_evicted.value
+
+    @property
+    def replays(self) -> int:
+        return self._c_replays.value
+
     def stats(self) -> dict:
+        """One-shot stats view — a *registry read* plus the percentile
+        math of :func:`request_stats`.  Invariants pinned by
+        tests/test_telemetry.py and the chaos telemetry smoke:
+        ``served == serve_completed``, ``dropped == serve_failed``,
+        ``shed == serve_shed``, ``evicted_midflight == serve_evicted``."""
         out = {
             "policy": self.policy,
             "num_slots": self.engine.num_slots,
@@ -442,11 +546,13 @@ class Scheduler:
             **request_stats(self.completed, self.engine.S),
         }
         out.update(
+            admitted=self._c_admitted.value,
+            failed=self._c_failed.value,
             shed=len(self.queue.shed),
             max_pending_seen=self.queue.max_pending_seen,
-            evicted_midflight=self.evicted,
-            engine_restarts=self._engine_crashes,
-            replays=self.replays,
+            evicted_midflight=self._c_evicted.value,
+            engine_restarts=self._c_restarts.value,
+            replays=self._c_replays.value,
             detok_backlog_peak=self.detok_backlog_peak,
             degrade_tier=(
                 self._degrade.tier if self._degrade is not None else 0

@@ -1,0 +1,284 @@
+"""dalle_tpu.telemetry — unified metrics + tracing for training and serving.
+
+One process-global session, explicitly opted into (``--telemetry`` on the
+trainers and ``generate.py --serve``, or :func:`configure` from code).
+When no session is configured every helper below is a cheap no-op — the
+instrumented hot paths (engine ticks, data pump, checkpoint writer) pay
+one ``is None`` check (pinned by tests/test_telemetry.py and the
+``telemetry_overhead`` bench rung).
+
+A configured session owns:
+
+* a :class:`~dalle_tpu.telemetry.registry.MetricsRegistry`, periodically
+  snapshotted (``kind: "telemetry"`` lines) into ``<run_dir>/metrics.jsonl``;
+* a :class:`~dalle_tpu.telemetry.tracing.Tracer` ring buffer, exported to
+  ``<run_dir>/trace.json`` (Chrome trace-event format — load it at
+  https://ui.perfetto.dev) on :func:`shutdown`;
+* a ``log_event`` hook: every structured event also bumps an
+  ``events_<kind>`` counter and lands as an instant marker on the trace
+  timeline — events.jsonl becomes one sink of the telemetry stream
+  rather than a parallel universe.
+
+See docs/OBSERVABILITY.md for the full model and flag reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from dalle_tpu.telemetry.registry import (  # noqa: F401 (re-exports)
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    SnapshotWriter,
+)
+from dalle_tpu.telemetry.tracing import NOOP_TRACER, Tracer  # noqa: F401
+from dalle_tpu.telemetry.schema import EVENT_KINDS, is_known_kind  # noqa: F401
+
+_NOOP_REGISTRY = MetricsRegistry(enabled=False)
+
+_LOCK = threading.Lock()
+_SESSION: Optional["TelemetrySession"] = None
+
+
+class TelemetrySession:
+    """Everything one telemetry run owns; built by :func:`configure`."""
+
+    def __init__(self, *, run_dir: Optional[str], metrics_interval_s: float,
+                 trace_capacity: int):
+        self.run_dir = str(run_dir) if run_dir is not None else None
+        self.registry = MetricsRegistry(enabled=True)
+        self.tracer = Tracer(capacity=trace_capacity, enabled=True)
+        self.writer: Optional[SnapshotWriter] = None
+        if self.run_dir is not None:
+            import os
+
+            os.makedirs(self.run_dir, exist_ok=True)
+            self.writer = SnapshotWriter(
+                self.registry, os.path.join(self.run_dir, "metrics.jsonl"),
+                interval_s=metrics_interval_s,
+            )
+            self.writer.start()
+
+    def _on_event(self, rec: dict) -> None:
+        """log_event hook: count the kind + drop an instant marker."""
+        kind = rec.get("kind", "unknown")
+        self.registry.counter(f"events_{kind}").inc()
+        args = {k: v for k, v in rec.items()
+                if k not in ("_time", "kind")
+                and isinstance(v, (bool, int, float, str))}
+        self.tracer.instant(kind, track="events", **args)
+
+    def close(self) -> Optional[str]:
+        """Stop the snapshot thread (final snapshot) and export the
+        trace.  Returns the trace path (None when no run dir)."""
+        if self.writer is not None:
+            self.writer.stop(final=True)
+        if self.run_dir is not None:
+            import os
+
+            path = os.path.join(self.run_dir, "trace.json")
+            try:
+                return self.tracer.export_chrome_trace(path)
+            except OSError:
+                return None
+        return None
+
+
+# --- session lifecycle ------------------------------------------------------
+
+
+def configure(run_dir: Optional[str] = None, *,
+              metrics_interval_s: float = 10.0,
+              trace_capacity: int = 65536) -> TelemetrySession:
+    """Enable telemetry for this process (idempotent per call site: a
+    second configure replaces the session after closing the first)."""
+    global _SESSION
+    from dalle_tpu.training import logging as tlog
+
+    with _LOCK:
+        if _SESSION is not None:
+            _shutdown_locked()
+        sess = TelemetrySession(
+            run_dir=run_dir, metrics_interval_s=metrics_interval_s,
+            trace_capacity=trace_capacity,
+        )
+        tlog.add_event_hook(sess._on_event)
+        _SESSION = sess
+    tlog.log_event(
+        "telemetry_enabled",
+        run_dir=run_dir, metrics_interval_s=metrics_interval_s,
+    )
+    return sess
+
+
+def _shutdown_locked() -> Optional[str]:
+    global _SESSION
+    sess, _SESSION = _SESSION, None
+    if sess is None:
+        return None
+    from dalle_tpu.training import logging as tlog
+
+    tlog.remove_event_hook(sess._on_event)
+    return sess.close()
+
+
+def shutdown() -> Optional[str]:
+    """Tear down the session: final metrics snapshot + trace.json export.
+    Safe to call when telemetry was never configured (no-op)."""
+    with _LOCK:
+        return _shutdown_locked()
+
+
+def enabled() -> bool:
+    return _SESSION is not None
+
+
+def session() -> Optional[TelemetrySession]:
+    return _SESSION
+
+
+def registry() -> MetricsRegistry:
+    """The live registry (a disabled no-op registry when off)."""
+    s = _SESSION
+    return s.registry if s is not None else _NOOP_REGISTRY
+
+
+def tracer() -> Tracer:
+    """The live tracer (a no-op tracer when off)."""
+    s = _SESSION
+    return s.tracer if s is not None else NOOP_TRACER
+
+
+# --- cheap instrumentation helpers (no-op when disabled) --------------------
+
+
+def inc(name: str, n: int = 1) -> None:
+    s = _SESSION
+    if s is not None:
+        s.registry.counter(name).inc(n)
+
+
+def set_gauge(name: str, value: float) -> None:
+    s = _SESSION
+    if s is not None:
+        s.registry.gauge(name).set(value)
+
+
+def observe(name: str, value: float) -> None:
+    s = _SESSION
+    if s is not None:
+        s.registry.histogram(name).observe(value)
+
+
+def span(name: str, track: str = "main", **args):
+    """Context manager recording a live span (no-op when disabled)."""
+    return tracer().span(name, track=track, **args)
+
+
+def complete_span(name: str, t_start: float, t_end: float,
+                  track: str = "main", **args) -> None:
+    """Retrospective span from monotonic timestamps already in hand."""
+    s = _SESSION
+    if s is not None:
+        s.tracer.complete(name, t_start, t_end, track=track, **args)
+
+
+# --- CLI integration --------------------------------------------------------
+
+
+def add_telemetry_args(parser) -> None:
+    """The shared ``--telemetry`` flag block (trainers + generate --serve)."""
+    g = parser.add_argument_group("telemetry")
+    g.add_argument(
+        "--telemetry", action="store_true",
+        help="enable the metrics registry + span tracer; snapshots land "
+             "in the run dir's metrics.jsonl, the timeline in trace.json "
+             "(Perfetto-loadable)",
+    )
+    g.add_argument(
+        "--metrics_interval_s", type=float, default=10.0,
+        help="seconds between metrics.jsonl snapshots (with --telemetry)",
+    )
+    g.add_argument(
+        "--xla_profile_steps", type=str, default=None, metavar="A-B",
+        help="capture a jax.profiler trace over steps A..B inclusive "
+             "(e.g. 20-25); written under the run dir's xla_profile/",
+    )
+
+
+def configure_from_args(args, run_dir: Optional[str]) -> Optional[TelemetrySession]:
+    """Honor the ``add_telemetry_args`` flags; None when --telemetry is off."""
+    if not getattr(args, "telemetry", False):
+        return None
+    return configure(
+        run_dir=run_dir,
+        metrics_interval_s=getattr(args, "metrics_interval_s", 10.0),
+    )
+
+
+class XlaProfileWindow:
+    """Opt-in ``jax.profiler`` capture over a step window ``A-B``.
+
+    Call :meth:`on_step` once per training step *before* the step runs;
+    the window opens at step A and closes after step B (also via
+    :meth:`stop` on any exit path — the trace is never left dangling).
+    """
+
+    def __init__(self, start: Optional[int], end: Optional[int],
+                 log_dir: Optional[str]):
+        self.start = start
+        self.end = end
+        self.log_dir = log_dir
+        self._active = False
+
+    @classmethod
+    def from_arg(cls, spec: Optional[str],
+                 log_dir: Optional[str]) -> "XlaProfileWindow":
+        """Parse ``"A-B"`` (or a single ``"A"`` for a one-step window)."""
+        if not spec or log_dir is None:
+            return cls(None, None, None)
+        parts = spec.split("-")
+        try:
+            a = int(parts[0])
+            b = int(parts[1]) if len(parts) > 1 and parts[1] else a
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"--xla_profile_steps wants 'A-B' (or 'A'), got {spec!r}"
+            )
+        if b < a:
+            raise ValueError(
+                f"--xla_profile_steps window is backwards: {spec!r}"
+            )
+        return cls(a, b, str(log_dir))
+
+    def on_step(self, step: int) -> None:
+        if self.start is None:
+            return
+        if not self._active and self.start <= step <= self.end:
+            import jax
+
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            from dalle_tpu.training.logging import log_event
+
+            log_event("xla_profile_start", step=step, dir=self.log_dir)
+        elif self._active and step > self.end:
+            self.stop(step=step)
+
+    def stop(self, step: Optional[int] = None) -> None:
+        if not self._active:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._active = False
+        from dalle_tpu.training.logging import log_event
+
+        log_event("xla_profile_stop", step=step, dir=self.log_dir)

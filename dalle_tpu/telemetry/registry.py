@@ -1,0 +1,310 @@
+"""Low-overhead metrics registry: counters, gauges, fixed-bucket histograms.
+
+Design constraints (docs/OBSERVABILITY.md):
+
+* **thread-safe** — the serving scheduler, detok worker, checkpoint
+  writer thread, and data-watchdog pump all report concurrently;
+* **cheap when hot** — a counter ``inc`` is one lock + one int add; a
+  histogram ``observe`` is one ``bisect`` into a fixed edge tuple (no
+  allocation, no sorting, no unbounded memory);
+* **no-op when disabled** — a registry built with ``enabled=False``
+  hands out shared do-nothing instruments so instrumented code pays a
+  single attribute call on the cold path (pinned by the
+  ``telemetry_overhead`` bench rung and tests/test_telemetry.py).
+
+Histograms use *fixed* bucket edges chosen at creation: percentiles are
+read back by linear interpolation inside the owning bucket, with the
+exact observed min/max clamping the open-ended tails.  Accuracy is one
+bucket width — plenty for latency work, constant memory forever.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from bisect import bisect_right
+from typing import Dict, Optional, Sequence, Tuple
+
+# Log-spaced 10µs .. ~1000s, four buckets per decade: covers a Pallas
+# tick on TPU and a cold XLA compile with the same instrument.
+DEFAULT_TIME_BUCKETS: Tuple[float, ...] = tuple(
+    10.0 ** (k / 4.0) for k in range(-20, 13)
+)
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` only; read back via ``value``."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (queue depths, EWMAs, modeled bytes)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated percentile readout.
+
+    ``edges`` are the bucket upper bounds; observations land in the
+    first bucket whose upper bound exceeds them (one extra overflow
+    bucket catches the rest).  Exact ``min``/``max``/``sum``/``count``
+    are tracked alongside, so the open tails interpolate against real
+    observed extremes rather than ±inf.
+    """
+
+    __slots__ = ("name", "edges", "_counts", "_count", "_sum", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name: str, buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.edges: Tuple[float, ...] = tuple(
+            sorted(buckets if buckets is not None else DEFAULT_TIME_BUCKETS)
+        )
+        self._counts = [0] * (len(self.edges) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect_right(self.edges, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Interpolated percentile (numpy 'linear' rank convention, to
+        one bucket width).  None until something has been observed."""
+        with self._lock:
+            if self._count == 0:
+                return None
+            if self._count == 1:
+                return self._min
+            # fractional rank into the sorted (virtual) sample; the
+            # extreme ranks are exact (min/max are tracked), not
+            # interpolated out of their bucket
+            target = (p / 100.0) * (self._count - 1)
+            if target <= 0:
+                return self._min
+            if target >= self._count - 1:
+                return self._max
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                # bucket i covers virtual ranks [cum, cum + c)
+                if target < cum + c:
+                    lo = self.edges[i - 1] if i > 0 else self._min
+                    hi = self.edges[i] if i < len(self.edges) else self._max
+                    lo = max(lo, self._min)
+                    hi = min(hi, self._max)
+                    if c == 1 or hi <= lo:
+                        return min(max(lo, self._min), self._max)
+                    frac = (target - cum) / c
+                    return lo + frac * (hi - lo)
+                cum += c
+            return self._max  # p == 100 lands past the last rank
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self._count, self._sum
+            vmin, vmax = self._min, self._max
+        out = {"count": count, "sum": total, "min": vmin, "max": vmax}
+        for p in (50, 90, 99):
+            out[f"p{p}"] = self.percentile(p)
+        return out
+
+
+class _NoopCounter:
+    __slots__ = ()
+    name = "noop"
+    value = 0
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+
+class _NoopGauge:
+    __slots__ = ()
+    name = "noop"
+    value = None
+
+    def set(self, v: float) -> None:
+        pass
+
+
+class _NoopHistogram:
+    __slots__ = ()
+    name = "noop"
+    count = 0
+    sum = 0.0
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def percentile(self, p: float) -> Optional[float]:
+        return None
+
+    def snapshot(self) -> dict:
+        return {"count": 0, "sum": 0.0, "min": None, "max": None}
+
+
+NOOP_COUNTER = _NoopCounter()
+NOOP_GAUGE = _NoopGauge()
+NOOP_HISTOGRAM = _NoopHistogram()
+
+
+class MetricsRegistry:
+    """Named instrument store: get-or-create, thread-safe, snapshotable.
+
+    A disabled registry (``enabled=False``) returns shared no-op
+    instruments from every getter and snapshots to an empty dict — the
+    fast path for instrumented code is one call that does nothing.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        if not self.enabled:
+            return NOOP_COUNTER
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        if not self.enabled:
+            return NOOP_GAUGE
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        if not self.enabled:
+            return NOOP_HISTOGRAM
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, buckets)
+            return h
+
+    def snapshot(self) -> dict:
+        """One JSON-serializable view of everything registered."""
+        if not self.enabled:
+            return {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._histograms.values())
+        return {
+            "counters": {c.name: c.value for c in counters},
+            "gauges": {g.name: g.value for g in gauges
+                       if g.value is not None},
+            "histograms": {h.name: h.snapshot() for h in hists},
+        }
+
+
+class SnapshotWriter:
+    """Background thread appending registry snapshots to metrics.jsonl.
+
+    Snapshot lines carry ``"kind": "telemetry"`` so they coexist with the
+    Run's scalar records in the same file (tools/telemetry_report.py
+    reads both).  ``write_now()`` is also called synchronously by
+    ``telemetry.shutdown()`` so short runs always get a final snapshot.
+    """
+
+    def __init__(self, registry: MetricsRegistry, path: str,
+                 interval_s: float = 10.0):
+        self.registry = registry
+        self.path = str(path)
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def write_now(self) -> dict:
+        rec = {"_time": time.time(), "kind": "telemetry",
+               **self.registry.snapshot()}
+        with self._lock:
+            try:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass  # snapshots are best-effort; never kill the run
+        return rec
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.write_now()
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="telemetry-snapshot", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, final: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final:
+            self.write_now()

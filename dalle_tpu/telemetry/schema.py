@@ -1,0 +1,65 @@
+"""The structured-event schema: every ``log_event`` kind, in one table.
+
+``tools/check_events.py`` statically verifies that every
+``log_event("<kind>", ...)`` callsite in the tree uses a kind registered
+here (run as a tier-1 test), so event kinds cannot silently drift from
+docs/OBSERVABILITY.md — which renders this same table.
+
+Adding an event kind = add a row here + fire it.  The value is a short
+human description; the grouping comments mirror the subsystem that owns
+the emitter.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+EVENT_KINDS: Dict[str, str] = {
+    # --- training resilience (dalle_tpu/training/resilience.py) ----------
+    "anomaly_skip": "anomalous step detected; zero update applied in-step",
+    "anomaly_rollback": "consecutive anomalies; restored last intact "
+                        "checkpoint and replaying",
+    "preempt_requested": "SIGTERM/SIGINT observed; checkpoint-and-exit "
+                         "requested",
+    "preempt_checkpoint": "preemption checkpoint written before exit",
+    # --- data pipeline (dalle_tpu/data/) ---------------------------------
+    "data_fast_forward": "resume: dataloader fast-forwarded past "
+                         "already-trained batches",
+    "data_fast_forward_short": "resume fast-forward hit end of loader "
+                               "before reaching the target batch",
+    "data_watchdog_stall": "dataloader produced no batch within the "
+                           "watchdog timeout",
+    "data_watchdog_abort": "dataloader stalled past the abort budget; "
+                           "training aborted",
+    "data_sample_quarantined": "undecodable/corrupt sample skipped and "
+                               "quarantined",
+    "wds_shard_retry": "webdataset shard read failed; retrying",
+    "wds_shard_quarantined": "webdataset shard failed past the retry "
+                             "budget; quarantined",
+    # --- checkpointing (dalle_tpu/training/checkpoint.py) ----------------
+    "ckpt_retry": "checkpoint write hit a transient OSError; backing off "
+                  "and retrying",
+    "ckpt_corrupt_skipped": "resume skipped a checkpoint missing its "
+                            "intact marker / metadata / subtrees",
+    # --- serving (dalle_tpu/serving/) ------------------------------------
+    "serve_shed": "admission control shed a request (queue full)",
+    "serve_evicted": "mid-flight eviction: in-flight deadline provably "
+                     "unmeetable",
+    "serve_degraded": "queue pressure escalated the service tier "
+                      "(skip CLIP / skip detok)",
+    "serve_restored": "queue pressure relaxed the service tier",
+    "engine_crash": "decode engine raised mid-tick; supervisor engaged",
+    "engine_restart": "engine state rebuilt; in-flight requests "
+                      "deterministically replayed",
+    "serve_summary": "final Scheduler.stats() emitted at serve shutdown "
+                     "(clean or supervisor-exhausted)",
+    # --- telemetry / profiling (dalle_tpu/telemetry/) --------------------
+    "telemetry_enabled": "telemetry session configured (run dir, "
+                         "snapshot interval)",
+    "xla_profile_start": "jax.profiler trace capture window opened",
+    "xla_profile_stop": "jax.profiler trace capture window closed",
+}
+
+
+def is_known_kind(kind: str) -> bool:
+    return kind in EVENT_KINDS

@@ -1,0 +1,158 @@
+"""Span-based tracing with a ring-buffer sink and Chrome-trace export.
+
+The model is deliberately small: three event shapes, all timestamped on
+``time.monotonic()``:
+
+* **complete spans** — a named interval on a *track* (one track per
+  logical thread of activity: scheduler, each decode slot, the detok
+  worker, the checkpoint writer).  Recorded either live via the
+  ``span()`` context manager or retrospectively via ``complete()`` from
+  timestamps already stamped on a Request (queue wait, decode
+  occupancy) — retrospective recording is what keeps decode at *zero*
+  per-tick tracing cost: one span per request, with tick counts and the
+  tick-time EWMA attached as args, not one span per tick.
+* **instant events** — point markers (shed, evict, crash, restart, any
+  ``log_event`` kind when telemetry is on).
+
+The sink is a bounded deque (default 64k events): a long serving run
+keeps the most recent window instead of growing without bound.
+``export_chrome_trace()`` writes the Chrome trace-event JSON format
+(``{"traceEvents": [...]}``, "X"/"i" phases, µs timestamps) which
+https://ui.perfetto.dev loads directly — see docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+def _clean_args(args: dict) -> dict:
+    """Keep only JSON-trivial arg values (spans must never hold arrays)."""
+    return {
+        k: v for k, v in args.items()
+        if v is None or isinstance(v, (bool, int, float, str))
+    }
+
+
+class Tracer:
+    """Ring-buffered trace recorder.  All methods are thread-safe; a
+    disabled tracer records nothing (every call is a cheap early
+    return)."""
+
+    def __init__(self, capacity: int = 65536, enabled: bool = True,
+                 process: str = "dalle_tpu"):
+        self.enabled = bool(enabled)
+        self.process = process
+        self._buf: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._tracks: Dict[str, int] = {}
+        self._t0 = time.monotonic()  # export origin: ts are relative
+
+    # --- recording -------------------------------------------------------
+    def _tid(self, track: str) -> int:
+        tid = self._tracks.get(track)
+        if tid is None:
+            tid = self._tracks[track] = len(self._tracks)
+        return tid
+
+    def complete(self, name: str, t_start: float, t_end: float,
+                 track: str = "main", **args) -> None:
+        """Record a finished interval from monotonic timestamps."""
+        if not self.enabled:
+            return
+        rec = {
+            "ph": "X", "name": name, "track": track,
+            "ts": t_start, "dur": max(0.0, t_end - t_start),
+            "args": _clean_args(args),
+        }
+        with self._lock:
+            self._tid(track)
+            self._buf.append(rec)
+
+    def instant(self, name: str, track: str = "events", **args) -> None:
+        if not self.enabled:
+            return
+        rec = {
+            "ph": "i", "name": name, "track": track,
+            "ts": time.monotonic(), "args": _clean_args(args),
+        }
+        with self._lock:
+            self._tid(track)
+            self._buf.append(rec)
+
+    @contextmanager
+    def span(self, name: str, track: str = "main", **args):
+        """Live span: records the interval on exit, exceptions included
+        (the span closes with an ``error`` arg and the exception
+        propagates — nesting stays well-formed under throws)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        except BaseException as e:
+            self.complete(name, t0, time.monotonic(), track=track,
+                          error=f"{type(e).__name__}: {e}", **args)
+            raise
+        self.complete(name, t0, time.monotonic(), track=track, **args)
+
+    # --- readout ---------------------------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON object (load at ui.perfetto.dev)."""
+        with self._lock:
+            events = list(self._buf)
+            tracks = dict(self._tracks)
+        pid = 1
+        out: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": self.process},
+        }]
+        for track, tid in sorted(tracks.items(), key=lambda kv: kv[1]):
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": track},
+            })
+        body = []
+        for e in events:
+            ts_us = max(0.0, (e["ts"] - self._t0) * 1e6)
+            rec = {
+                "ph": e["ph"], "name": e["name"], "pid": pid,
+                "tid": self._tid_frozen(tracks, e["track"]),
+                "ts": round(ts_us, 3), "args": e["args"],
+            }
+            if e["ph"] == "X":
+                rec["dur"] = round(e["dur"] * 1e6, 3)
+            if e["ph"] == "i":
+                rec["s"] = "t"  # thread-scoped instant
+            body.append(rec)
+        body.sort(key=lambda r: r["ts"])
+        return {"traceEvents": out + body,
+                "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _tid_frozen(tracks: Dict[str, int], track: str) -> int:
+        # events recorded before export always registered their track
+        return tracks.get(track, 0)
+
+    def export_chrome_trace(self, path: str) -> str:
+        path = str(path)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+NOOP_TRACER = Tracer(capacity=1, enabled=False)

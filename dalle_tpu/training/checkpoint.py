@@ -265,10 +265,14 @@ class AsyncCheckpointWriter:
         def work():
             import time
 
+            from dalle_tpu import telemetry
+
+            t_w0 = time.monotonic()
             try:
                 for attempt in range(1, self.retries + 2):
                     try:
                         save_checkpoint(path, **host_kwargs)
+                        telemetry.inc("ckpt_saves_done")
                         return
                     except OSError as e:
                         if attempt > self.retries:
@@ -281,6 +285,13 @@ class AsyncCheckpointWriter:
                         time.sleep(delay)
             except BaseException as e:  # re-raised on the main thread
                 self._error = e
+            finally:
+                t_w1 = time.monotonic()
+                telemetry.observe("ckpt_write_s", t_w1 - t_w0)
+                telemetry.complete_span("ckpt_write", t_w0, t_w1,
+                                        track="ckpt-writer",
+                                        path=str(path))
+                telemetry.set_gauge("ckpt_writer_depth", 0)
 
         # non-daemon: the thread isn't killed mid-write at interpreter
         # exit.  That is necessary but NOT sufficient for a clean
@@ -295,6 +306,12 @@ class AsyncCheckpointWriter:
         )
         import atexit
 
+        from dalle_tpu import telemetry
+
+        telemetry.inc("ckpt_saves_started")
+        # the writer is depth-1 (save() waits for the previous write), so
+        # the queue-depth gauge is 1 while a write is in flight, 0 idle
+        telemetry.set_gauge("ckpt_writer_depth", 1)
         atexit.register(self._report_pending_error)
         self._thread.start()
 

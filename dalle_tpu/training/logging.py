@@ -13,7 +13,10 @@ Root-worker gating is the caller's job, same idiom as the reference
 
 from __future__ import annotations
 
+import atexit
 import json
+import os
+import sys
 import threading
 import time
 from pathlib import Path
@@ -28,17 +31,43 @@ import numpy as np
 # post-mortems read one JSONL file instead of scraping stdout.  Events
 # fired before a Run exists (e.g. --auto_resume rejecting a corrupted
 # checkpoint during startup) buffer in memory and flush into events.jsonl
-# when the Run opens it.
+# when the Run opens it.  If the process exits before any sink binds —
+# a startup crash is exactly when those events matter most — an atexit
+# hook flushes the buffer to a fallback file (DALLE_EVENTS_FALLBACK, or
+# ./events.jsonl) or, failing that, stderr.
+#
+# Hooks (add_event_hook) observe every event as it is logged; the
+# telemetry layer uses one to count event kinds and drop instant markers
+# on the trace timeline (dalle_tpu/telemetry).  Hooks run outside the
+# sink lock and must never raise into the caller.
 
 _EVENT_LOCK = threading.Lock()
 _EVENT_SINK = None  # open file handle, bound by Run (or set_event_sink)
 _PENDING_EVENTS: list = []
 _PENDING_CAP = 1000
+_EVENT_HOOKS: list = []
+_ATEXIT_REGISTERED = False
+
+
+def add_event_hook(fn) -> None:
+    """Register ``fn(record: dict)`` to observe every logged event."""
+    with _EVENT_LOCK:
+        if fn not in _EVENT_HOOKS:
+            _EVENT_HOOKS.append(fn)
+
+
+def remove_event_hook(fn) -> None:
+    with _EVENT_LOCK:
+        try:
+            _EVENT_HOOKS.remove(fn)
+        except ValueError:
+            pass
 
 
 def log_event(kind: str, **fields) -> dict:
     """Append one structured event to the run's events.jsonl (buffered
     until a Run binds the sink).  Thread-safe; never raises."""
+    global _ATEXIT_REGISTERED
     rec = {"_time": time.time(), "kind": kind, **fields}
     with _EVENT_LOCK:
         if _EVENT_SINK is not None:
@@ -49,7 +78,39 @@ def log_event(kind: str, **fields) -> dict:
                 pass  # closed/broken sink: the event is best-effort
         elif len(_PENDING_EVENTS) < _PENDING_CAP:
             _PENDING_EVENTS.append(rec)
+            if not _ATEXIT_REGISTERED:
+                atexit.register(flush_pending_events)
+                _ATEXIT_REGISTERED = True
+        hooks = list(_EVENT_HOOKS)
+    for fn in hooks:
+        try:
+            fn(rec)
+        except Exception:
+            pass  # an observer must never break the emitter
     return rec
+
+
+def flush_pending_events(path: Optional[str] = None) -> int:
+    """Write events still buffered without a sink to a fallback file
+    (``path``, else ``$DALLE_EVENTS_FALLBACK``, else ``./events.jsonl``),
+    degrading to stderr.  Returns the number flushed.  Registered via
+    atexit on first buffered event and called from the resilience exit
+    path, so pre-Run events are never silently lost."""
+    with _EVENT_LOCK:
+        if not _PENDING_EVENTS:
+            return 0
+        pending, _PENDING_EVENTS[:] = list(_PENDING_EVENTS), []
+    target = path or os.environ.get("DALLE_EVENTS_FALLBACK", "events.jsonl")
+    lines = "".join(json.dumps(rec) + "\n" for rec in pending)
+    try:
+        with open(target, "a") as f:
+            f.write(lines)
+    except OSError:
+        try:
+            sys.stderr.write(lines)
+        except (ValueError, OSError):
+            return 0
+    return len(pending)
 
 
 def set_event_sink(fh) -> None:

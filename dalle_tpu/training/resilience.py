@@ -169,6 +169,9 @@ class Resilience:
             self.consecutive_skips = 0
             return "ok"
         self.consecutive_skips += 1
+        from dalle_tpu import telemetry
+
+        telemetry.inc("train_anomaly_skips")
         log_event(
             "anomaly_skip", step=step, loss=loss, grad_norm=grad_norm,
             consecutive=self.consecutive_skips,
@@ -192,6 +195,9 @@ class Resilience:
         in a row landing on the same step means replay is deterministic
         and the run cannot make progress."""
         self.rollbacks += 1
+        from dalle_tpu import telemetry
+
+        telemetry.inc("train_anomaly_rollbacks")
         self.detector = SpikeDetector(
             self.detector.zscore, self.detector._window.maxlen,
             self.detector.min_warm,
@@ -255,6 +261,13 @@ class Resilience:
         if self._trace_fh is not None:
             self._trace_fh.close()
             self._trace_fh = None
+        # the trainers' finally-block runs through here on every exit —
+        # preemption included — so events fired before a Run bound the
+        # sink (startup crashes, early --auto_resume rejections) reach
+        # the fallback file even if the atexit hook never gets a chance
+        from dalle_tpu.training.logging import flush_pending_events
+
+        flush_pending_events()
 
 
 def skip_batches(it, n: int, label: str = "resume") -> int:

@@ -133,6 +133,12 @@ def parse_args(argv=None):
                              "then skip VAE detok — codes only) with "
                              "hysteresis; serve_degraded/serve_restored "
                              "events record every transition")
+    # shared observability surface (docs/OBSERVABILITY.md): --telemetry
+    # writes metrics.jsonl + a Perfetto-loadable trace.json under
+    # <outputs_dir>/serve/telemetry/
+    from dalle_tpu import telemetry as _telemetry
+
+    _telemetry.add_telemetry_args(parser)
     parser.add_argument("--num_images", type=int, default=128)
     parser.add_argument("--batch_size", type=int, default=4)
     parser.add_argument("--top_k", type=float, default=0.9,
@@ -494,6 +500,13 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
     outdir = Path(args.outputs_dir) / "serve"
     outdir.mkdir(parents=True, exist_ok=True)
 
+    # --telemetry: metrics.jsonl + trace.json under serve/telemetry/.
+    # Configure BEFORE the engine/queue/scheduler are built so the
+    # Scheduler picks the session registry up as its default
+    from dalle_tpu import telemetry
+
+    tel = telemetry.configure_from_args(args, str(outdir / "telemetry"))
+
     from PIL import Image
 
     def on_result(req):
@@ -591,10 +604,29 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
 
         th = threading.Thread(target=feeder, daemon=True)
         th.start()
-        stats = sched.run()
-        th.join()
-        print(json.dumps(stats))
+        try:
+            sched.run()
+            th.join()
+        finally:
+            # surface the final stats on EVERY exit path — clean drain
+            # AND supervisor exhaustion (the crash-budget re-raise): one
+            # structured serve_summary event plus the stats JSON on
+            # stdout, so an operator never loses the run's accounting
+            from dalle_tpu.training.logging import log_event
+
+            stats = sched.stats()
+            log_event("serve_summary", **stats)
+            print(json.dumps(stats))
     finally:
+        trace_path = telemetry.shutdown()
+        if tel is not None:
+            # land buffered events next to metrics.jsonl/trace.json
+            # rather than the cwd fallback
+            from dalle_tpu.training.logging import flush_pending_events
+
+            flush_pending_events(str(outdir / "telemetry" / "events.jsonl"))
+            print(f"telemetry: {outdir / 'telemetry'} "
+                  f"(trace: {trace_path})")
         stack.close()
 
 

@@ -7,8 +7,15 @@ semantics on CPU, so collectives and shardings are tested for real.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests fire log_event freely without binding a Run; route the atexit
+# pending-event flush (training/logging.py) away from the repo root.
+os.environ.setdefault(
+    "DALLE_EVENTS_FALLBACK",
+    os.path.join(tempfile.gettempdir(), "dalle_tpu_test_events.jsonl"),
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

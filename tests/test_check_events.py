@@ -1,0 +1,68 @@
+"""tools/check_events.py — the event-schema static check, run as tier-1.
+
+The real assertion is the first test: every ``log_event`` kind in THIS
+tree is registered in dalle_tpu/telemetry/schema.py.  A new event kind
+added without a schema entry fails tier-1 here, not in some consumer's
+dashboard three weeks later.
+"""
+
+import os
+import textwrap
+
+from tools.check_events import check_events
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_event_kinds_all_registered():
+    assert check_events(REPO_ROOT) == []
+
+
+def _mk_tree(tmp_path, body):
+    (tmp_path / "dalle_tpu").mkdir()
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "mod.py").write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_detects_unknown_kind(tmp_path):
+    root = _mk_tree(tmp_path, """
+        log_event("definitely_not_a_kind", x=1)
+    """)
+    problems = check_events(root)
+    assert len(problems) == 1
+    assert "definitely_not_a_kind" in problems[0]
+    assert "mod.py:2" in problems[0]
+
+
+def test_detects_non_literal_kind_outside_forwarder(tmp_path):
+    root = _mk_tree(tmp_path, """
+        kind = "serve_shed"
+        log_event(kind, x=1)
+        run.log_event(kind)
+    """)
+    problems = check_events(root)
+    assert len(problems) == 2
+    assert all("non-literal" in p for p in problems)
+
+
+def test_known_kinds_and_method_calls_pass(tmp_path):
+    root = _mk_tree(tmp_path, """
+        log_event("serve_shed", request_id="r")
+        run.log_event("engine_crash", error="e")
+    """)
+    assert check_events(root) == []
+
+
+def test_forwarder_is_exempt(tmp_path):
+    root = _mk_tree(tmp_path, "")
+    fwd = tmp_path / "dalle_tpu" / "training"
+    fwd.mkdir(parents=True)
+    (fwd / "logging.py").write_text("def f(kind):\n    log_event(kind)\n")
+    assert check_events(root) == []
+
+
+def test_bare_call_is_flagged(tmp_path):
+    root = _mk_tree(tmp_path, "log_event()\n")
+    problems = check_events(root)
+    assert len(problems) == 1 and "no kind" in problems[0]

@@ -1,0 +1,386 @@
+"""Telemetry pins (dalle_tpu/telemetry/, docs/OBSERVABILITY.md).
+
+What these tests nail down:
+
+* histogram percentiles against a numpy oracle (fixed-bucket
+  interpolation is accurate to one bucket width, min/max exact);
+* span nesting stays well-formed when the body throws (both spans
+  recorded, ``error`` attached, exception propagates);
+* the Chrome-trace export is valid JSON with metadata, sorted
+  timestamps, and µs durations — i.e. Perfetto-loadable;
+* registry counters reconcile EXACTLY with ``request_stats``/
+  ``Scheduler.stats()`` on a replayed arrival trace (the operator's
+  two views of one run can never disagree);
+* the disabled path is a no-op: without a configured session every
+  helper does nothing and hands out the shared noop instruments;
+* pre-Run buffered ``log_event`` records flush to the fallback file
+  (satellite: startup crashes keep their evidence).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_tpu import telemetry
+from dalle_tpu.telemetry.registry import (
+    NOOP_COUNTER,
+    NOOP_GAUGE,
+    NOOP_HISTOGRAM,
+    Histogram,
+    MetricsRegistry,
+    SnapshotWriter,
+)
+from dalle_tpu.telemetry.tracing import NOOP_TRACER, Tracer
+
+
+@pytest.fixture(autouse=True)
+def _no_session_leak():
+    """Every test starts and ends without a global telemetry session."""
+    telemetry.shutdown()
+    yield
+    telemetry.shutdown()
+
+
+# --- registry ------------------------------------------------------------
+
+
+def test_counter_and_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("c") is c  # get-or-create
+    g = reg.gauge("g")
+    assert g.value is None
+    g.set(2)
+    g.set(7.5)
+    assert g.value == 7.5
+    snap = reg.snapshot()
+    assert snap["counters"] == {"c": 5}
+    assert snap["gauges"] == {"g": 7.5}
+
+
+def test_histogram_percentiles_match_numpy_oracle():
+    # unit-width buckets over [0, 100): interpolation error is bounded
+    # by one bucket width; allow 1.5 for edge effects
+    edges = [float(x) for x in range(0, 101)]
+    h = Histogram("lat", buckets=edges)
+    vals = np.random.RandomState(0).uniform(0.0, 100.0, size=500)
+    for v in vals:
+        h.observe(float(v))
+    for p in (1, 10, 50, 90, 99):
+        want = np.percentile(vals, p)
+        got = h.percentile(p)
+        assert abs(got - want) <= 1.5, (p, got, want)
+    assert h.count == 500
+    assert h.sum == pytest.approx(vals.sum())
+
+
+def test_histogram_min_max_exact_and_edge_cases():
+    h = Histogram("lat", buckets=[1.0, 10.0])
+    assert h.percentile(50) is None  # empty
+    h.observe(3.25)
+    assert h.percentile(50) == 3.25  # single observation: exact
+    h.observe(0.125)   # underflow bucket
+    h.observe(250.0)   # overflow bucket
+    snap = h.snapshot()
+    assert snap["min"] == 0.125 and snap["max"] == 250.0
+    assert snap["count"] == 3
+    # tails clamp to observed extremes, never +-inf
+    assert 0.125 <= h.percentile(1) <= 250.0
+    assert h.percentile(100) == 250.0
+
+
+def test_default_buckets_cover_latency_range():
+    h = Histogram("t")
+    for v in (1e-5, 1e-3, 0.1, 5.0, 900.0):
+        h.observe(v)
+    p50 = h.percentile(50)
+    assert 1e-5 <= p50 <= 900.0
+
+
+def test_disabled_registry_hands_out_noop_singletons():
+    reg = MetricsRegistry(enabled=False)
+    assert reg.counter("x") is NOOP_COUNTER
+    assert reg.gauge("x") is NOOP_GAUGE
+    assert reg.histogram("x") is NOOP_HISTOGRAM
+    reg.counter("x").inc(100)
+    reg.gauge("x").set(3)
+    reg.histogram("x").observe(1.0)
+    assert reg.counter("x").value == 0
+    assert reg.snapshot() == {
+        "counters": {}, "gauges": {}, "histograms": {},
+    }
+
+
+def test_snapshot_writer_appends_telemetry_lines(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("n").inc(3)
+    path = tmp_path / "metrics.jsonl"
+    w = SnapshotWriter(reg, str(path), interval_s=60.0)
+    w.write_now()
+    reg.counter("n").inc()
+    w.stop(final=True)  # never started: stop still writes the final
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(recs) == 2
+    assert all(r["kind"] == "telemetry" for r in recs)
+    assert recs[0]["counters"]["n"] == 3
+    assert recs[1]["counters"]["n"] == 4
+
+
+# --- tracer --------------------------------------------------------------
+
+
+def test_span_nesting_well_formed_under_exceptions():
+    tr = Tracer()
+    with pytest.raises(ValueError, match="boom"):
+        with tr.span("outer", track="t", tag=1):
+            with tr.span("inner", track="t"):
+                raise ValueError("boom")
+    evs = tr.events()
+    assert [e["name"] for e in evs] == ["inner", "outer"]
+    for e in evs:
+        assert e["args"]["error"] == "ValueError: boom"
+    inner, outer = evs
+    # containment: the outer interval encloses the inner one
+    assert outer["ts"] <= inner["ts"]
+    assert (outer["ts"] + outer["dur"]
+            >= inner["ts"] + inner["dur"])
+    assert outer["args"]["tag"] == 1  # user args survive the throw
+
+
+def test_span_records_clean_exit_without_error_arg():
+    tr = Tracer()
+    with tr.span("ok", track="t", request_id="r1"):
+        pass
+    (e,) = tr.events()
+    assert "error" not in e["args"]
+    assert e["args"]["request_id"] == "r1"
+    assert e["dur"] >= 0
+
+
+def test_chrome_trace_export_is_valid_and_sorted(tmp_path):
+    tr = Tracer(process="testproc")
+    with tr.span("a", track="alpha"):
+        pass
+    tr.complete("b", 1.0, 2.5, track="beta", slot=3)
+    tr.instant("mark", track="events", kind="x")
+    path = tr.export_chrome_trace(str(tmp_path / "trace.json"))
+    trace = json.loads(open(path).read())  # round-trips as JSON
+    evs = trace["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    body = [e for e in evs if e["ph"] != "M"]
+    assert any(e["name"] == "process_name"
+               and e["args"]["name"] == "testproc" for e in meta)
+    named_tids = {e["tid"] for e in meta if e["name"] == "thread_name"}
+    assert {e["tid"] for e in body} <= named_tids
+    assert all("pid" in e and "ts" in e for e in body)
+    ts = [e["ts"] for e in body]
+    assert ts == sorted(ts)
+    (b,) = [e for e in body if e["name"] == "b"]
+    assert b["dur"] == pytest.approx(1.5e6)  # seconds -> µs
+    (i,) = [e for e in body if e["ph"] == "i"]
+    assert i["s"] == "t"
+
+
+def test_tracer_ring_buffer_keeps_most_recent():
+    tr = Tracer(capacity=4)
+    for k in range(10):
+        tr.instant(f"e{k}")
+    assert [e["name"] for e in tr.events()] == ["e6", "e7", "e8", "e9"]
+
+
+def test_args_cleaned_to_json_scalars():
+    tr = Tracer()
+    tr.instant("m", track="t", ok=1, arr=np.zeros(3), d={"x": 1},
+               s="str", none=None)
+    (e,) = tr.events()
+    assert set(e["args"]) == {"ok", "s", "none"}
+
+
+# --- module session / disabled no-op pins --------------------------------
+
+
+def test_disabled_module_helpers_are_noops():
+    assert not telemetry.enabled()
+    assert telemetry.registry().counter("x") is NOOP_COUNTER
+    assert telemetry.tracer() is NOOP_TRACER
+    telemetry.inc("x", 5)
+    telemetry.observe("h", 1.0)
+    telemetry.set_gauge("g", 2.0)
+    with telemetry.span("s", track="t"):
+        pass
+    telemetry.complete_span("c", 0.0, 1.0)
+    assert telemetry.registry().snapshot() == {
+        "counters": {}, "gauges": {}, "histograms": {},
+    }
+    assert telemetry.tracer().events() == []
+    # disabled spans still propagate exceptions
+    with pytest.raises(RuntimeError):
+        with telemetry.span("s2"):
+            raise RuntimeError("through")
+
+
+def test_configure_shutdown_roundtrip(tmp_path):
+    run_dir = tmp_path / "run"
+    telemetry.configure(str(run_dir), metrics_interval_s=60.0)
+    assert telemetry.enabled()
+    telemetry.inc("foo", 2)
+    telemetry.observe("lat_s", 0.25)
+    telemetry.set_gauge("depth", 1)
+    with telemetry.span("work", track="w"):
+        pass
+    # log_event hook: kind counter + instant marker on the timeline
+    from dalle_tpu.training.logging import log_event
+
+    log_event("serve_shed", request_id="t0")
+    assert telemetry.registry().counter("events_serve_shed").value == 1
+    trace_path = telemetry.shutdown()
+    assert not telemetry.enabled()
+    assert telemetry.shutdown() is None  # idempotent
+
+    trace = json.load(open(trace_path))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"work", "serve_shed", "telemetry_enabled"} <= names
+    snaps = [json.loads(l)
+             for l in (run_dir / "metrics.jsonl").read_text().splitlines()]
+    last = snaps[-1]
+    assert last["kind"] == "telemetry"
+    assert last["counters"]["foo"] == 2
+    assert last["counters"]["events_serve_shed"] == 1
+    assert last["gauges"]["depth"] == 1.0
+    assert last["histograms"]["lat_s"]["count"] == 1
+
+
+def test_xla_profile_window_parsing(tmp_path):
+    W = telemetry.XlaProfileWindow
+    w = W.from_arg(None, str(tmp_path))
+    assert w.start is None
+    w = W.from_arg("3-5", str(tmp_path))
+    assert (w.start, w.end) == (3, 5)
+    w = W.from_arg("7", str(tmp_path))
+    assert (w.start, w.end) == (7, 7)
+    with pytest.raises(ValueError):
+        W.from_arg("5-3", str(tmp_path))
+    with pytest.raises(ValueError):
+        W.from_arg("abc", str(tmp_path))
+
+
+# --- counters vs stats on a replayed trace -------------------------------
+
+
+def _tiny_model(rng):
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+    cfg = DALLEConfig(
+        num_text_tokens=30, text_seq_len=4, num_image_tokens=20,
+        dim=32, depth=2, heads=2, dim_head=16, image_fmap_size=2,
+    )
+    text = jax.random.randint(rng, (2, 4), 1, 30)
+    codes = jax.random.randint(rng, (2, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    return model, params
+
+
+def test_replay_counters_reconcile_with_stats(rng):
+    """The registry's request counters and the stats() dict are two
+    views of the same run — pinned equal on a replayed trace with
+    sheds in play (max_pending=1 against a burst)."""
+    from dalle_tpu.serving import make_poisson_trace, replay_trace
+
+    model, params = _tiny_model(rng)
+    cfg = model.cfg
+    trace = make_poisson_trace(
+        6, rate_hz=1000.0, text_seq_len=cfg.text_seq_len,
+        num_text_tokens=cfg.num_text_tokens, seed=3,
+    )
+    reg = MetricsRegistry()
+    stats = replay_trace(
+        model, params, trace, num_slots=2, filter_thres=0.0,
+        max_pending=1, shed_policy="reject", metrics=reg,
+    )
+    c = reg.snapshot()["counters"]
+    assert c["serve_completed"] == stats["served"]
+    assert c["serve_failed"] == stats["dropped"]
+    assert c["serve_admitted"] == stats["admitted"]
+    assert c["serve_shed"] == stats["shed"]
+    assert c["serve_evicted"] == stats["evicted_midflight"]
+    # conservation: every submitted request was admitted or shed
+    assert c["serve_submitted"] == c["serve_admitted"] + c["serve_shed"]
+    assert stats["served"] > 0
+    # latency histograms populated for everything that decoded
+    h = reg.snapshot()["histograms"]
+    assert h["serve_decode_s"]["count"] == stats["served"]
+    assert h["serve_queue_wait_s"]["count"] == stats["admitted"]
+
+
+# --- pre-Run event buffering (satellite) ---------------------------------
+
+
+def test_pending_events_flush_to_fallback(tmp_path, monkeypatch):
+    from dalle_tpu.training import logging as tlog
+
+    tlog.set_event_sink(None)
+    tlog.flush_pending_events()  # drain anything earlier tests buffered
+    fallback = tmp_path / "ev.jsonl"
+    monkeypatch.setenv("DALLE_EVENTS_FALLBACK", str(fallback))
+    tlog.log_event("serve_summary", served=3)
+    assert tlog.pending_events()  # buffered: no sink bound
+    assert tlog.flush_pending_events() == 1
+    (rec,) = [json.loads(l) for l in fallback.read_text().splitlines()]
+    assert rec["kind"] == "serve_summary" and rec["served"] == 3
+    assert tlog.flush_pending_events() == 0  # drained
+
+
+def test_pending_events_flush_explicit_path_wins(tmp_path, monkeypatch):
+    from dalle_tpu.training import logging as tlog
+
+    tlog.set_event_sink(None)
+    tlog.flush_pending_events()
+    monkeypatch.setenv("DALLE_EVENTS_FALLBACK", str(tmp_path / "env.jsonl"))
+    tlog.log_event("engine_crash", error="x")
+    target = tmp_path / "explicit.jsonl"
+    assert tlog.flush_pending_events(str(target)) == 1
+    assert target.exists()
+    assert not (tmp_path / "env.jsonl").exists()
+
+
+# --- report rendering ----------------------------------------------------
+
+
+def test_render_report_over_synthesized_run(tmp_path):
+    from tools.telemetry_report import render_report
+
+    reg = MetricsRegistry()
+    reg.counter("serve_completed").inc(4)
+    reg.gauge("train_mfu").set(0.31)
+    reg.histogram("serve_ttlt_s").observe(0.5)
+    SnapshotWriter(reg, str(tmp_path / "metrics.jsonl")).write_now()
+    with open(tmp_path / "metrics.jsonl", "a") as f:
+        f.write(json.dumps({"_time": 1.0, "step": 7, "loss": 2.5}) + "\n")
+    with open(tmp_path / "events.jsonl", "w") as f:
+        f.write(json.dumps({"_time": 1.0, "kind": "serve_shed"}) + "\n")
+    tr = Tracer()
+    with tr.span("decode", track="slot0"):
+        pass
+    tr.export_chrome_trace(str(tmp_path / "trace.json"))
+
+    out = render_report(str(tmp_path))
+    for needle in ("serve_completed", "train_mfu", "serve_ttlt_s",
+                   "loss", "serve_shed", "slot0", "perfetto"):
+        assert needle in out, needle
+
+
+def test_render_report_empty_dir_is_graceful(tmp_path):
+    from tools.telemetry_report import render_report
+
+    out = render_report(str(tmp_path))
+    assert "no telemetry snapshots" in out
+    assert "no events.jsonl" in out
+    assert "no trace.json" in out

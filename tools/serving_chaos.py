@@ -2,7 +2,7 @@
 """Serving chaos harness: prove the serving stack is overload-safe and
 crash-tolerant (docs/SERVING.md "Overload & failure semantics").
 
-Three scenarios against the continuous-batching engine (tiny
+Four scenarios against the continuous-batching engine (tiny
 randomly-initialized model — the properties under test are host-side
 protocol guarantees, not model quality):
 
@@ -18,6 +18,10 @@ protocol guarantees, not model quality):
    against a bounded queue: pending never exceeds ``max_pending``, the
    excess is shed with structured errors, and the p99 TTLT of *admitted*
    requests stays within ``p99_gate`` (2x) of the unflooded baseline.
+4. **telemetry** — an over-bound burst under a live ``--telemetry``
+   session: the exported ``trace.json`` is Perfetto-loadable and the
+   ``metrics.jsonl`` request counters reconcile exactly with
+   ``Scheduler.stats()`` (docs/OBSERVABILITY.md).
 
 Run directly (``python tools/serving_chaos.py``), as the
 ``serving_resilience`` bench rung, or via
@@ -258,17 +262,102 @@ def scenario_flood(model, params, *, slots=4, max_pending=2, n_base=8,
     }
 
 
-def run_serving_chaos(*, slots=3, n_req=6, p99_gate=2.0) -> dict:
-    """All three scenarios; ``ok`` iff every gate holds."""
+def scenario_telemetry(model, params, *, slots=3, n_req=10, max_pending=2,
+                       run_dir=None) -> dict:
+    """--telemetry smoke (docs/OBSERVABILITY.md): serve an over-bound
+    burst under a live telemetry session; the exported ``trace.json``
+    must be Chrome-trace valid (Perfetto-loadable) and the final
+    ``metrics.jsonl`` snapshot's request counters must reconcile
+    EXACTLY with the ``Scheduler.stats()`` the operator sees."""
+    import tempfile
+
+    from dalle_tpu import telemetry
+    from dalle_tpu.serving import DecodeEngine, RequestQueue, Scheduler
+
+    cfg = model.cfg
+    run_dir = run_dir or tempfile.mkdtemp(prefix="dalle_tel_smoke_")
+    telemetry.configure(run_dir, metrics_interval_s=60.0)
+    try:
+        engine = DecodeEngine(
+            model, params, num_slots=slots,
+            filter_thres=GREEDY["filter_thres"],
+        )
+        engine.warmup()
+        # the queue carries the registry from birth so burst-time sheds
+        # (before the Scheduler exists) are counted too
+        q = RequestQueue(max_pending=max_pending, shed_policy="reject",
+                         metrics=telemetry.registry())
+        reqs = _mk_requests(cfg, n_req)
+        for r in reqs:
+            q.submit(r)
+        q.close()
+        sched = Scheduler(engine, q, policy="continuous")
+        stats = sched.run()
+    finally:
+        trace_path = telemetry.shutdown()
+
+    # trace validity: parses as Chrome-trace JSON, every event has a
+    # phase, and the serve lifecycle spans made it in
+    with open(trace_path) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    names = {e.get("name") for e in events}
+    trace_ok = (
+        bool(events)
+        and all("ph" in e and "pid" in e for e in events)
+        and {"decode", "queue_wait"} <= names
+    )
+
+    # metrics.jsonl: the final snapshot's counters vs stats() — exact
+    counters = {}
+    with open(os.path.join(run_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "telemetry":
+                counters = rec["counters"]
+    pairs = {
+        "serve_admitted": stats["admitted"],
+        "serve_completed": stats["served"],
+        "serve_failed": stats["dropped"],
+        "serve_shed": stats["shed"],
+        "serve_evicted": stats["evicted_midflight"],
+    }
+    mismatches = {
+        k: {"counter": counters.get(k, 0), "stats": want}
+        for k, want in pairs.items() if counters.get(k, 0) != want
+    }
+    ok = (trace_ok and not mismatches
+          and stats["shed"] > 0 and stats["served"] > 0)
+    return {
+        "ok": ok,
+        "run_dir": run_dir,
+        "trace": trace_path,
+        "trace_ok": trace_ok,
+        "trace_events": len(events),
+        "counter_mismatches": mismatches,
+        "served": stats["served"],
+        "shed": stats["shed"],
+        "admitted": stats["admitted"],
+        "failed": stats["failed"],
+    }
+
+
+def run_serving_chaos(*, slots=3, n_req=6, p99_gate=2.0,
+                      telemetry_dir=None) -> dict:
+    """All four scenarios; ``ok`` iff every gate holds."""
     model, params = _quick_model()
     crash = scenario_crash_replay(model, params, slots=slots, n_req=n_req)
     fail_fast = scenario_fail_fast(model, params, slots=slots)
     flood = scenario_flood(model, params, p99_gate=p99_gate)
+    tel = scenario_telemetry(model, params, slots=slots,
+                             run_dir=telemetry_dir)
     return {
-        "ok": crash["ok"] and fail_fast["ok"] and flood["ok"],
+        "ok": (crash["ok"] and fail_fast["ok"] and flood["ok"]
+               and tel["ok"]),
         "crash_replay": crash,
         "fail_fast": fail_fast,
         "flood": flood,
+        "telemetry": tel,
     }
 
 
@@ -279,6 +368,10 @@ def main(argv=None):
     ap.add_argument("--slots", type=int, default=3)
     ap.add_argument("--n_req", type=int, default=6)
     ap.add_argument("--p99_gate", type=float, default=2.0)
+    ap.add_argument("--telemetry", type=str, default=None, metavar="DIR",
+                    help="directory for the telemetry scenario's "
+                         "metrics.jsonl + trace.json (default: a "
+                         "fresh tempdir)")
     args = ap.parse_args(argv)
 
     import jax
@@ -288,6 +381,7 @@ def main(argv=None):
 
     res = run_serving_chaos(
         slots=args.slots, n_req=args.n_req, p99_gate=args.p99_gate,
+        telemetry_dir=args.telemetry,
     )
     print(json.dumps(res, indent=2))
     return 0 if res["ok"] else 1

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Render one run directory's telemetry into a human-readable report.
+
+Input is whatever subset of the observability surface the run produced
+(docs/OBSERVABILITY.md):
+
+* ``metrics.jsonl`` — interleaved :class:`Run` scalar lines and
+  telemetry registry snapshots (``"kind": "telemetry"``);
+* ``events.jsonl``  — structured events (schema:
+  dalle_tpu/telemetry/schema.py);
+* ``trace.json``    — the Chrome-trace export (load the same file at
+  https://ui.perfetto.dev for the interactive view; this report only
+  aggregates it).
+
+Everything is optional: a training run has scalars but maybe no trace,
+a serve run has the reverse — missing files render as a one-line note,
+never an error.  Pure stdlib so it runs anywhere the run dir lands
+(dev box, TPU VM, CI artifact store).
+
+Usage: ``python tools/telemetry_report.py <run_dir>``;
+library entry point: :func:`render_report` (pinned by
+tests/test_telemetry.py).
+"""
+
+import json
+import os
+import sys
+
+
+def _read_jsonl(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass  # a torn final line from a killed run
+    except OSError:
+        pass
+    return out
+
+
+def _fmt(v):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, int):
+        return str(v)
+    if v == 0:
+        return "0"
+    if abs(v) >= 1e5 or abs(v) < 1e-3:
+        return f"{v:.3e}"
+    return f"{v:.4g}"
+
+
+def _section(title):
+    return [title, "-" * len(title)]
+
+
+def _kv_table(d, indent="  "):
+    if not d:
+        return [f"{indent}(none)"]
+    w = max(len(k) for k in d)
+    return [f"{indent}{k:<{w}}  {_fmt(v)}" for k, v in sorted(d.items())]
+
+
+def _metrics_lines(run_dir):
+    recs = _read_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    snaps = [r for r in recs if r.get("kind") == "telemetry"]
+    scalars = [r for r in recs if r.get("kind") != "telemetry"]
+    lines = []
+
+    lines += _section("Registry (last snapshot)")
+    if not snaps:
+        lines.append("  no telemetry snapshots "
+                      "(run without --telemetry, or metrics.jsonl absent)")
+    else:
+        last = snaps[-1]
+        lines.append(f"  snapshots: {len(snaps)}")
+        lines.append("  counters:")
+        lines += _kv_table(last.get("counters", {}), indent="    ")
+        lines.append("  gauges:")
+        lines += _kv_table(last.get("gauges", {}), indent="    ")
+        hists = last.get("histograms", {})
+        lines.append("  histograms:")
+        if not hists:
+            lines.append("    (none)")
+        for name in sorted(hists):
+            h = hists[name]
+            lines.append(
+                f"    {name}: n={h.get('count')} "
+                f"p50={_fmt(h.get('p50'))} p90={_fmt(h.get('p90'))} "
+                f"p99={_fmt(h.get('p99'))} "
+                f"min={_fmt(h.get('min'))} max={_fmt(h.get('max'))}"
+            )
+
+    lines.append("")
+    lines += _section("Training scalars")
+    if not scalars:
+        lines.append("  (none)")
+    else:
+        # last write wins per key — the state of the run at exit; skip
+        # log_histogram's list-valued hist/edges payloads
+        last_vals, steps = {}, []
+        for r in scalars:
+            if "step" in r:
+                steps.append(r["step"])
+            for k, v in r.items():
+                if k in ("_time", "step") or isinstance(v, list):
+                    continue
+                last_vals[k] = v
+        span = (f"steps {min(steps)}..{max(steps)}, " if steps else "")
+        lines.append(f"  {span}{len(scalars)} records")
+        lines += _kv_table(last_vals)
+    return lines
+
+
+def _events_lines(run_dir):
+    evs = _read_jsonl(os.path.join(run_dir, "events.jsonl"))
+    lines = _section("Events")
+    if not evs:
+        lines.append("  (no events.jsonl)")
+        return lines
+    counts = {}
+    for e in evs:
+        k = e.get("kind", "?")
+        counts[k] = counts.get(k, 0) + 1
+    lines.append(f"  {len(evs)} events:")
+    lines += _kv_table(counts, indent="    ")
+    return lines
+
+
+def _trace_lines(run_dir):
+    path = os.path.join(run_dir, "trace.json")
+    lines = _section("Trace")
+    try:
+        with open(path) as f:
+            trace = json.load(f)
+    except (OSError, ValueError):
+        lines.append("  (no trace.json)")
+        return lines
+    events = trace.get("traceEvents", [])
+    threads = {
+        e["tid"]: e.get("args", {}).get("name", str(e["tid"]))
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    # aggregate complete spans per (track, name): count + total duration
+    agg = {}
+    n_instants = 0
+    for e in events:
+        if e.get("ph") == "i":
+            n_instants += 1
+        if e.get("ph") != "X":
+            continue
+        key = (threads.get(e.get("tid"), "?"), e.get("name", "?"))
+        cnt, tot = agg.get(key, (0, 0.0))
+        agg[key] = (cnt + 1, tot + e.get("dur", 0.0))
+    lines.append(
+        f"  {len(events)} events ({len(agg)} span kinds, "
+        f"{n_instants} instants) — load in https://ui.perfetto.dev"
+    )
+    for (track, name), (cnt, tot_us) in sorted(agg.items()):
+        lines.append(
+            f"    {track:<12} {name:<18} n={cnt:<5} "
+            f"total={tot_us / 1e6:.3f}s mean={tot_us / cnt / 1e3:.2f}ms"
+        )
+    return lines
+
+
+def render_report(run_dir) -> str:
+    """The whole report as one string (empty-dir-safe)."""
+    title = f"telemetry report: {run_dir}"
+    lines = [title, "=" * len(title), ""]
+    lines += _metrics_lines(run_dir)
+    lines.append("")
+    lines += _events_lines(run_dir)
+    lines.append("")
+    lines += _trace_lines(run_dir)
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: telemetry_report.py <run_dir>", file=sys.stderr)
+        return 2
+    if not os.path.isdir(argv[0]):
+        print(f"not a directory: {argv[0]}", file=sys.stderr)
+        return 2
+    sys.stdout.write(render_report(argv[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,11 +12,13 @@ jitted ``make_clip_train_step`` → a self-describing checkpoint that
 """
 
 import argparse
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dalle_tpu import telemetry
 from dalle_tpu.data import DataLoader, TextImageDataset
 from dalle_tpu.data.prefetch import device_prefetch, watchdog_iter
 from dalle_tpu.models.clip import CLIP, CLIPConfig
@@ -124,6 +126,7 @@ def parse_args(argv=None):
                         help="resume from the newest checkpoint in "
                              "--output_path if one exists")
     resilience.add_resilience_args(parser)
+    telemetry.add_telemetry_args(parser)
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
 
@@ -270,6 +273,14 @@ def main(argv=None):
     resume_data_step = resume_meta.get("data_step", 0) if resume_meta else 0
     data_step = 0  # batches applied within the current epoch
 
+    tel = telemetry.configure_from_args(
+        args, str(run.dir) if run is not None else None
+    ) if is_root else None
+    xprof = telemetry.XlaProfileWindow.from_arg(
+        args.xla_profile_steps if is_root else None,
+        str(ckpt_dir / "xla_profile"),
+    )
+
     from dalle_tpu.training.checkpoint import make_async_writer
 
     ckpt_writer = make_async_writer(args.async_ckpt)
@@ -320,6 +331,8 @@ def main(argv=None):
                               epoch=epoch, data_step=data_step)
                     save(f"clip-step{global_step}")  # synchronous
                     raise resilience.Preempted
+                xprof.on_step(global_step)
+                t_step0 = time.monotonic()
                 step_key = jax.random.fold_in(rng, global_step)
                 action = "ok"
                 if resil.active:
@@ -335,12 +348,21 @@ def main(argv=None):
                     params, opt_state, loss = step_fn(
                         params, opt_state, text, images, step_key
                     )
+                if telemetry.enabled() and global_step % 20 == 0:
+                    # sampled true step time (async dispatch hides it)
+                    jax.block_until_ready(loss)
+                    telemetry.observe("train_step_s",
+                                      time.monotonic() - t_step0)
                 if action == "rollback":
                     rollback = True
                     break
                 m = meter.step()
                 if m is not None:
                     loss_f = float(distr.average_all(loss))
+                    if tel is not None:
+                        telemetry.set_gauge("train_mfu", m["mfu"])
+                        telemetry.set_gauge("train_samples_per_s",
+                                            m["samples_per_sec"])
                     if is_root:
                         print(
                             f"epoch {epoch} step {global_step} loss {loss_f:.5f} "
@@ -398,6 +420,8 @@ def main(argv=None):
         # joins, killing in-flight saves (ADVICE.md)
         if ckpt_writer is not None:
             ckpt_writer.wait()
+        xprof.stop()
+        telemetry.shutdown()  # final snapshot + trace.json (no-op when off)
         resil.close()
         resil.uninstall_signal_handlers()
     if is_root:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from dalle_tpu import telemetry
 from dalle_tpu.data import BatchedWebLoader, DataLoader, TextImageDataset, WebDataset
 from dalle_tpu.data.prefetch import device_prefetch, local_rows, watchdog_iter
 from dalle_tpu.parallel.mesh import batch_sharding
@@ -247,6 +248,7 @@ def parse_args(argv=None):
                              "the reference's DeepSpeed-config precedence, "
                              "deepspeed_backend.py:66-133)")
     resilience.add_resilience_args(parser)
+    telemetry.add_telemetry_args(parser)
     parser = backend_lib.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
@@ -557,6 +559,39 @@ def main(argv=None):
         print(f"DALLE params: {count_params(params):,}")
 
     ckpt_dir = Path(args.output_path)
+    # --telemetry: registry + tracer with snapshots into the run dir's
+    # metrics.jsonl (root only — one writer per run); the analytic
+    # byte/comm models seed live gauges so MFU/bytes meters appear in
+    # snapshots without a TPU profiler attached
+    tel = telemetry.configure_from_args(
+        args, str(run.dir) if run is not None else None
+    ) if is_root else None
+    if tel is not None:
+        try:
+            from dalle_tpu.training.profiler import (
+                dalle_step_comm_time,
+                dalle_step_wire_bytes,
+            )
+
+            telemetry.set_gauge(
+                "train_modeled_wire_gb_per_step",
+                dalle_step_wire_bytes(cfg, args.batch_size)["total"] / 1e9,
+            )
+            comm = dalle_step_comm_time(
+                cfg, args.batch_size, distr.mesh,
+                grad_comm=args.grad_comm,
+                tp_overlap=getattr(args, "tp_overlap", False),
+                fsdp_prefetch=getattr(args, "fsdp_prefetch", False),
+            )
+            telemetry.set_gauge("train_modeled_exposed_comm_s",
+                                comm["exposed_total_s"])
+            telemetry.set_gauge("train_modeled_step_s", comm["step_s"])
+        except Exception:
+            pass  # the models reject some exotic mesh/config combos
+    xprof = telemetry.XlaProfileWindow.from_arg(
+        args.xla_profile_steps if is_root else None,
+        str(ckpt_dir / "xla_profile"),
+    )
     # restore the step counter so step-tagged checkpoints keep ascending
     # across restarts (--auto_resume ranks checkpoints by saved step —
     # a reset counter would make newer checkpoints look older)
@@ -664,6 +699,8 @@ def main(argv=None):
                     raise resilience.Preempted
                 if args.flops_profiler and global_step == 200 and is_root:
                     jax.profiler.start_trace(str(ckpt_dir / "profile"))
+                xprof.on_step(global_step)
+                t_step0 = time.monotonic()
                 step_key = jax.random.fold_in(rng, global_step)
                 action = "ok"
                 if resil.active:
@@ -698,6 +735,14 @@ def main(argv=None):
                     jax.block_until_ready(loss)
                     jax.profiler.stop_trace()
                     print(f"profiler trace written to {ckpt_dir/'profile'}")
+                if telemetry.enabled() and global_step % 20 == 0:
+                    # sampled TRUE step time: the async dispatch means
+                    # wall time between steps is not compute time; a
+                    # block_until_ready every N steps bounds the sync
+                    # cost while keeping an honest compute histogram
+                    jax.block_until_ready(loss)
+                    telemetry.observe("train_step_s",
+                                      time.monotonic() - t_step0)
                 if action == "rollback":
                     rollback = True
                     break
@@ -713,6 +758,9 @@ def main(argv=None):
                     # the print/log below is root-gated
                     avg_loss = float(distr.average_all(loss))
                 if is_root and m is not None:
+                    telemetry.set_gauge("train_mfu", m["mfu"])
+                    telemetry.set_gauge("train_tokens_per_s",
+                                        m["tokens_per_sec"])
                     extras = {k: float(v) for k, v in step_metrics.items()}
                     print(
                         f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
@@ -811,6 +859,8 @@ def main(argv=None):
         # shutdown' (ADVICE.md)
         if ckpt_writer is not None:
             ckpt_writer.wait()
+        xprof.stop()
+        telemetry.shutdown()  # final snapshot + trace.json (no-op when off)
         resil.close()
         resil.uninstall_signal_handlers()
     if is_root:

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dalle_tpu import telemetry
 from dalle_tpu.data import DataLoader, ImageFolderDataset
 from dalle_tpu.data.prefetch import device_prefetch, local_rows, watchdog_iter
 from dalle_tpu.parallel.mesh import batch_sharding
@@ -110,6 +111,7 @@ def parse_args(argv=None):
                         help="resume from the newest checkpoint in "
                              "--output_path if one exists")
     resilience.add_resilience_args(parser)
+    telemetry.add_telemetry_args(parser)
     parser = backend_lib.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     return apply_config_json(args, args.config_json, parser)
@@ -271,6 +273,14 @@ def main(argv=None):
     resume_epoch = start_epoch
     t10 = time.perf_counter()
 
+    tel = telemetry.configure_from_args(
+        args, str(run.dir) if run is not None else None
+    ) if is_root else None
+    xprof = telemetry.XlaProfileWindow.from_arg(
+        args.xla_profile_steps if is_root else None,
+        f"{args.output_path}/xla_profile",
+    )
+
     from dalle_tpu.training.checkpoint import make_async_writer
 
     ckpt_writer = make_async_writer(args.async_ckpt)
@@ -319,6 +329,8 @@ def main(argv=None):
                               epoch=epoch, data_step=data_step)
                     save("vae")  # synchronous; the usual in-loop name, so
                     raise resilience.Preempted  # --auto_resume finds it
+                xprof.on_step(global_step)
+                t_step0 = time.monotonic()
                 step_key = jax.random.fold_in(rng, global_step)
                 action = "ok"
                 if resil.active:
@@ -334,6 +346,11 @@ def main(argv=None):
                     params, opt_state, loss, recons = step_fn(
                         params, opt_state, images, temp, step_key
                     )
+                if telemetry.enabled() and global_step % 20 == 0:
+                    # sampled true step time (async dispatch hides it)
+                    jax.block_until_ready(loss)
+                    telemetry.observe("train_step_s",
+                                      time.monotonic() - t_step0)
                 if action == "rollback":
                     rollback = True
                     break
@@ -371,6 +388,8 @@ def main(argv=None):
                     dt = time.perf_counter() - t10
                     t10 = time.perf_counter()
                     sps = args.batch_size * 10 / dt if global_step else 0.0
+                    if tel is not None:
+                        telemetry.set_gauge("train_samples_per_s", sps)
                     print(
                         f"epoch {epoch} step {global_step} loss {avg_loss:.5f} "
                         f"({sps:.1f} samples/s)"
@@ -435,6 +454,8 @@ def main(argv=None):
         # joins, killing in-flight saves (ADVICE.md)
         if ckpt_writer is not None:
             ckpt_writer.wait()
+        xprof.stop()
+        telemetry.shutdown()  # final snapshot + trace.json (no-op when off)
         resil.close()
         resil.uninstall_signal_handlers()
     if is_root:
