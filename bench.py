@@ -123,6 +123,12 @@ PHASES = [
     # interleaved best-of; ON tokens/s must stay within 2% of OFF, and
     # the disabled run must record ZERO trace events.  Host-side
     ("telemetry_overhead", 600, False),
+    # serving-cache evidence (docs/SERVING.md §7): one Zipf(1.1) prompt
+    # trace replayed cached vs uncached — >=30% fewer device-prefilled
+    # requests, bitwise-identical codes for every request, and both
+    # jitted admit paths compile exactly once across all occupancy x
+    # hit/miss combinations.  Host-side
+    ("serving_cache", 600, False),
 ]
 
 # phases that are their own hardened scripts (run via custom argv instead of
@@ -1474,7 +1480,8 @@ def _serving_resilience_bench():
     res = dict(verdict)
     res["wall_s"] = round(time.time() - t0, 1)
     if not verdict["ok"]:
-        bad = [k for k in ("crash_replay", "fail_fast", "flood")
+        bad = [k for k in ("crash_replay", "fail_fast", "cache_crash",
+                           "flood")
                if not verdict[k]["ok"]]
         res["rung_failed"] = f"serving chaos gates failed: {bad}"
     return res
@@ -1569,6 +1576,145 @@ def _telemetry_overhead_bench():
     return res
 
 
+def _serving_cache_bench():
+    """Serving cache rung (docs/SERVING.md §7, the ISSUE 8 pin).
+
+    Replays one Zipf(alpha=1.1) prompt trace — 48 arrivals over 8
+    distinct prompts x 2 seeds, the redundancy profile of real
+    image-generation traffic — through the slot engine twice: uncached,
+    then with the result cache + shared-prefix KV pool.  Gates:
+
+      * admission-cost reduction >= 30%: the cached pass device-prefills
+        at most 0.7x the requests the uncached pass does (it should only
+        prefill the distinct texts);
+      * every request's codes are bitwise identical cached vs uncached
+        (the warm path is exact, not approximate);
+      * no-recompile: tick and BOTH admit paths compile exactly once
+        across a staggered mix of occupancy x hit/miss admissions.
+    """
+    import jax
+    import numpy as np
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.serving import (
+        DecodeEngine, PrefixPool, Request, make_zipf_trace, replay_trace,
+    )
+
+    # the serving smoke shape (see _serving_bench)
+    cfg = DALLEConfig(
+        num_text_tokens=64, text_seq_len=16, num_image_tokens=128,
+        image_fmap_size=8, dim=32, depth=2, heads=2, dim_head=16,
+    )
+    key = jax.random.PRNGKey(0)
+    model = DALLE(cfg)
+    text = jax.random.randint(
+        key, (2, cfg.text_seq_len), 1, cfg.num_text_tokens
+    )
+    codes = jax.random.randint(
+        key, (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = model.init({"params": key}, text, codes)["params"]
+    t0 = time.time()
+
+    n_req, slots = 48, 8
+    trace = make_zipf_trace(
+        n_req, 1e5, cfg.text_seq_len, cfg.num_text_tokens, alpha=1.1,
+        num_prompts=8, seeds_per_prompt=2, seed=0,
+    )
+
+    def run(**kw):
+        out = {}
+        st = replay_trace(
+            model, params, trace, policy="continuous", num_slots=slots,
+            time_scale=0.0,
+            on_result=lambda r: (
+                out.__setitem__(r.request_id, np.array(r.codes))
+                if r.codes is not None else None
+            ),
+            **kw,
+        )
+        return st, out
+
+    cold_stats, cold = run()
+    warm_stats, warm = run(
+        result_cache_bytes=16 << 20, prefix_pool_bytes=16 << 20
+    )
+    ids = sorted(set(cold) & set(warm))
+    bitwise = len(ids) == n_req and all(
+        np.array_equal(cold[i], warm[i]) for i in ids
+    )
+    reduction = 1.0 - (
+        warm_stats["prefill_requests"]
+        / max(1, cold_stats["prefill_requests"])
+    )
+    hits = warm_stats["cache_hits"]
+    hit_rate = hits / max(1, hits + warm_stats["cache_misses"])
+
+    # no-recompile pin: staggered admissions across occupancy x hit/miss
+    eng = DecodeEngine(
+        model, params, num_slots=4, filter_thres=0.9,
+        prefix_pool=PrefixPool(16 << 20),
+    )
+    eng.warmup()
+
+    def mk(i, j):
+        return Request(
+            text_tokens=np.asarray(trace[j].text_tokens, np.int32),
+            seed=100 + i, request_id=f"pin{i}",
+        )
+
+    eng.admit([mk(0, 0), mk(1, 1), mk(2, 2)])  # 3 misses
+    for _ in range(cfg.image_seq_len // 2):
+        eng.step()
+    eng.admit([mk(3, 0)])  # pure hit at partial occupancy
+    while eng.in_flight():
+        eng.step()
+    eng.admit([mk(4, 1), mk(5, 3), mk(6, 3)])  # hit + miss + same-batch dup
+    while eng.in_flight():
+        eng.step()
+    recompile_free = (
+        eng._tick_fn._cache_size() == 1
+        and eng._admit_fn._cache_size() == 1
+        and eng._admit_cached_fn._cache_size() == 1
+    )
+
+    _hb(
+        f"serving_cache: reduction={reduction:.3f} hit_rate={hit_rate:.3f} "
+        f"bitwise={bitwise} recompile_free={recompile_free}"
+    )
+    res = {
+        "n_requests": n_req,
+        "num_slots": slots,
+        "zipf_alpha": 1.1,
+        "distinct_prompts": 8,
+        "prefill_uncached": cold_stats["prefill_requests"],
+        "prefill_cached": warm_stats["prefill_requests"],
+        "admission_cost_reduction": round(reduction, 4),
+        "reduction_gate": 0.30,
+        "cache_hits": hits,
+        "cache_misses": warm_stats["cache_misses"],
+        "prefix_reuses": warm_stats["prefix_reuses"],
+        "hit_rate": round(hit_rate, 4),
+        "cache_bytes": warm_stats["cache_bytes"],
+        "bitwise_equal": bitwise,
+        "compared": len(ids),
+        "recompile_free": recompile_free,
+        "tokens_per_s_uncached": round(cold_stats["tokens_per_s"], 2),
+        "tokens_per_s_cached": round(warm_stats["tokens_per_s"], 2),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    fails = []
+    if reduction < 0.30:
+        fails.append(f"admission-cost reduction {reduction:.3f} < 0.30")
+    if not bitwise:
+        fails.append(f"cached codes not bitwise equal ({len(ids)} compared)")
+    if not recompile_free:
+        fails.append("admit/tick recompiled with caching enabled")
+    if fails:
+        res["rung_failed"] = "; ".join(fails)
+    return res
+
+
 PHASE_FNS = {
     "train_tiny": lambda: _train_bench(tiny=True),
     "train": _train_bench,
@@ -1587,6 +1733,7 @@ PHASE_FNS = {
     "resilience": _resilience_bench,
     "serving_resilience": _serving_resilience_bench,
     "telemetry_overhead": _telemetry_overhead_bench,
+    "serving_cache": _serving_cache_bench,
 }
 
 

@@ -11,6 +11,13 @@ because every DALL-E request has the same shape (text_seq_len prefix +
 image_seq_len generation).  See docs/SERVING.md §5.
 """
 
+from dalle_tpu.serving.cache import (
+    PrefixPool,
+    ResultCache,
+    model_fingerprint,
+    request_key,
+    text_key,
+)
 from dalle_tpu.serving.engine import DecodeEngine, EngineState
 from dalle_tpu.serving.queue import (
     Request,
@@ -25,6 +32,7 @@ from dalle_tpu.serving.scheduler import (
     TraceItem,
     load_trace,
     make_poisson_trace,
+    make_zipf_trace,
     replay_trace,
     request_stats,
     save_trace,
@@ -42,8 +50,14 @@ __all__ = [
     "POLICIES",
     "TraceItem",
     "make_poisson_trace",
+    "make_zipf_trace",
     "replay_trace",
     "request_stats",
     "load_trace",
     "save_trace",
+    "ResultCache",
+    "PrefixPool",
+    "model_fingerprint",
+    "request_key",
+    "text_key",
 ]

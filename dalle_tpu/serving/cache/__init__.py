@@ -1,0 +1,29 @@
+"""Serving cache subsystem: three reuse tiers over the slot engine.
+
+1. :class:`ResultCache` — content-addressed finished codes (O(1) dedup,
+   zero device work on a hit).
+2. :class:`PrefixPool` — shared-prefix text-KV blocks, copied into slots
+   through the engine's jitted merge seam instead of recomputing prefill.
+3. Variations fan-out — ``Request.variations=k`` (serving.queue) prefills
+   once and decodes k seeds off one pooled block; the fan-out itself
+   lives in the scheduler.
+
+Keying is in :mod:`.fingerprint`; see docs/SERVING.md §7.
+"""
+
+from dalle_tpu.serving.cache.fingerprint import (
+    model_fingerprint,
+    request_key,
+    text_key,
+)
+from dalle_tpu.serving.cache.prefix import PrefixEntry, PrefixPool
+from dalle_tpu.serving.cache.results import ResultCache
+
+__all__ = [
+    "ResultCache",
+    "PrefixPool",
+    "PrefixEntry",
+    "model_fingerprint",
+    "request_key",
+    "text_key",
+]

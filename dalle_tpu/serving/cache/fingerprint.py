@@ -1,0 +1,89 @@
+"""Cache keys for the serving cache subsystem (docs/SERVING.md §7).
+
+Two layers of keying:
+
+* :func:`model_fingerprint` — identifies *which function* the engine is
+  serving: the checkpoint (path + step, i.e. the weights) plus the
+  compute-policy-stripped model config.  ``DALLEConfig.to_dict()`` is
+  the policy stripper: it already pops ``dtype``/``stream_dtype``/
+  ``use_flash``/``fused_ff``/``fused_decode``/``tp_overlap``/
+  ``fsdp_prefetch`` because those pick an *execution path*, never the
+  function the params parameterize — ``--fused_decode`` is pinned
+  bitwise against the baseline engine, so codes cached under one policy
+  are exactly what the other policy would produce.  Output-CHANGING
+  knobs (``kv_int8``, ``quant_int8`` — quantization changes logits, so
+  codes differ) survive ``to_dict`` and therefore fingerprint apart, as
+  they must.
+
+* :func:`request_key` — identifies *which request* against that
+  function: fingerprint + text tokens + seed + the full sampling tuple
+  (temperature, top-p, the engine's static top-k fraction and sampling
+  mode).  The serving engine is deterministic in exactly this tuple
+  (tests/test_serving.py pins engine codes bitwise against solo
+  decode), which is what makes result caching bitwise-safe rather than
+  approximate — and why the key must contain nothing less.
+
+Keys are hex sha256 digests: stable across processes and restarts, so
+a persisted/warm cache stays coherent as long as the checkpoint is the
+same — and can never serve stale codes after a reload, because a new
+checkpoint path or step changes every key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+import numpy as np
+
+
+def model_fingerprint(cfg, *, checkpoint_path: Optional[str] = None,
+                      step: Optional[int] = None) -> str:
+    """Fingerprint the served function: weights identity + stripped config.
+
+    ``cfg`` is a ``DALLEConfig`` (anything with a policy-stripping
+    ``to_dict``).  ``checkpoint_path``/``step`` name the weights; leave
+    them None for in-memory params (tests, ``--quick`` benches) — the
+    config alone still keys correctly within one process.
+    """
+    payload = {
+        "config": cfg.to_dict(),
+        "checkpoint": checkpoint_path,
+        "step": step,
+    }
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def text_key(text_tokens) -> str:
+    """Content hash of one tokenized text prefix (the prefix-pool key).
+
+    The pool caches *prefill output*, which depends only on the text
+    tokens and the params — so the text hash alone keys it (the params
+    are pinned by the pool living inside one engine)."""
+    tt = np.ascontiguousarray(np.asarray(text_tokens, np.int32))
+    return hashlib.sha256(tt.tobytes()).hexdigest()
+
+
+def request_key(fingerprint: str, text_tokens, *, seed: int,
+                temperature: float, top_p: Optional[float],
+                filter_thres: float, use_top_p: bool) -> str:
+    """Content address of one request's finished codes.
+
+    Everything the deterministic decode depends on is in here; nothing
+    else is.  Floats are normalized through ``repr(float(...))`` so the
+    same value always serializes identically."""
+    h = hashlib.sha256()
+    h.update(fingerprint.encode())
+    tt = np.ascontiguousarray(np.asarray(text_tokens, np.int32))
+    h.update(tt.tobytes())
+    samp = (
+        int(seed),
+        repr(float(temperature)),
+        None if top_p is None else repr(float(top_p)),
+        repr(float(filter_thres)),
+        bool(use_top_p),
+    )
+    h.update(json.dumps(samp).encode())
+    return h.hexdigest()

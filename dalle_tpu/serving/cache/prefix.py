@@ -1,0 +1,103 @@
+"""Shared-prefix KV pool: text hash → prefill-computed text-KV block.
+
+The middle tier of the serving cache (docs/SERVING.md §7).  Every
+DALL-E request has the same shape — a fixed-length text prefix followed
+by ``image_seq_len`` generated positions — so the prefill-computed KV
+rows for positions ``[0, text_seq_len)`` are a pure function of (text
+tokens, params).  The pool stores those rows once per distinct text
+(exactly as the engine's jitted prefill produced them, including the
+int8-KV rows + fp32 scales layout and the gMLP/shift-hist leaves) and
+the engine's pool-hit admission path copies them into a slot instead of
+recomputing prefill (`DecodeEngine._admit_cached_impl`).
+
+Entries are opaque to the pool: a flat list of host numpy leaves (the
+engine owns the treedef and the per-leaf position axes) plus the forced
+first token (``remap_pad_tokens(text)[-1]``, the token fed at position
+``text_seq_len``).  Host-side round-tripping preserves bits, so a
+pool-hit admission is bitwise the cold prefill (tests/test_serving_cache.py).
+
+Same LRU-under-bytes-budget semantics as :class:`ResultCache`,
+including the floor-1 rule.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+
+class PrefixEntry(NamedTuple):
+    """One pooled text-KV block."""
+
+    leaves: List[np.ndarray]  # flat cache leaves, [1, ..., t, ...] each
+    first: int  # forced token at position text_seq_len
+    nbytes: int
+
+
+class PrefixPool:
+    """LRU {text_key: PrefixEntry} bounded by ``max_bytes``."""
+
+    def __init__(self, max_bytes: int):
+        assert max_bytes > 0, f"max_bytes must be > 0, got {max_bytes}"
+        self.max_bytes = int(max_bytes)
+        self._d: "OrderedDict[str, PrefixEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: str) -> Optional[PrefixEntry]:
+        with self._lock:
+            e = self._d.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._d.move_to_end(key)
+            self.hits += 1
+            return e
+
+    def put(self, key: str, leaves: List[np.ndarray], first: int) -> None:
+        """Insert (idempotent: same text → same bits, first put wins),
+        evict LRU down to the budget, floor one entry."""
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                return
+            leaves = [np.ascontiguousarray(l) for l in leaves]
+            for l in leaves:
+                l.flags.writeable = False
+            nbytes = sum(l.nbytes for l in leaves)
+            self._d[key] = PrefixEntry(leaves, int(first), nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._d) > 1:
+                _, old = self._d.popitem(last=False)
+                self._bytes -= old.nbytes
+                self.evictions += 1
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._d),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

@@ -1,0 +1,95 @@
+"""Content-addressed result cache: request key → finished VQ codes.
+
+The O(1) tier of the serving cache (docs/SERVING.md §7): a duplicate
+request — same text, seed, sampling tuple, and model fingerprint — is
+answered at admission with the stored codes, with ZERO device work.
+Safe because the engine is bitwise-deterministic in exactly that tuple
+(tests/test_serving.py), so the cached value IS the value a fresh
+decode would produce.
+
+LRU under a bytes budget, with a floor of one entry: eviction never
+empties the cache just because a single entry exceeds the budget —
+an over-budget singleton is more useful than an always-cold cache, and
+the bound still holds the moment a second entry arrives.  Stored codes
+are defensive copies marked read-only; ``get`` returns the shared
+read-only array (callers copy if they need to mutate).
+
+Thread-safe: admission runs on the scheduler thread but stats/bytes
+are read from tests and the detok worker.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+
+class ResultCache:
+    """LRU {request_key: codes} bounded by ``max_bytes``."""
+
+    def __init__(self, max_bytes: int):
+        assert max_bytes > 0, f"max_bytes must be > 0, got {max_bytes}"
+        self.max_bytes = int(max_bytes)
+        self._d: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # --- core ------------------------------------------------------------
+    def get(self, key: str) -> Optional[np.ndarray]:
+        """The stored codes (read-only, shared) or None; hit → MRU."""
+        with self._lock:
+            arr = self._d.get(key)
+            if arr is None:
+                self.misses += 1
+                return None
+            self._d.move_to_end(key)
+            self.hits += 1
+            return arr
+
+    def put(self, key: str, codes) -> None:
+        """Insert (idempotent — a present key is refreshed to MRU, not
+        re-stored: duplicate decodes produce the same bits by contract),
+        then evict LRU entries down to the budget, floor one entry."""
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                return
+            arr = np.array(codes)  # defensive copy
+            arr.flags.writeable = False
+            self._d[key] = arr
+            self._bytes += arr.nbytes
+            while self._bytes > self.max_bytes and len(self._d) > 1:
+                _, old = self._d.popitem(last=False)
+                self._bytes -= old.nbytes
+                self.evictions += 1
+
+    # --- introspection ---------------------------------------------------
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._d),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

@@ -38,6 +38,7 @@ from dalle_tpu.models.dalle import DALLE
 from dalle_tpu.ops.sampling import sample_logits_per_slot
 from dalle_tpu.training import faults
 
+from dalle_tpu.serving.cache.fingerprint import text_key
 from dalle_tpu.serving.queue import Request
 
 
@@ -78,6 +79,7 @@ class DecodeEngine:
         num_slots: int = 8,
         filter_thres: float = 0.9,
         use_top_p: bool = False,
+        prefix_pool=None,
     ):
         self.model = model
         self.params = params
@@ -87,12 +89,54 @@ class DecodeEngine:
         self.S = c.image_seq_len
         self.filter_thres = filter_thres
         self.use_top_p = use_top_p
+        self.prefix_pool = prefix_pool
         self._tick_fn = jax.jit(self._tick_impl, donate_argnums=(1,))
         self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(1,))
+        self._admit_cached_fn = jax.jit(
+            self._admit_cached_impl, donate_argnums=(1,)
+        )
         self.state = self._init_state()
+        self._find_block_axes()
         self.tick_count = 0
         self.slot_req: List[Optional[Request]] = [None] * self.num_slots
         self._slot_done: List[Optional[int]] = [None] * self.num_slots
+        # admission-cost accounting (host ints, survive reset())
+        self.admit_calls = 0  # host admit() invocations
+        self.prefill_admits = 0  # jitted prefill-admission dispatches
+        self.pool_admits = 0  # jitted pool-hit admission dispatches
+        self.prefill_requests = 0  # requests that paid device prefill
+        self.prefix_reuses = 0  # requests admitted off a pooled block
+
+    def _find_block_axes(self) -> None:
+        """Locate each cache leaf's position axis (the one sized
+        total_seq_len) so the text-prefix block — positions [:t] — can be
+        sliced out after prefill and merged back on a pool hit.  Every
+        leaf layout the model emits (GQA k/v, int8 rows + scales, gMLP
+        gate values, shift hist) carries exactly one such axis; if a
+        config ever makes that ambiguous (a feature dim colliding with
+        total_seq_len) the pool is disabled rather than guessed at."""
+        seq = self.t + self.S
+        leaves = jax.tree_util.tree_leaves(self.state.cache)
+        axes, specs = [], []
+        for leaf in leaves:
+            cand = [i for i in range(1, leaf.ndim) if leaf.shape[i] == seq]
+            if len(cand) != 1:
+                self._block_axes = None
+                self._block_specs = None
+                if self.prefix_pool is not None:
+                    print(
+                        "serving: prefix pool disabled — cache leaf "
+                        f"{leaf.shape} has no unambiguous position axis"
+                    )
+                    self.prefix_pool = None
+                return
+            ax = cand[0]
+            axes.append(ax)
+            shape = list(leaf.shape)
+            shape[ax] = self.t
+            specs.append((tuple(shape), leaf.dtype))
+        self._block_axes = axes
+        self._block_specs = specs
 
     # --- device side -----------------------------------------------------
     def _init_state(self) -> EngineState:
@@ -145,14 +189,18 @@ class DecodeEngine:
     def _admit_impl(
         self, params, state: EngineState, texts, base_keys, temps, tps,
         src, take,
-    ) -> EngineState:
+    ) -> Tuple[EngineState, Any]:
         """Prefill up to B newcomers in one batched pass and gather-merge
         them into their slots.
 
         ``src[b]`` names the newcomer row slot b takes, ``take[b]`` whether
         it takes one.  The merge is a gather-select (``where(take,
         new[src], old)``) rather than a scatter — deterministic even if a
-        host bug ever produced duplicate targets."""
+        host bug ever produced duplicate targets.
+
+        Also returns the text-prefix blocks — each prefilled cache leaf
+        sliced to positions [:t] — so the host can export newcomers' rows
+        into the shared-prefix pool without a second device pass."""
         model, t, S = self.model, self.t, self.S
         A = texts.shape[0]  # == num_slots (static)
         fresh = model.apply({"params": params}, A, method=DALLE.init_cache)
@@ -171,11 +219,61 @@ class DecodeEngine:
             return jnp.where(tk, jnp.take(new, src, axis=0), old)
 
         cache = jax.tree_util.tree_map(merge, state.cache, pcache)
+        if self._block_axes is None:
+            blocks = ()
+        else:
+            blocks = [
+                jax.lax.slice_in_dim(leaf, 0, t, axis=ax)
+                for leaf, ax in zip(
+                    jax.tree_util.tree_leaves(pcache), self._block_axes
+                )
+            ]
         return EngineState(
             cache=cache,
             pos=jnp.where(take, jnp.int32(t), state.pos),
             prev=jnp.where(take, 0, state.prev),
             first=jnp.where(take, first[src], state.first),
+            keys=jnp.where(take[:, None, None], ladder[src], state.keys),
+            temp=jnp.where(take, temps[src], state.temp),
+            top_p=jnp.where(take, tps[src], state.top_p),
+            active=state.active | take,
+            out=jnp.where(take[:, None], 0, state.out),
+        ), blocks
+
+    def _admit_cached_impl(
+        self, params, state: EngineState, blocks, first, base_keys, temps,
+        tps, src, take,
+    ) -> EngineState:
+        """Admit newcomers whose text-prefix blocks are already computed —
+        the pool-hit path.  Identical to ``_admit_impl`` except no
+        prefill: each cache leaf's positions [:t] come from ``blocks``
+        (gather-selected like the prefill merge, then written back with a
+        static-offset dynamic-update so untaken slots keep their rows
+        bit-for-bit).  Positions beyond t keep the previous occupant's
+        rows — safe because decode never reads past its own position
+        (causal mask row / tril-masked gate / in-kernel pos mask), and
+        every position is written before it is first read.
+
+        ``first`` rides in as data ([B] int32, the forced token at pos t)
+        rather than being recomputed from texts — the host computed it
+        once at export time."""
+        t, S = self.t, self.S
+        ladder = jax.vmap(lambda k: jax.random.split(k, S))(base_keys)
+        old_leaves, treedef = jax.tree_util.tree_flatten(state.cache)
+        merged_leaves = []
+        for old, new, ax in zip(old_leaves, blocks, self._block_axes):
+            tk = take.reshape((-1,) + (1,) * (old.ndim - 1))
+            head = jax.lax.slice_in_dim(old, 0, t, axis=ax)
+            merged = jnp.where(tk, jnp.take(new, src, axis=0), head)
+            merged_leaves.append(
+                jax.lax.dynamic_update_slice_in_dim(old, merged, 0, axis=ax)
+            )
+        cache = jax.tree_util.tree_unflatten(treedef, merged_leaves)
+        return EngineState(
+            cache=cache,
+            pos=jnp.where(take, jnp.int32(t), state.pos),
+            prev=jnp.where(take, 0, state.prev),
+            first=jnp.where(take, first[src].astype(jnp.int32), state.first),
             keys=jnp.where(take[:, None, None], ladder[src], state.keys),
             temp=jnp.where(take, temps[src], state.temp),
             top_p=jnp.where(take, tps[src], state.top_p),
@@ -228,32 +326,113 @@ class DecodeEngine:
         self._slot_done = [None] * self.num_slots
 
     def warmup(self):
-        """Compile tick + admit up front (keeps XLA compile time out of
-        the latency stats), then reset to a fresh state."""
+        """Compile tick + both admit paths up front (keeps XLA compile
+        time out of the latency stats), then reset to a fresh state.  The
+        cached-admit warmup runs with take=all-False, so the pool itself
+        is untouched."""
         B, t = self.num_slots, self.t
         z = np.zeros
-        st = self._admit_fn(
+        st, _ = self._admit_fn(
             self.params, self.state,
             jnp.asarray(z((B, t), np.int32)),
             jnp.asarray(z((B, 2), np.uint32)),
             jnp.ones((B,), jnp.float32), jnp.ones((B,), jnp.float32),
             jnp.asarray(z((B,), np.int32)), jnp.asarray(z((B,), bool)),
         )
+        if self.prefix_pool is not None:
+            st = self._admit_cached_fn(
+                self.params, st,
+                [jnp.zeros(s, d) for s, d in self._block_specs],
+                jnp.asarray(z((B,), np.int32)),
+                jnp.asarray(z((B, 2), np.uint32)),
+                jnp.ones((B,), jnp.float32), jnp.ones((B,), jnp.float32),
+                jnp.asarray(z((B,), np.int32)), jnp.asarray(z((B,), bool)),
+            )
         st = self._tick_fn(self.params, st)
         jax.block_until_ready(st.out)
         self.state = self._init_state()
         self.tick_count = 0
 
+    def _bind_slot(self, req: Request, slot: int, now: float) -> None:
+        self.slot_req[slot] = req
+        self._slot_done[slot] = self.tick_count + self.S
+        req.admit_time = now
+        req.slot = slot  # trace track: decode occupancy lands here
+
     def admit(self, reqs: Sequence[Request]):
-        """Scatter up to ``len(free_slots())`` new requests into free slots
-        (one jitted call, no recompilation — shapes are static in B)."""
+        """Scatter up to ``len(free_slots())`` new requests into free
+        slots.  With a prefix pool attached, requests whose text block is
+        pooled skip device prefill entirely (``_admit_cached_fn``); the
+        rest go through the prefill path, which exports their freshly
+        computed blocks into the pool.  Both paths are static-shape in B
+        — no combination of occupancy × hit/miss ever recompiles."""
         if not reqs:
             return
         free = self.free_slots()
         assert len(reqs) <= len(free), (
             f"admit({len(reqs)}) with only {len(free)} free slots"
         )
-        B, t, S = self.num_slots, self.t, self.S
+        self.admit_calls += 1
+        pool = self.prefix_pool
+        if pool is None:
+            self._admit_prefill([(r, None) for r in reqs], free[: len(reqs)])
+            self.prefill_admits += 1
+            self.prefill_requests += len(reqs)
+            return
+        # Batch-local dedup: k same-text requests in one batch (the
+        # variations fan-out) prefill ONCE — the duplicates resolve off
+        # the block the first one just exported.
+        hits, misses, dups = [], [], []
+        missed = set()
+        for req in reqs:
+            key = text_key(req.text_tokens)
+            if key in missed:
+                dups.append((req, key))
+                continue
+            entry = pool.get(key)
+            if entry is not None:
+                hits.append((req, entry))
+            else:
+                missed.add(key)
+                misses.append((req, key))
+        idx = 0
+        if misses:
+            self._admit_prefill(misses, free[idx : idx + len(misses)])
+            idx += len(misses)
+            self.prefill_admits += 1
+            self.prefill_requests += len(misses)
+        leftover = []
+        for req, key in dups:
+            entry = pool.get(key)
+            if entry is not None:
+                hits.append((req, entry))
+            else:  # exported block already evicted (pool smaller than batch)
+                leftover.append((req, key))
+        if hits:
+            self._admit_pooled(hits, free[idx : idx + len(hits)])
+            idx += len(hits)
+            self.pool_admits += 1
+            self.prefix_reuses += len(hits)
+        if leftover:
+            self._admit_prefill(leftover, free[idx : idx + len(leftover)])
+            self.prefill_admits += 1
+            self.prefill_requests += len(leftover)
+
+    def _fill_sampling_row(self, req: Request, i, base, temps, tps) -> None:
+        base[i] = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+        temps[i] = req.temperature
+        if req.top_p is not None:
+            assert self.use_top_p, (
+                "request has top_p but the engine was built with "
+                "use_top_p=False (static sampling mode)"
+            )
+            tps[i] = req.top_p
+
+    def _admit_prefill(self, misses, slots) -> None:
+        """The prefill path: batched device prefill + gather-merge, then
+        export each newcomer's prefix block into the pool."""
+        B, t = self.num_slots, self.t
+        c = self.model.cfg
         texts = np.zeros((B, t), np.int32)
         base = np.zeros((B, 2), np.uint32)
         temps = np.ones((B,), np.float32)
@@ -261,31 +440,57 @@ class DecodeEngine:
         src = np.zeros((B,), np.int32)
         take = np.zeros((B,), bool)
         now = time.monotonic()
-        for i, req in enumerate(reqs):
-            slot = free[i]
+        for i, ((req, _key), slot) in enumerate(zip(misses, slots)):
             tt = np.asarray(req.text_tokens, np.int32).reshape(-1)
             assert tt.shape[0] == t, (
                 f"request text must be [{t}] tokens, got {tt.shape}"
             )
             texts[i] = tt
-            base[i] = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
-            temps[i] = req.temperature
-            if req.top_p is not None:
-                assert self.use_top_p, (
-                    "request has top_p but the engine was built with "
-                    "use_top_p=False (static sampling mode)"
-                )
-                tps[i] = req.top_p
+            self._fill_sampling_row(req, i, base, temps, tps)
             src[slot] = i
             take[slot] = True
-            self.slot_req[slot] = req
-            self._slot_done[slot] = self.tick_count + S
-            req.admit_time = now
-            req.slot = slot  # trace track: decode occupancy lands here
-        self.state = self._admit_fn(
+            self._bind_slot(req, slot, now)
+        self.state, blocks = self._admit_fn(
             self.params, self.state, jnp.asarray(texts), jnp.asarray(base),
             jnp.asarray(temps), jnp.asarray(tps), jnp.asarray(src),
             jnp.asarray(take),
+        )
+        if self.prefix_pool is not None:
+            host = [np.array(b) for b in blocks]  # one fetch, all rows
+            for i, (req, key) in enumerate(misses):
+                tt = texts[i]
+                # remap_pad_tokens(text)[-1], computed host-side
+                first = (
+                    int(tt[-1]) if tt[-1] != 0 else c.num_text_tokens + t - 1
+                )
+                self.prefix_pool.put(
+                    key, [b[i : i + 1] for b in host], first
+                )
+
+    def _admit_pooled(self, hits, slots) -> None:
+        """The pool-hit path: stack the pooled blocks host-side and merge
+        them into slots with zero prefill compute."""
+        B = self.num_slots
+        bufs = [np.zeros(s, d) for s, d in self._block_specs]
+        first = np.zeros((B,), np.int32)
+        base = np.zeros((B, 2), np.uint32)
+        temps = np.ones((B,), np.float32)
+        tps = np.ones((B,), np.float32)
+        src = np.zeros((B,), np.int32)
+        take = np.zeros((B,), bool)
+        now = time.monotonic()
+        for i, ((req, entry), slot) in enumerate(zip(hits, slots)):
+            for buf, leaf in zip(bufs, entry.leaves):
+                buf[i] = leaf[0]
+            first[i] = entry.first
+            self._fill_sampling_row(req, i, base, temps, tps)
+            src[slot] = i
+            take[slot] = True
+            self._bind_slot(req, slot, now)
+        self.state = self._admit_cached_fn(
+            self.params, self.state, [jnp.asarray(b) for b in bufs],
+            jnp.asarray(first), jnp.asarray(base), jnp.asarray(temps),
+            jnp.asarray(tps), jnp.asarray(src), jnp.asarray(take),
         )
 
     def step(self) -> List[Request]:

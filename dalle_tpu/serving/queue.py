@@ -52,6 +52,7 @@ class Request:
     top_p: Optional[float] = None
     request_id: str = ""
     deadline_s: Optional[float] = None  # relative to arrival; None = no deadline
+    variations: int = 1  # k > 1: fan out to k seeds (seed, seed+1, ...)
     # --- filled in downstream ---
     arrival_time: Optional[float] = None
     admit_time: Optional[float] = None
@@ -65,8 +66,20 @@ class Request:
     retries: int = 0  # crash-recovery replays consumed so far
     service_tier: int = 0  # degradation tier the request was served at
     slot: Optional[int] = None  # engine slot last occupied (trace track)
+    # --- serving-cache bookkeeping (docs/SERVING.md §7) ---
+    cache_hit: bool = False  # served from the result cache, zero device work
+    cache_key: Optional[str] = None  # content address under the result cache
+    # --- variations fan-out (k seeded children of one parent) ---
+    parent: Optional["Request"] = field(default=None, repr=False)
+    variant_index: Optional[int] = None  # this child's position in the fan
+    variants: Optional[List["Request"]] = field(default=None, repr=False)
     _done: threading.Event = field(
         default_factory=threading.Event, repr=False, compare=False
+    )
+    _notified: bool = field(default=False, repr=False, compare=False)
+    _variants_left: int = field(default=0, repr=False, compare=False)
+    _vlock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
     )
 
     def __post_init__(self):
@@ -105,6 +118,43 @@ class Request:
         if self.error is None:
             self.error = reason
         self.dropped = self.dropped or dropped
+        self._mark_done()
+
+    def _mark_done(self) -> None:
+        """Terminal transition (success OR failure): release ``result()``
+        waiters and, for a variations child, notify the parent exactly
+        once — a request can reach terminal state from several paths
+        (detok worker, shed, eviction, crash budget) and the parent's
+        fan-in count must not double-decrement."""
+        with self._vlock:
+            already = self._notified
+            self._notified = True
+        self._done.set()
+        if not already and self.parent is not None:
+            self.parent._variant_done()
+
+    def _variant_done(self) -> None:
+        """One child of this variations parent reached terminal state.
+        When the last one lands, aggregate: ``variants`` keeps the
+        per-seed children (each with its own codes/image/error),
+        ``codes`` stacks the successful children's codes in fan order,
+        and the parent is dropped only if EVERY child was."""
+        with self._vlock:
+            self._variants_left -= 1
+            if self._variants_left > 0:
+                return
+        kids = self.variants or []
+        errs = [f"#v{k.variant_index}: {k.error}" for k in kids
+                if k.error is not None]
+        if errs and self.error is None:
+            self.error = "; ".join(errs)
+        self.dropped = bool(kids) and all(k.dropped for k in kids)
+        good = [k.codes for k in kids if k.codes is not None]
+        if good and len(good) == len(kids):
+            self.codes = np.stack(good)
+        done = [k.finish_time for k in kids if k.finish_time is not None]
+        if done:
+            self.finish_time = max(done)
         self._done.set()
 
 

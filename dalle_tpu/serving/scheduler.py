@@ -37,12 +37,19 @@ import math
 import queue as pyqueue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
 from dalle_tpu import telemetry
+from dalle_tpu.serving.cache import (
+    PrefixPool,
+    ResultCache,
+    model_fingerprint,
+    request_key,
+)
 from dalle_tpu.serving.engine import DecodeEngine
 from dalle_tpu.serving.queue import Request, RequestQueue
 from dalle_tpu.telemetry import MetricsRegistry
@@ -158,12 +165,26 @@ class Scheduler:
         evict_unmeetable: bool = True,
         metrics: Optional[MetricsRegistry] = None,
         tracer=None,
+        result_cache: Optional[ResultCache] = None,
+        fingerprint: Optional[str] = None,
     ):
         assert policy in POLICIES, f"policy must be one of {POLICIES}"
         self.engine = engine
         self.queue = req_queue
         self.policy = policy
         self.on_result = on_result
+        # --- serving cache (docs/SERVING.md §7) ---
+        self.result_cache = result_cache
+        if result_cache is not None and fingerprint is None:
+            fingerprint = model_fingerprint(engine.model.cfg)
+        self.fingerprint = fingerprint
+        # in-flight dedup: cache_key -> {"original": Request,
+        # "followers": [Request]}; followers ride the original's decode
+        self._inflight: dict = {}
+        # admission-ready requests that never touch the client queue:
+        # variations children + followers orphaned by a failed original
+        self._ready: deque = deque()
+        self._prefix_seen = 0  # engine.prefix_reuses watermark
         self.idle_wait = idle_wait
         self.max_engine_restarts = int(max_engine_restarts)
         self.max_request_retries = int(max_request_retries)
@@ -197,6 +218,9 @@ class Scheduler:
         self._c_evicted = metrics.counter("serve_evicted")
         self._c_replays = metrics.counter("serve_replays")
         self._c_restarts = metrics.counter("serve_engine_restarts")
+        self._c_cache_hits = metrics.counter("serve_cache_hits")
+        self._c_cache_misses = metrics.counter("serve_cache_misses")
+        self._c_prefix = metrics.counter("serve_prefix_reuses")
         self._h_tick = metrics.histogram("serve_tick_s")
         self._h_queue_wait = metrics.histogram("serve_queue_wait_s")
         self._h_decode = metrics.histogram("serve_decode_s")
@@ -293,7 +317,7 @@ class Scheduler:
                         print(f"[serve] on_result failed for "
                               f"{req.request_id}: {e}")
             finally:
-                req._done.set()
+                req._mark_done()  # releases waiters + variations fan-in
 
     # --- admission -------------------------------------------------------
     def _want(self, n_free: int) -> int:
@@ -328,6 +352,142 @@ class Scheduler:
                 keep.append(r)
         return keep
 
+    # --- serving cache + variations (docs/SERVING.md §7) -----------------
+    def _request_key(self, req: Request) -> str:
+        """Content address of ``req``'s codes under THIS engine: model
+        fingerprint + text + seed + the full sampling tuple."""
+        return request_key(
+            self.fingerprint, req.text_tokens, seed=req.seed,
+            temperature=req.temperature, top_p=req.top_p,
+            filter_thres=self.engine.filter_thres,
+            use_top_p=self.engine.use_top_p,
+        )
+
+    def _fan_out(self, req: Request) -> List[Request]:
+        """Expand a ``variations=k`` request into k seeded children
+        (seed, seed+1, ... — exactly what k independent submissions with
+        those seeds would decode).  The parent never enters the engine;
+        it completes when the last child does, with the children's codes
+        stacked in fan order."""
+        kids = [
+            Request(
+                text_tokens=req.text_tokens, seed=req.seed + i,
+                temperature=req.temperature, top_p=req.top_p,
+                request_id=f"{req.request_id}#v{i}",
+                deadline_s=req.deadline_s, arrival_time=req.arrival_time,
+                parent=req, variant_index=i,
+            )
+            for i in range(req.variations)
+        ]
+        req.variants = kids
+        with req._vlock:
+            req._variants_left = len(kids)
+        log_event("serve_variations", request_id=req.request_id,
+                  k=len(kids))
+        return kids
+
+    def _serve_from_cache(self, req: Request, codes: np.ndarray) -> None:
+        """Complete ``req`` from the result cache: zero device work, no
+        slot, no admission — straight to the detok worker.  Counts as a
+        completion (the codes ARE what a decode would have produced —
+        bitwise, by the determinism contract)."""
+        req.cache_hit = True
+        req.codes = np.array(codes)  # private copy of the shared entry
+        req.finish_time = time.monotonic()
+        self._c_cache_hits.inc()
+        self._c_completed.inc()
+        if req.ttlt is not None:
+            self._h_ttlt.observe(req.ttlt)
+        log_event("serve_cache_hit", request_id=req.request_id,
+                  key=req.cache_key[:16])
+        self.completed.append(req)
+        self._detok_q.put(req)
+
+    def _requeue_followers(self, req: Request) -> None:
+        """``req`` — an in-flight dedup original — terminally failed:
+        its followers go back to the admission-ready list, where the
+        first becomes the new original (or hits the cache if the codes
+        landed before the failure)."""
+        if req.cache_key is None:
+            return
+        ent = self._inflight.get(req.cache_key)
+        if ent is None or ent["original"] is not req:
+            return
+        del self._inflight[req.cache_key]
+        self._ready.extend(ent["followers"])
+
+    def _resolve_cache(self, req: Request) -> None:
+        """An engine-decoded request completed: store its codes under its
+        content address and serve every follower that deduped onto it."""
+        if self.result_cache is None or req.cache_key is None:
+            return
+        ent = self._inflight.pop(req.cache_key, None)
+        if req.codes is not None:
+            self.result_cache.put(req.cache_key, req.codes)
+            log_event("serve_cache_store", request_id=req.request_id,
+                      key=req.cache_key[:16],
+                      cache_bytes=self.result_cache.bytes)
+        if ent is None or not ent["followers"]:
+            return
+        codes = self.result_cache.get(req.cache_key)
+        for f in ent["followers"]:
+            if codes is not None:
+                self._serve_from_cache(f, codes)
+            else:  # store raced an eviction storm: decode it after all
+                self._ready.append(f)
+
+    def _next_admittable(self, want: int) -> List[Request]:
+        """Pull up to ``want`` engine-bound requests, resolving the cache
+        tiers on the way: variations fan out to children, exact-duplicate
+        requests complete from the result cache (or attach as followers
+        of an identical in-flight decode), and only genuinely new work
+        reaches the engine.  ``self._ready`` (children + orphaned
+        followers) is served before the client queue."""
+        out: List[Request] = []
+        while len(out) < want:
+            if self._ready:
+                r = self._ready.popleft()
+            else:
+                got = self.queue.pop(1)
+                if not got:
+                    break
+                r = got[0]
+            if not self._drop_expired([r]):
+                self._requeue_followers(r)
+                continue
+            if r.variations > 1 and r.variants is None:
+                self._ready.extendleft(reversed(self._fan_out(r)))
+                continue
+            if self.result_cache is not None:
+                if r.cache_key is None:
+                    r.cache_key = self._request_key(r)
+                ent = self._inflight.get(r.cache_key)
+                if ent is not None and ent["original"] is r:
+                    out.append(r)  # crash-recovery replay of the original
+                    continue
+                codes = self.result_cache.get(r.cache_key)
+                if codes is not None:
+                    self._serve_from_cache(r, codes)
+                    continue
+                if ent is not None:
+                    ent["followers"].append(r)
+                    continue
+                self._inflight[r.cache_key] = {"original": r,
+                                               "followers": []}
+                self._c_cache_misses.inc()
+            out.append(r)
+        return out
+
+    def _sync_prefix_counter(self) -> None:
+        """Mirror the engine's prefix-reuse count (which survives
+        ``reset()``) into the registry, logging each fresh reuse batch."""
+        d = self.engine.prefix_reuses - self._prefix_seen
+        if d > 0:
+            self._prefix_seen = self.engine.prefix_reuses
+            self._c_prefix.inc(d)
+            log_event("serve_prefix_reuse", n=d,
+                      total=self.engine.prefix_reuses)
+
     def _evict_unmeetable_slots(self):
         """Mid-flight eviction: a slot whose remaining decode time
         provably exceeds its deadline is freed for admittable work.
@@ -357,6 +517,7 @@ class Scheduler:
                     f"unmeetable ({rem} ticks remaining at "
                     f"~{(self._tick_ewma or 0.0):.4f}s/tick)"
                 )
+                self._requeue_followers(req)
                 self.completed.append(req)
                 self._c_evicted.inc()
                 self._c_failed.inc()
@@ -402,6 +563,7 @@ class Scheduler:
                     f"engine crashed {r.retries}x during decode "
                     f"(retry budget {self.max_request_retries}): {exc}"
                 )
+                self._requeue_followers(r)
                 self._c_failed.inc()
                 self.completed.append(r)
                 failed.append(r.request_id)
@@ -439,6 +601,21 @@ class Scheduler:
                 req._fail(reason)
                 self._c_failed.inc()
                 self.completed.append(req)
+        # dedup followers + not-yet-admitted children/orphans live outside
+        # both the queue and the engine — release their waiters too
+        for ent in list(self._inflight.values()):
+            for req in ent["followers"]:
+                if not req._done.is_set():
+                    req._fail(reason)
+                    self._c_failed.inc()
+                    self.completed.append(req)
+        self._inflight.clear()
+        while self._ready:
+            req = self._ready.popleft()
+            if not req._done.is_set():
+                req._fail(reason)
+                self._c_failed.inc()
+                self.completed.append(req)
 
     # --- main loop -------------------------------------------------------
     def _serve_tick(self) -> bool:
@@ -447,11 +624,12 @@ class Scheduler:
         self._evict_unmeetable_slots()
         want = self._want(len(eng.free_slots()))
         if want:
-            reqs = self._drop_expired(self.queue.pop(want))
+            reqs = self._next_admittable(want)
             if reqs:
                 with self.tracer.span("admit", track="scheduler",
                                       n=len(reqs)):
                     eng.admit(reqs)
+                self._sync_prefix_counter()
                 self._c_admitted.inc(len(reqs))
                 for r in reqs:
                     # retrospective span: enqueue -> admission (EDF wait)
@@ -488,7 +666,9 @@ class Scheduler:
                     self._h_ttlt.observe(req.ttlt)
                 self.completed.append(req)
                 self._detok_q.put(req)
-        elif self.queue.closed and self.queue.pending() == 0:
+                self._resolve_cache(req)
+        elif (self.queue.closed and self.queue.pending() == 0
+              and not self._ready):
             drained = True
         else:
             self.queue.wait(timeout=self.idle_wait)
@@ -498,6 +678,8 @@ class Scheduler:
         g("serve_pending").set(self.queue.pending())
         g("serve_detok_backlog").set(backlog)
         g("serve_occupancy").set(eng.num_active)
+        if self.result_cache is not None:
+            g("serve_cache_bytes").set(self.result_cache.bytes)
         if self._tick_ewma is not None:
             g("serve_tick_ewma_s").set(self._tick_ewma)
         if self._degrade is not None:
@@ -545,10 +727,22 @@ class Scheduler:
             "ticks": self.engine.tick_count,
             **request_stats(self.completed, self.engine.S),
         }
+        cache_bytes = (
+            self.result_cache.bytes if self.result_cache is not None else 0
+        )
+        # keep the gauge pinned to the value stats() reports
+        self.metrics.gauge("serve_cache_bytes").set(cache_bytes)
         out.update(
             admitted=self._c_admitted.value,
             failed=self._c_failed.value,
             shed=len(self.queue.shed),
+            cache_hits=self._c_cache_hits.value,
+            cache_misses=self._c_cache_misses.value,
+            prefix_reuses=self._c_prefix.value,
+            cache_bytes=cache_bytes,
+            prefill_requests=self.engine.prefill_requests,
+            prefill_admits=self.engine.prefill_admits,
+            pool_admits=self.engine.pool_admits,
             max_pending_seen=self.queue.max_pending_seen,
             evicted_midflight=self._c_evicted.value,
             engine_restarts=self._c_restarts.value,
@@ -578,6 +772,39 @@ class TraceItem:
     top_p: Optional[float] = None
     deadline_s: Optional[float] = None
     request_id: str = ""
+    variations: int = 1
+
+
+def make_zipf_trace(
+    n: int, rate_hz: float, text_seq_len: int, num_text_tokens: int,
+    *, alpha: float = 1.1, num_prompts: int = 32, seeds_per_prompt: int = 4,
+    seed: int = 0,
+) -> List[TraceItem]:
+    """Poisson arrivals whose prompts follow a Zipf(``alpha``) popularity
+    law over ``num_prompts`` distinct texts — the redundancy profile of
+    real image-generation traffic (FastUSP, PAPERS.md).  Each arrival
+    draws one of ``seeds_per_prompt`` seeds for its prompt, so the trace
+    contains both exact (text, seed) repeats (result-cache hits) and
+    same-text-new-seed arrivals (prefix-pool reuses).  Seeds are distinct
+    across prompts, so identical codes always mean a cache hit, never a
+    seed collision."""
+    assert alpha > 1.0, f"numpy's Zipf sampler needs alpha > 1, got {alpha}"
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+    prompts = rng.randint(
+        1, num_text_tokens, size=(num_prompts, text_seq_len)
+    )
+    pid = (rng.zipf(alpha, size=n) - 1) % num_prompts
+    sid = rng.randint(0, seeds_per_prompt, size=n)
+    return [
+        TraceItem(
+            arrival_s=float(a),
+            text_tokens=prompts[pid[i]].astype(np.int32),
+            seed=int(pid[i] * seeds_per_prompt + sid[i]),
+            request_id=f"zipf{i}",
+        )
+        for i, a in enumerate(arrivals)
+    ]
 
 
 def make_poisson_trace(
@@ -610,6 +837,7 @@ def save_trace(path: str, trace: Sequence[TraceItem]):
                 "top_p": it.top_p,
                 "deadline_s": it.deadline_s,
                 "request_id": it.request_id,
+                "variations": it.variations,
             }) + "\n")
 
 
@@ -629,6 +857,7 @@ def load_trace(path: str) -> List[TraceItem]:
                 top_p=d.get("top_p"),
                 deadline_s=d.get("deadline_s"),
                 request_id=d.get("request_id", ""),
+                variations=int(d.get("variations", 1)),
             ))
     return trace
 
@@ -648,6 +877,11 @@ def replay_trace(
     clip_params=None,
     max_pending: Optional[int] = None,
     shed_policy: str = "reject",
+    result_cache: Optional[ResultCache] = None,
+    result_cache_bytes: Optional[int] = None,
+    prefix_pool: Optional[PrefixPool] = None,
+    prefix_pool_bytes: Optional[int] = None,
+    fingerprint: Optional[str] = None,
     **scheduler_kwargs,
 ) -> dict:
     """Replay a recorded arrival trace against a fresh engine.
@@ -657,18 +891,26 @@ def replay_trace(
     engine is warmed up first so XLA compile time never lands in the
     latency numbers.  ``sequential`` forces a single-slot engine
     (batch-of-1 by construction).  ``max_pending``/``shed_policy`` bound
-    the queue (overload experiments); extra keyword arguments reach the
+    the queue (overload experiments); ``result_cache``/``prefix_pool``
+    (or the ``*_bytes`` shorthands, which build fresh ones) enable the
+    serving cache tiers; extra keyword arguments reach the
     :class:`Scheduler` (degradation, restart budgets, ...)."""
+    if result_cache is None and result_cache_bytes:
+        result_cache = ResultCache(result_cache_bytes)
+    if prefix_pool is None and prefix_pool_bytes:
+        prefix_pool = PrefixPool(prefix_pool_bytes)
     B = 1 if policy == "sequential" else num_slots
     engine = DecodeEngine(
         model, params, num_slots=B, filter_thres=filter_thres,
         use_top_p=any(it.top_p is not None for it in trace),
+        prefix_pool=prefix_pool,
     )
     engine.warmup()
     q = RequestQueue(max_pending=max_pending, shed_policy=shed_policy)
     sched = Scheduler(
         engine, q, policy=policy, vae=vae, vae_params=vae_params,
-        clip=clip, clip_params=clip_params, **scheduler_kwargs,
+        clip=clip, clip_params=clip_params, result_cache=result_cache,
+        fingerprint=fingerprint, **scheduler_kwargs,
     )
 
     def feeder():
@@ -681,6 +923,7 @@ def replay_trace(
                 text_tokens=it.text_tokens, seed=it.seed,
                 temperature=it.temperature, top_p=it.top_p,
                 deadline_s=it.deadline_s, request_id=it.request_id,
+                variations=it.variations,
             ))
         q.close()
 

@@ -53,6 +53,15 @@ EVENT_KINDS: Dict[str, str] = {
                       "deterministically replayed",
     "serve_summary": "final Scheduler.stats() emitted at serve shutdown "
                      "(clean or supervisor-exhausted)",
+    # --- serving cache (dalle_tpu/serving/cache/) ------------------------
+    "serve_cache_hit": "request completed from the content-addressed "
+                       "result cache (zero device work)",
+    "serve_cache_store": "finished codes stored under their content "
+                         "address",
+    "serve_prefix_reuse": "admission reused pooled text-KV blocks "
+                          "instead of device prefill",
+    "serve_variations": "variations request fanned out to k seeded "
+                        "children",
     # --- telemetry / profiling (dalle_tpu/telemetry/) --------------------
     "telemetry_enabled": "telemetry session configured (run dir, "
                          "snapshot interval)",

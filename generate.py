@@ -59,6 +59,11 @@ def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
         deadline_s = float(deadline_s)
         if deadline_s < 0:
             raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+    variations = int(d.get("variations", 1))
+    if not (1 <= variations <= 64):
+        raise ValueError(
+            f"variations must be in [1, 64], got {variations}"
+        )
     tokens = tokenizer.tokenize(
         text, text_seq_len, truncate_text=True
     ).astype(np.int32)[0]
@@ -69,6 +74,7 @@ def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
         top_p=top_p,
         deadline_s=deadline_s,
         request_id=str(d.get("id", f"req{i}")),
+        variations=variations,
     )
 
 
@@ -87,6 +93,16 @@ def validate_serve_flags(args) -> list:
         errors.append(
             f"--shed_policy {args.shed_policy} requires --max_queue "
             "(an unbounded queue never sheds)"
+        )
+    if args.cache_bytes < 0:
+        errors.append(
+            f"--cache_bytes must be >= 0 (0 disables), got "
+            f"{args.cache_bytes}"
+        )
+    if args.prefix_pool_bytes < 0:
+        errors.append(
+            f"--prefix_pool_bytes must be >= 0 (0 disables), got "
+            f"{args.prefix_pool_bytes}"
         )
     return errors
 
@@ -127,6 +143,19 @@ def parse_args(argv=None):
                              "the longest-queued (evict_oldest), or the "
                              "one with the most deadline slack "
                              "(evict_latest_deadline)")
+    # serving cache tiers (dalle_tpu/serving/cache/, docs/SERVING.md §7):
+    # content-addressed result dedup + shared-prefix KV reuse.  Requests
+    # may also carry "variations": k to fan one text out to k seeds.
+    parser.add_argument("--cache_bytes", type=int, default=0,
+                        help="result-cache budget in bytes: duplicate "
+                             "(text, seed, sampling) requests complete "
+                             "from cached codes with zero device work "
+                             "(LRU; 0 disables)")
+    parser.add_argument("--prefix_pool_bytes", type=int, default=0,
+                        help="shared-prefix KV pool budget in bytes: "
+                             "repeated texts skip device prefill, reusing "
+                             "the pooled text-KV block bitwise "
+                             "(LRU; 0 disables)")
     parser.add_argument("--degrade", action="store_true",
                         help="under sustained queue pressure, drop to "
                              "cheaper service tiers (skip CLIP rerank, "
@@ -528,7 +557,8 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
             )
         score = (f" clip={req.clip_score:.4f}"
                  if req.clip_score is not None else "")
-        print(f"[{req.request_id}] done: ttlt={req.ttlt:.3f}s{score}")
+        cached = " (cached)" if req.cache_hit else ""
+        print(f"[{req.request_id}] done: ttlt={req.ttlt:.3f}s{score}{cached}")
 
     try:
         errors_path = outdir / "errors.jsonl"
@@ -543,9 +573,28 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                 ) + "\n")
             print(f"[{req.request_id}] shed: {req.error}")
 
+        # serving cache tiers (docs/SERVING.md §7): the fingerprint binds
+        # every cache key to THIS checkpoint + output-changing config, so
+        # a reloaded or different checkpoint can never serve stale codes
+        from dalle_tpu.serving import (
+            PrefixPool, ResultCache, model_fingerprint,
+        )
+
+        result_cache = (
+            ResultCache(args.cache_bytes) if args.cache_bytes > 0 else None
+        )
+        prefix_pool = (
+            PrefixPool(args.prefix_pool_bytes)
+            if args.prefix_pool_bytes > 0 else None
+        )
+        fingerprint = (
+            model_fingerprint(cfg, checkpoint_path=args.dalle_path)
+            if result_cache is not None else None
+        )
         engine = DecodeEngine(
             model, params, num_slots=args.serve_slots,
             filter_thres=args.top_k, use_top_p=args.top_p is not None,
+            prefix_pool=prefix_pool,
         )
         engine.warmup()
         req_queue = RequestQueue(
@@ -556,12 +605,15 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
             engine, req_queue, policy=args.serve_policy,
             vae=vae, vae_params=vae_params, clip=clip,
             clip_params=clip_params, on_result=on_result,
-            degrade=args.degrade,
+            degrade=args.degrade, result_cache=result_cache,
+            fingerprint=fingerprint,
         )
         print(f"serving: {args.serve_slots} slots, policy "
               f"{args.serve_policy}, "
               f"max_queue={args.max_queue or 'unbounded'} "
-              f"shed={args.shed_policy} degrade={args.degrade}, stream "
+              f"shed={args.shed_policy} degrade={args.degrade}, "
+              f"cache={args.cache_bytes or 'off'} "
+              f"prefix_pool={args.prefix_pool_bytes or 'off'}, stream "
               f"{'stdin' if args.serve == '-' else args.serve}")
 
         def reject(req_id, line_no, reason):

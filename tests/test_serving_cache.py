@@ -1,0 +1,377 @@
+"""Serving cache subsystem tests (dalle_tpu/serving/cache/,
+docs/SERVING.md §7).
+
+The contract under test: caching is a pure admission-cost optimisation —
+warm-path codes are BITWISE the cold path's, across sampling modes and
+cache layouts.  Pinned here, fast (tier-1):
+
+* ResultCache / PrefixPool LRU semantics — byte budget enforced with a
+  floor of one entry, idempotent put, MRU refresh on get, entries
+  returned read-only;
+* fingerprint keying — compute-policy flags (fused_decode, use_flash,
+  precision) do NOT change the key; output-changing knobs (kv_int8),
+  weights identity (checkpoint_path) and step DO;
+* request_key discrimination — seed / temperature / top_p / filter_thres
+  all key separately; identical inputs key identically across calls;
+* engine pooled admission — a text admitted off the shared-prefix KV
+  pool decodes bitwise as a prefilled admission (greedy + sampled,
+  kv_int8 on/off) while `_admit_fn` AND `_admit_cached_fn` each compile
+  exactly once across occupancy x hit/miss churn;
+* scheduler dedup — k duplicate in-flight requests pay ONE device
+  prefill/decode and all k complete with equal codes (1 miss + k-1
+  hits, ``served == k``);
+* variations fan-out — ``variations=k`` returns codes bitwise equal to
+  k independent requests at seeds ``seed..seed+k-1``;
+* stats/telemetry reconciliation and Zipf-trace determinism.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.generate import generate_image_codes
+from dalle_tpu.serving import (
+    DecodeEngine,
+    PrefixPool,
+    Request,
+    RequestQueue,
+    ResultCache,
+    Scheduler,
+    make_zipf_trace,
+    model_fingerprint,
+    request_key,
+)
+
+T, F = 4, 2
+N_IMG = F * F
+GREEDY = dict(temperature=1e-8)
+
+
+def build(rng, *, kv_int8=False, **kw):
+    kw.setdefault("image_fmap_size", F)
+    cfg = DALLEConfig(
+        num_text_tokens=30,
+        text_seq_len=T,
+        num_image_tokens=20,
+        dim=32,
+        depth=2,
+        heads=2,
+        dim_head=16,
+        **kw,
+    )
+    text = jax.random.randint(rng, (3, T), 1, 30)
+    codes = jax.random.randint(rng, (3, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    if kv_int8:
+        from dalle_tpu.models.quantize import kv_int8_model
+
+        model = kv_int8_model(model)
+    return model, params, text
+
+
+def serve_burst(model, params, reqs, *, num_slots=3, filter_thres=0.0,
+                result_cache=None, prefix_pool=None, **sched_kw):
+    """Submit ``reqs`` as a burst through a fresh engine + scheduler
+    (optionally cache-enabled), drain, return (scheduler, stats)."""
+    engine = DecodeEngine(
+        model, params, num_slots=num_slots, filter_thres=filter_thres,
+        prefix_pool=prefix_pool,
+    )
+    engine.warmup()
+    q = RequestQueue()
+    for r in reqs:
+        q.submit(r)
+    q.close()
+    sched = Scheduler(engine, q, policy="continuous",
+                      result_cache=result_cache, **sched_kw)
+    stats = sched.run()
+    return sched, stats
+
+
+# --- LRU byte budgets ---------------------------------------------------
+
+
+def test_result_cache_lru_eviction_and_floor():
+    codes = np.arange(N_IMG, dtype=np.int32)
+    cache = ResultCache(max_bytes=3 * codes.nbytes)
+    for i in range(5):
+        cache.put(f"k{i}", codes + i)
+    # budget holds: the 2 oldest were evicted, 3 newest retained LRU-first
+    assert len(cache) == 3 and cache.bytes <= cache.max_bytes
+    assert "k0" not in cache and "k1" not in cache
+    for i in (2, 3, 4):
+        np.testing.assert_array_equal(cache.get(f"k{i}"), codes + i)
+
+    # get() refreshes recency: touch k2, insert one more -> k3 evicted
+    cache.get("k2")
+    cache.put("k5", codes + 5)
+    assert "k2" in cache and "k3" not in cache and "k5" in cache
+
+    # floor of one: an entry larger than the whole budget is still held
+    tiny = ResultCache(max_bytes=1)
+    tiny.put("big", codes)
+    assert len(tiny) == 1 and "big" in tiny
+    np.testing.assert_array_equal(tiny.get("big"), codes)
+
+
+def test_result_cache_idempotent_put_and_readonly():
+    codes = np.arange(N_IMG, dtype=np.int32)
+    cache = ResultCache(max_bytes=1 << 20)
+    cache.put("k", codes)
+    nbytes = cache.bytes
+    cache.put("k", codes + 99)  # repeat put does not clobber or double
+    assert cache.bytes == nbytes
+    got = cache.get("k")
+    np.testing.assert_array_equal(got, codes)
+    assert not got.flags.writeable  # shared entry is tamper-proof
+    # the cache copied on put: mutating the caller's array changes nothing
+    codes += 7
+    np.testing.assert_array_equal(cache.get("k"), np.arange(N_IMG))
+    s = cache.stats()
+    assert s["hits"] == 2 and s["misses"] == 0 and s["entries"] == 1
+
+
+def test_prefix_pool_lru_and_floor():
+    def leaves(i):
+        return [np.full((1, 2, T, 3), i, np.float32),
+                np.full((1, T, 4), i, np.int8)]
+
+    nbytes = sum(a.nbytes for a in leaves(0))
+    pool = PrefixPool(max_bytes=2 * nbytes)
+    for i in range(4):
+        pool.put(f"t{i}", leaves(i), first=10 + i)
+    assert len(pool) == 2 and pool.bytes <= pool.max_bytes
+    assert pool.get("t0") is None and pool.get("t1") is None
+    e = pool.get("t3")
+    assert e is not None and e.first == 13 and e.nbytes == nbytes
+    for leaf, want in zip(e.leaves, leaves(3)):
+        np.testing.assert_array_equal(leaf, want)
+        assert not leaf.flags.writeable
+
+    # floor of one entry even when a single entry exceeds the budget
+    tiny = PrefixPool(max_bytes=1)
+    tiny.put("big", leaves(9), first=5)
+    assert len(tiny) == 1 and tiny.get("big") is not None
+
+
+# --- fingerprint / request keying ---------------------------------------
+
+
+def test_fingerprint_policy_invariance():
+    import dataclasses
+
+    base = DALLEConfig(num_text_tokens=30, text_seq_len=T,
+                       num_image_tokens=20, image_fmap_size=F, dim=32,
+                       depth=2, heads=2, dim_head=16)
+    fp = model_fingerprint(base)
+    # pure compute policies re-route the SAME math: the key is stable
+    for policy in (dict(fused_decode=True), dict(use_flash=True),
+                   dict(fused_ff=True), dict(dtype="bfloat16"),
+                   dict(stream_dtype="bfloat16")):
+        same = dataclasses.replace(base, **policy)
+        assert model_fingerprint(same) == fp, f"{policy} changed the key"
+    # output-changing knobs and weight identity MUST change the key
+    assert model_fingerprint(dataclasses.replace(base, kv_int8=True)) != fp
+    assert model_fingerprint(dataclasses.replace(base, depth=3)) != fp
+    assert model_fingerprint(base, checkpoint_path="ckpt_a") != fp
+    assert (model_fingerprint(base, checkpoint_path="ckpt_a")
+            != model_fingerprint(base, checkpoint_path="ckpt_b"))
+    assert (model_fingerprint(base, checkpoint_path="c", step=1)
+            != model_fingerprint(base, checkpoint_path="c", step=2))
+
+
+def test_request_key_discriminates_and_is_stable():
+    tt = np.arange(1, T + 1, dtype=np.int32)
+    kw = dict(seed=3, temperature=1.0, top_p=None, filter_thres=0.9,
+              use_top_p=False)
+    k0 = request_key("fp", tt, **kw)
+    assert request_key("fp", tt.copy(), **kw) == k0  # stable across calls
+    variants = [
+        dict(kw, seed=4),
+        dict(kw, temperature=0.5),
+        dict(kw, filter_thres=0.8),
+        dict(kw, use_top_p=True, top_p=0.9),
+    ]
+    keys = {k0} | {request_key("fp", tt, **v) for v in variants}
+    assert len(keys) == 1 + len(variants)  # every knob keys separately
+    assert request_key("other_fp", tt, **kw) != k0
+    assert request_key("fp", tt + 1, **kw) != k0
+
+
+# --- engine: pooled admission bitwise + no-recompile --------------------
+
+
+@pytest.mark.parametrize("kv_int8", [False, True])
+@pytest.mark.parametrize("sampled", [False, True])
+def test_engine_pool_admission_bitwise_matches_cold(rng, kv_int8, sampled):
+    """A request admitted off the prefix pool (same text, new seed — no
+    device prefill) produces BITWISE the codes of a cold prefilled
+    admission, greedy and sampled, with int8 KV on/off; the pooled
+    admit path compiles exactly once alongside the prefill path."""
+    model, params, _ = build(rng, kv_int8=kv_int8)
+    c = model.cfg
+    temp = 0.7 if sampled else 1e-8
+    texts = np.asarray(
+        jax.random.randint(rng, (2, T), 1, c.num_text_tokens))
+
+    def mk(ti, seed):
+        return Request(text_tokens=texts[ti], seed=seed,
+                       temperature=temp, request_id=f"t{ti}s{seed}")
+
+    def drain(engine, reqs, stagger_at=0):
+        pending = list(reqs)
+        first = [pending.pop(0), pending.pop(0)]
+        engine.admit(first)
+        while pending or engine.num_active:
+            if (engine.tick_count >= stagger_at and pending
+                    and engine.free_slots()):
+                engine.admit([pending.pop(0)])
+            engine.step()
+
+    spec = [(0, 1), (1, 2), (0, 5), (1, 6)]  # 2 texts x 2 seeds
+
+    cold = DecodeEngine(model, params, num_slots=3, filter_thres=0.0)
+    cold.warmup()
+    cold_reqs = [mk(*s) for s in spec]
+    drain(cold, cold_reqs)  # 3rd request admitted as soon as a slot frees
+    assert cold.pool_admits == 0
+
+    pool = PrefixPool(1 << 20)
+    warm = DecodeEngine(model, params, num_slots=3, filter_thres=0.0,
+                        prefix_pool=pool)
+    warm.warmup()
+    warm_reqs = [mk(*s) for s in spec]
+    # stagger so the pooled admissions land at partial occupancy too
+    drain(warm, warm_reqs, stagger_at=2)
+    # 2 distinct texts prefill; the 2 repeats ride the pool
+    assert warm.prefill_requests == 2 and warm.prefix_reuses == 2
+    assert warm._admit_fn._cache_size() == 1
+    assert warm._admit_cached_fn._cache_size() == 1
+    assert warm._tick_fn._cache_size() == 1
+
+    for a, b in zip(cold_reqs, warm_reqs):
+        np.testing.assert_array_equal(
+            a.codes, b.codes,
+            err_msg=f"{a.request_id}: pooled admission != cold "
+                    f"(kv_int8={kv_int8}, sampled={sampled})",
+        )
+
+
+def test_engine_same_batch_duplicates_prefill_once(rng):
+    """k same-text requests arriving in ONE admit batch still pay a
+    single prefill — the batch-local dedup resolves the repeats off the
+    block exported by the first."""
+    model, params, _ = build(rng)
+    text = np.asarray(jax.random.randint(rng, (T,), 1, 30))
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=0.0,
+                          prefix_pool=PrefixPool(1 << 20))
+    engine.warmup()
+    reqs = [Request(text_tokens=text, seed=i, temperature=1e-8,
+                    request_id=f"d{i}") for i in range(3)]
+    engine.admit(reqs)
+    while engine.num_active:
+        engine.step()
+    assert engine.prefill_requests == 1 and engine.prefix_reuses == 2
+    for r in reqs[1:]:
+        np.testing.assert_array_equal(r.codes, reqs[0].codes)
+
+
+# --- scheduler: dedup, variations, stats --------------------------------
+
+
+def test_scheduler_duplicate_inflight_single_decode(rng):
+    """k identical (text, seed) requests: ONE device prefill+decode, all
+    k served with equal codes, counters read 1 miss + (k-1) hits and
+    ``served == serve_completed`` holds."""
+    model, params, _ = build(rng)
+    text = np.asarray(jax.random.randint(rng, (T,), 1, 30))
+    k = 5
+    reqs = [Request(text_tokens=text, seed=7, temperature=1e-8,
+                    request_id=f"dup{i}") for i in range(k)]
+    sched, stats = serve_burst(
+        model, params, reqs,
+        result_cache=ResultCache(1 << 20), prefix_pool=PrefixPool(1 << 20),
+    )
+    assert stats["served"] == k
+    assert stats["prefill_requests"] == 1
+    assert stats["cache_misses"] == 1 and stats["cache_hits"] == k - 1
+    assert stats["cache_bytes"] > 0
+    # PR-7 reconciliation pattern: stats() is a registry read — every
+    # cache stat equals its counter/gauge EXACTLY
+    reg = sched.metrics
+    assert reg.counter("serve_completed").value == k
+    assert reg.counter("serve_cache_hits").value == stats["cache_hits"]
+    assert reg.counter("serve_cache_misses").value == stats["cache_misses"]
+    assert reg.counter("serve_prefix_reuses").value == stats["prefix_reuses"]
+    assert reg.gauge("serve_cache_bytes").value == stats["cache_bytes"]
+    base = reqs[0].result().codes
+    for r in reqs[1:]:
+        np.testing.assert_array_equal(r.result().codes, base)
+
+
+def test_scheduler_cache_hit_skips_device_entirely(rng):
+    """A repeat request against a WARM cache is answered at admission:
+    zero additional prefills, zero additional ticks of decode for it."""
+    model, params, _ = build(rng)
+    text = np.asarray(jax.random.randint(rng, (T,), 1, 30))
+    rc, pool = ResultCache(1 << 20), PrefixPool(1 << 20)
+
+    def one():
+        return [Request(text_tokens=text, seed=3, temperature=1e-8,
+                        request_id="w")]
+
+    first = one()
+    _, s1 = serve_burst(model, params, first, result_cache=rc,
+                        prefix_pool=pool)
+    again = one()
+    _, s2 = serve_burst(model, params, again, result_cache=rc,
+                        prefix_pool=pool)
+    assert s1["prefill_requests"] == 1 and s2["prefill_requests"] == 0
+    assert s2["cache_hits"] == 1 and s2["served"] == 1
+    assert again[0].cache_hit
+    np.testing.assert_array_equal(again[0].result().codes,
+                                  first[0].result().codes)
+
+
+def test_variations_fan_out_matches_independent_seeds(rng):
+    """``variations=k`` returns [k, image_seq_len] codes where row i is
+    BITWISE the codes of an independent request at seed+i — the fan-out
+    changes scheduling (prefill once, share the pool), never sampling."""
+    model, params, _ = build(rng)
+    text = np.asarray(jax.random.randint(rng, (T,), 1, 30))
+    k, seed, temp = 3, 11, 0.7
+
+    solo = [Request(text_tokens=text, seed=seed + i, temperature=temp,
+                    request_id=f"solo{i}") for i in range(k)]
+    serve_burst(model, params, solo)
+    expected = np.stack([r.result().codes for r in solo])
+
+    var = Request(text_tokens=text, seed=seed, temperature=temp,
+                  request_id="var", variations=k)
+    _, stats = serve_burst(model, params, [var],
+                           prefix_pool=PrefixPool(1 << 20))
+    got = var.result().codes
+    assert got.shape == (k, model.cfg.image_seq_len)
+    np.testing.assert_array_equal(got, expected)
+    # the fan-out paid ONE prefill; siblings rode the prefix pool
+    assert stats["prefill_requests"] == 1
+    assert stats["prefix_reuses"] == k - 1
+
+
+def test_zipf_trace_deterministic_and_redundant():
+    tr1 = make_zipf_trace(64, 10.0, T, 30, alpha=1.1, num_prompts=8,
+                          seeds_per_prompt=2, seed=5)
+    tr2 = make_zipf_trace(64, 10.0, T, 30, alpha=1.1, num_prompts=8,
+                          seeds_per_prompt=2, seed=5)
+    assert len(tr1) == 64
+    for a, b in zip(tr1, tr2):
+        assert a.seed == b.seed and a.arrival_s == b.arrival_s
+        assert list(a.text_tokens) == list(b.text_tokens)
+    # the point of Zipf traffic: exact (text, seed) repeats exist
+    pairs = [(tuple(t.text_tokens), t.seed) for t in tr1]
+    assert len(set(pairs)) < len(pairs)
+    # arrivals are sorted offsets starting at 0
+    assert all(b.arrival_s >= a.arrival_s for a, b in zip(tr1, tr1[1:]))

@@ -46,6 +46,30 @@ def parse_args(argv=None):
                     help="with --synth: mean arrival rate")
     ap.add_argument("--trace_seed", type=int, default=0,
                     help="with --synth: RNG seed for arrivals + prompts")
+    # Zipf redundancy knobs (docs/SERVING.md §7): repeated prompts + a
+    # small per-prompt seed set produce both exact duplicates (result-
+    # cache hits) and same-text-new-seed arrivals (prefix reuses)
+    ap.add_argument("--zipf", type=float, default=None,
+                    help="with --synth: draw prompts from a Zipf(alpha) "
+                         "popularity law over --zipf_prompts distinct "
+                         "texts instead of all-unique prompts")
+    ap.add_argument("--zipf_prompts", type=int, default=32,
+                    help="with --zipf: number of distinct prompts")
+    ap.add_argument("--zipf_seeds", type=int, default=4,
+                    help="with --zipf: seeds drawn per prompt (exact "
+                         "duplicates appear once a (prompt, seed) pair "
+                         "repeats)")
+    ap.add_argument("--cache_bytes", type=int, default=0,
+                    help="result-cache budget in bytes (0 = no result "
+                         "cache)")
+    ap.add_argument("--prefix_pool_bytes", type=int, default=0,
+                    help="shared-prefix KV pool budget in bytes (0 = no "
+                         "pool)")
+    ap.add_argument("--compare_cache", action="store_true",
+                    help="replay each combination twice — uncached, then "
+                         "with the caches above (or 16 MiB defaults) — "
+                         "and report the admission-cost reduction + "
+                         "bitwise equality of the served codes")
     ap.add_argument("--save_trace", type=str, default=None,
                     help="write the (synthesized or loaded) trace here for "
                          "later replays")
@@ -97,8 +121,11 @@ def main(argv=None):
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
+    import numpy as np
+
     from dalle_tpu.serving import (
-        POLICIES, load_trace, make_poisson_trace, replay_trace, save_trace,
+        POLICIES, load_trace, make_poisson_trace, make_zipf_trace,
+        replay_trace, save_trace,
     )
 
     assert args.quick or args.dalle_path, (
@@ -117,10 +144,18 @@ def main(argv=None):
     cfg = model.cfg
 
     if args.synth is not None:
-        trace = make_poisson_trace(
-            args.synth, args.rate_hz, cfg.text_seq_len,
-            cfg.num_text_tokens, seed=args.trace_seed,
-        )
+        if args.zipf is not None:
+            trace = make_zipf_trace(
+                args.synth, args.rate_hz, cfg.text_seq_len,
+                cfg.num_text_tokens, alpha=args.zipf,
+                num_prompts=args.zipf_prompts,
+                seeds_per_prompt=args.zipf_seeds, seed=args.trace_seed,
+            )
+        else:
+            trace = make_poisson_trace(
+                args.synth, args.rate_hz, cfg.text_seq_len,
+                cfg.num_text_tokens, seed=args.trace_seed,
+            )
     else:
         assert args.trace, "pass --trace FILE or --synth N"
         trace = load_trace(args.trace)
@@ -140,16 +175,69 @@ def main(argv=None):
         assert p in POLICIES, f"unknown policy {p!r} (not in {POLICIES})"
     slot_counts = [int(s) for s in args.slots.split(",")]
 
+    cache_kw = {}
+    if args.cache_bytes > 0:
+        cache_kw["result_cache_bytes"] = args.cache_bytes
+    if args.prefix_pool_bytes > 0:
+        cache_kw["prefix_pool_bytes"] = args.prefix_pool_bytes
+
+    def run(policy, slots, cached):
+        codes = {}
+        kw = dict(cache_kw) if cached else {}
+        if cached and not kw:  # --compare_cache with no explicit budgets
+            kw = {"result_cache_bytes": 16 << 20,
+                  "prefix_pool_bytes": 16 << 20}
+        stats = replay_trace(
+            model, params, trace, policy=policy, num_slots=slots,
+            filter_thres=args.filter_thres, time_scale=args.time_scale,
+            on_result=lambda r: (
+                codes.__setitem__(r.request_id, np.array(r.codes))
+                if r.codes is not None and r.parent is None else None
+            ),
+            **kw,
+        )
+        return stats, codes
+
     for policy in policies:
         for slots in slot_counts:
             if policy == "sequential" and slots != slot_counts[0]:
                 continue  # batch-of-1 ignores the slot count
-            stats = replay_trace(
-                model, params, trace, policy=policy, num_slots=slots,
-                filter_thres=args.filter_thres,
-                time_scale=args.time_scale,
+            if not args.compare_cache:
+                stats, _ = run(policy, slots, cached=bool(cache_kw))
+                print(json.dumps(stats))
+                continue
+            # cached vs uncached over the SAME trace: the cached pass
+            # must produce bitwise-identical codes while paying device
+            # prefill for only the distinct texts
+            stats_cold, cold = run(policy, slots, cached=False)
+            stats_warm, warm = run(policy, slots, cached=True)
+            ids = sorted(set(cold) & set(warm))
+            bitwise = bool(ids) and all(
+                np.array_equal(cold[i], warm[i]) for i in ids
             )
-            print(json.dumps(stats))
+            denom = max(1, stats_cold["prefill_requests"])
+            reduction = 1.0 - stats_warm["prefill_requests"] / denom
+            print(json.dumps({
+                "policy": policy,
+                "num_slots": slots,
+                "requests": len(trace),
+                "compared": len(ids),
+                "bitwise_equal": bitwise,
+                "prefill_uncached": stats_cold["prefill_requests"],
+                "prefill_cached": stats_warm["prefill_requests"],
+                "admission_cost_reduction": round(reduction, 4),
+                "cache_hits": stats_warm["cache_hits"],
+                "cache_misses": stats_warm["cache_misses"],
+                "prefix_reuses": stats_warm["prefix_reuses"],
+                "hit_rate": round(
+                    stats_warm["cache_hits"]
+                    / max(1, stats_warm["cache_hits"]
+                          + stats_warm["cache_misses"]), 4,
+                ),
+                "cache_bytes": stats_warm["cache_bytes"],
+                "tokens_per_s_uncached": stats_cold["tokens_per_s"],
+                "tokens_per_s_cached": stats_warm["tokens_per_s"],
+            }))
 
 
 if __name__ == "__main__":

@@ -2,7 +2,7 @@
 """Serving chaos harness: prove the serving stack is overload-safe and
 crash-tolerant (docs/SERVING.md "Overload & failure semantics").
 
-Four scenarios against the continuous-batching engine (tiny
+Five scenarios against the continuous-batching engine (tiny
 randomly-initialized model — the properties under test are host-side
 protocol guarantees, not model quality):
 
@@ -14,11 +14,16 @@ protocol guarantees, not model quality):
    scheduler re-raises, and every request still completes with a
    structured error (the orphaned-``result()`` hang is fixed
    independently of recovery).
-3. **flood** — a 10x overload burst (the ``flood@T:R`` fault grammar)
+3. **cache_crash** — the same mid-flight crash against a WARM serving
+   cache (result cache + shared-prefix KV pool, docs/SERVING.md §7):
+   cache-served and replayed codes are all bitwise equal to a cold
+   uncached run, the caches stay coherent across the engine
+   ``reset()``, and no ``result()`` hangs.
+4. **flood** — a 10x overload burst (the ``flood@T:R`` fault grammar)
    against a bounded queue: pending never exceeds ``max_pending``, the
    excess is shed with structured errors, and the p99 TTLT of *admitted*
    requests stays within ``p99_gate`` (2x) of the unflooded baseline.
-4. **telemetry** — an over-bound burst under a live ``--telemetry``
+5. **telemetry** — an over-bound burst under a live ``--telemetry``
    session: the exported ``trace.json`` is Perfetto-loadable and the
    ``metrics.jsonl`` request counters reconcile exactly with
    ``Scheduler.stats()`` (docs/OBSERVABILITY.md).
@@ -69,6 +74,7 @@ def _serve(model, params, reqs, **sched_kw):
     engine = DecodeEngine(
         model, params, num_slots=sched_kw.pop("num_slots", 3),
         filter_thres=GREEDY["filter_thres"],
+        prefix_pool=sched_kw.pop("prefix_pool", None),
     )
     engine.warmup()
     q = RequestQueue(
@@ -119,6 +125,91 @@ def scenario_crash_replay(model, params, *, slots=3, n_req=6) -> dict:
         "hangs": hangs,
         "errors": errors,
         "replay_mismatches": mismatches,
+        "engine_restarts": stats["engine_restarts"],
+        "replays": stats["replays"],
+        "served": stats["served"],
+    }
+
+
+def scenario_cache_crash(model, params, *, slots=3) -> dict:
+    """Engine crash mid-burst with a WARM serving cache: the cache-served
+    requests complete with zero device work, the decoding requests are
+    deterministically replayed (re-admitting off the prefix pool), and
+    EVERY code — cache-served and replayed alike — is bitwise equal to a
+    cold uncached run.  Zero ``result()`` hangs; the cache stays coherent
+    across the engine ``reset()``."""
+    import numpy as np
+
+    from dalle_tpu.serving import PrefixPool, Request, ResultCache
+    from dalle_tpu.training import faults
+
+    cfg = model.cfg
+    rng = np.random.RandomState(11)
+    texts = rng.randint(
+        1, cfg.num_text_tokens, size=(3, cfg.text_seq_len)
+    ).astype(np.int32)
+    # (text, seed) pairs: the first 3 warm the cache; the crash burst
+    # repeats them exactly (result-cache hits) and adds a new seed per
+    # text (prefix-pool reuses that DO decode — and get crashed)
+    warm_spec = [(0, 0), (1, 1), (2, 2)]
+    crash_spec = warm_spec + [(0, 10), (1, 11), (2, 12)]
+
+    def mk(spec, tag):
+        return [
+            Request(
+                text_tokens=texts[ti], seed=s,
+                temperature=GREEDY["temperature"],
+                request_id=f"{tag}_{ti}_{s}",
+            )
+            for ti, s in spec
+        ]
+
+    # cold, uncached baseline over every distinct (text, seed)
+    faults.reset()
+    baseline = mk(crash_spec, "cold")
+    _serve(model, params, baseline, num_slots=slots)
+    expect = {(ti, s): r.codes for (ti, s), r in zip(crash_spec, baseline)}
+    assert all(r.codes is not None for r in baseline)
+
+    # warm the shared caches, then crash mid-burst against them
+    rc, pool = ResultCache(16 << 20), PrefixPool(16 << 20)
+    warm = mk(warm_spec, "warm")
+    _serve(model, params, warm, num_slots=slots, result_cache=rc,
+           prefix_pool=pool)
+    fail_tick = cfg.image_seq_len // 2
+    faults.configure(f"tick_fail@{fail_tick}")
+    try:
+        burst = mk(crash_spec, "burst")
+        stats = _serve(model, params, burst, num_slots=slots,
+                       result_cache=rc, prefix_pool=pool,
+                       max_engine_restarts=2, max_request_retries=1)
+    finally:
+        faults.reset()
+
+    hangs = [r.request_id for r in burst if not r._done.is_set()]
+    errors = {r.request_id: r.error for r in burst if r.error is not None}
+    mismatches = [
+        r.request_id
+        for (ti, s), r in zip(crash_spec, burst)
+        if r.codes is None or not np.array_equal(r.codes, expect[(ti, s)])
+    ]
+    cached_served = [r.request_id for r in burst if r.cache_hit]
+    ok = (
+        not hangs and not errors and not mismatches
+        and stats["engine_restarts"] == 1
+        and stats["cache_hits"] == len(warm_spec)
+        and stats["prefix_reuses"] > 0
+    )
+    return {
+        "ok": ok,
+        "fail_tick": fail_tick,
+        "hangs": hangs,
+        "errors": errors,
+        "mismatches": mismatches,
+        "cache_served": cached_served,
+        "cache_hits": stats["cache_hits"],
+        "cache_misses": stats["cache_misses"],
+        "prefix_reuses": stats["prefix_reuses"],
         "engine_restarts": stats["engine_restarts"],
         "replays": stats["replays"],
         "served": stats["served"],
@@ -344,18 +435,20 @@ def scenario_telemetry(model, params, *, slots=3, n_req=10, max_pending=2,
 
 def run_serving_chaos(*, slots=3, n_req=6, p99_gate=2.0,
                       telemetry_dir=None) -> dict:
-    """All four scenarios; ``ok`` iff every gate holds."""
+    """All five scenarios; ``ok`` iff every gate holds."""
     model, params = _quick_model()
     crash = scenario_crash_replay(model, params, slots=slots, n_req=n_req)
     fail_fast = scenario_fail_fast(model, params, slots=slots)
+    cache_crash = scenario_cache_crash(model, params, slots=slots)
     flood = scenario_flood(model, params, p99_gate=p99_gate)
     tel = scenario_telemetry(model, params, slots=slots,
                              run_dir=telemetry_dir)
     return {
-        "ok": (crash["ok"] and fail_fast["ok"] and flood["ok"]
-               and tel["ok"]),
+        "ok": (crash["ok"] and fail_fast["ok"] and cache_crash["ok"]
+               and flood["ok"] and tel["ok"]),
         "crash_replay": crash,
         "fail_fast": fail_fast,
+        "cache_crash": cache_crash,
         "flood": flood,
         "telemetry": tel,
     }
