@@ -115,7 +115,8 @@ PHASES = [
     # zero hung result() waiters, a zero-restart-budget crash must
     # fail-fast every request with a structured error, and a 10x flood
     # against a bounded queue must shed (never grow) with admitted p99
-    # TTLT within 2x of the unflooded baseline.  Host-side
+    # TTLT within 2x of the unflooded baseline; a killed fleet replica
+    # must drain its in-flight work bitwise onto the survivor.  Host-side
     ("serving_resilience", 900, False),
     # observability evidence (docs/OBSERVABILITY.md): the telemetry
     # fast-path gate — one saturated serving burst replayed with the
@@ -129,6 +130,12 @@ PHASES = [
     # jitted admit paths compile exactly once across all occupancy x
     # hit/miss combinations.  Host-side
     ("serving_cache", 600, False),
+    # fleet scale-out evidence (docs/SERVING.md §8): one burst trace
+    # through a plain single scheduler vs a 1-replica Fleet (router
+    # overhead <= 5%) vs a 2-replica Fleet on distinct host devices
+    # (hardware-aware scaling gate + bitwise 1-vs-2-replica parity),
+    # plus the replica-kill drain scenario.  Host-side
+    ("serving_fleet", 900, False),
 ]
 
 # phases that are their own hardened scripts (run via custom argv instead of
@@ -1462,7 +1469,9 @@ def _serving_resilience_bench():
     engine-tick failure), fail_fast (restart budget 0 still completes
     every request with an error), and flood (10x burst vs a bounded
     queue: pending bounded, shed > 0, admitted p99 TTLT <= 2x the
-    unflooded baseline).  A failed gate sets ``rung_failed``."""
+    unflooded baseline), plus telemetry reconciliation and the fleet
+    replica-kill drain (docs/SERVING.md §8).  A failed gate sets
+    ``rung_failed``."""
     from tools.serving_chaos import run_serving_chaos
 
     t0 = time.time()
@@ -1481,7 +1490,7 @@ def _serving_resilience_bench():
     res["wall_s"] = round(time.time() - t0, 1)
     if not verdict["ok"]:
         bad = [k for k in ("crash_replay", "fail_fast", "cache_crash",
-                           "flood")
+                           "flood", "telemetry", "replica_kill")
                if not verdict[k]["ok"]]
         res["rung_failed"] = f"serving chaos gates failed: {bad}"
     return res
@@ -1715,6 +1724,147 @@ def _serving_cache_bench():
     return res
 
 
+def _serving_fleet_bench():
+    """Fleet scale-out rung (docs/SERVING.md §8, the ISSUE 9 pin).
+
+    One burst trace through three serving configurations — a plain
+    single :class:`Scheduler`, a 1-replica :class:`Fleet` (isolates the
+    router), and a 2-replica :class:`Fleet` on distinct host devices —
+    best-of-N interleaved, plus the replica-kill chaos scenario.  Gates:
+
+      * router overhead <= 5%: Fleet(1) tokens/s >= 0.95x the plain
+        scheduler on the same trace;
+      * scale-out, hardware-aware (the decode_speed precedent: perf
+        gates only where the hardware can express them): on >= 2 TPU
+        devices aggregate Fleet(2) >= 1.7x Fleet(1); on a multi-core
+        CPU host >= 1.3x; a single-core host cannot execute two replica
+        threads' device work in parallel (they time-slice one core, and
+        pay dispatch contention doing it — ~0.7x measured), so the gate
+        there is no-collapse (>= 0.6x, catching livelock or accidental
+        serialization, not perf) — with both replicas required to have
+        actually served requests as the concurrency evidence;
+      * parity: every request's codes bitwise identical 1 vs 2 replicas;
+      * replica_kill (tools/serving_chaos.py): a kill with work in
+        flight drains bitwise onto the survivor, fleet-shared caches
+        stay warm across the kill, zero ``result()`` hangs.
+    """
+    import jax
+    import numpy as np
+
+    from dalle_tpu.serving import (
+        fleet_replay_trace, make_poisson_trace, replay_trace,
+    )
+    from tools.serving_bench import _quick_model
+    from tools.serving_chaos import scenario_replica_kill
+
+    t0 = time.time()
+    model, params = _quick_model()
+    cfg = model.cfg
+    n_req, slots, repeats = 24, 4, 3
+    trace = make_poisson_trace(
+        n_req, 1e5, cfg.text_seq_len, cfg.num_text_tokens, seed=0
+    )
+
+    def collect(codes):
+        return lambda r: (
+            codes.__setitem__(r.request_id, np.array(r.codes))
+            if r.codes is not None else None
+        )
+
+    def run_plain():
+        codes = {}
+        st = replay_trace(model, params, trace, policy="continuous",
+                          num_slots=slots, on_result=collect(codes))
+        return st, codes
+
+    def run_fleet(replicas):
+        codes = {}
+        st = fleet_replay_trace(model, params, trace, replicas=replicas,
+                                num_slots=slots, on_result=collect(codes))
+        return st, codes
+
+    best = {"plain": 0.0, "fleet1": 0.0, "fleet2": 0.0}
+    codes1 = codes2 = {}
+    per_replica_served = []
+    for _ in range(repeats):
+        st, _ = run_plain()
+        best["plain"] = max(best["plain"], st["tokens_per_s"])
+        st, codes1 = run_fleet(1)
+        best["fleet1"] = max(best["fleet1"], st["tokens_per_s"])
+        st, codes2 = run_fleet(2)
+        best["fleet2"] = max(best["fleet2"], st["tokens_per_s"])
+        per_replica_served = [p["served"] for p in st["per_replica"]]
+
+    parity = (
+        len(codes1) == len(codes2) == n_req
+        and all(np.array_equal(codes1[k], codes2[k]) for k in codes1)
+    )
+    overhead_ratio = best["fleet1"] / max(best["plain"], 1e-9)
+    scaling = best["fleet2"] / max(best["fleet1"], 1e-9)
+
+    ncores = os.cpu_count() or 1
+    backend = jax.default_backend()
+    if backend == "tpu" and len(jax.devices()) >= 2:
+        gate_kind, scaling_gate = "tpu", 1.7
+    elif ncores >= 2:
+        gate_kind, scaling_gate = "cpu_multicore", 1.3
+    else:
+        gate_kind, scaling_gate = "single_core_no_collapse", 0.6
+
+    kill = scenario_replica_kill(model, params, slots=3)
+
+    _hb(
+        f"serving_fleet: plain={best['plain']:.1f} "
+        f"fleet1={best['fleet1']:.1f} fleet2={best['fleet2']:.1f} tok/s "
+        f"overhead={overhead_ratio:.3f}x scaling={scaling:.3f}x "
+        f"(gate {scaling_gate}x {gate_kind}) parity={parity} "
+        f"kill_ok={kill['ok']}"
+    )
+
+    fails = []
+    if not parity:
+        fails.append("codes differ between 1 and 2 replicas")
+    if overhead_ratio < 0.95:
+        fails.append(
+            f"router overhead: Fleet(1) {overhead_ratio:.3f}x plain "
+            f"(gate >= 0.95x)"
+        )
+    if scaling < scaling_gate:
+        fails.append(
+            f"scaling {scaling:.3f}x < {scaling_gate}x ({gate_kind})"
+        )
+    if not per_replica_served or min(per_replica_served) <= 0:
+        fails.append(
+            f"replica starved: per-replica served {per_replica_served}"
+        )
+    if not kill["ok"]:
+        fails.append("replica_kill chaos gates failed")
+
+    res = {
+        "n_requests": n_req,
+        "num_slots": slots,
+        "repeats": repeats,
+        "image_seq_len": cfg.image_seq_len,
+        "cpu_cores": ncores,
+        "backend": backend,
+        "devices": len(jax.devices()),
+        "tokens_per_s_plain": round(best["plain"], 2),
+        "tokens_per_s_fleet1": round(best["fleet1"], 2),
+        "tokens_per_s_fleet2": round(best["fleet2"], 2),
+        "router_overhead_ratio": round(overhead_ratio, 4),
+        "scaling_ratio": round(scaling, 4),
+        "scaling_gate": scaling_gate,
+        "scaling_gate_kind": gate_kind,
+        "parity_1v2": parity,
+        "per_replica_served": per_replica_served,
+        "replica_kill": kill,
+    }
+    res["wall_s"] = round(time.time() - t0, 1)
+    if fails:
+        res["rung_failed"] = "; ".join(fails)
+    return res
+
+
 PHASE_FNS = {
     "train_tiny": lambda: _train_bench(tiny=True),
     "train": _train_bench,
@@ -1734,10 +1884,23 @@ PHASE_FNS = {
     "serving_resilience": _serving_resilience_bench,
     "telemetry_overhead": _telemetry_overhead_bench,
     "serving_cache": _serving_cache_bench,
+    "serving_fleet": _serving_fleet_bench,
 }
+
+# phases exercising the replica fleet need >= 2 host devices on CPU;
+# the flag must land before the backend initializes and is a no-op on a
+# real accelerator (it only shapes the host platform)
+_FLEET_PHASES = {"serving_resilience", "serving_fleet"}
 
 
 def run_phase_child(name):
+    if (name in _FLEET_PHASES
+            and "host_platform_device_count" not in
+            os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        )
     if os.environ.get("BENCH_PLATFORM"):
         import jax
 

@@ -19,6 +19,14 @@ from dalle_tpu.serving.cache import (
     text_key,
 )
 from dalle_tpu.serving.engine import DecodeEngine, EngineState
+from dalle_tpu.serving.fleet import (
+    Fleet,
+    ReplicaKilled,
+    ReplicaSupervisor,
+    ReplicaWorker,
+    Router,
+    fleet_replay_trace,
+)
 from dalle_tpu.serving.queue import (
     Request,
     RequestError,
@@ -41,6 +49,12 @@ from dalle_tpu.serving.scheduler import (
 __all__ = [
     "DecodeEngine",
     "EngineState",
+    "Fleet",
+    "ReplicaKilled",
+    "ReplicaSupervisor",
+    "ReplicaWorker",
+    "Router",
+    "fleet_replay_trace",
     "Request",
     "RequestError",
     "RequestQueue",

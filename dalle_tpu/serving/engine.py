@@ -80,8 +80,18 @@ class DecodeEngine:
         filter_thres: float = 0.9,
         use_top_p: bool = False,
         prefix_pool=None,
+        replica_id: int = 0,
+        device=None,
     ):
         self.model = model
+        self.replica_id = int(replica_id)
+        # Fleet replicas pin params (and hence every jitted dispatch,
+        # whose other operands are uncommitted and follow) to their own
+        # device — on CPU these are the virtual host devices from
+        # XLA_FLAGS=--xla_force_host_platform_device_count=N.
+        self.device = device
+        if device is not None:
+            params = jax.device_put(params, device)
         self.params = params
         self.num_slots = int(num_slots)
         c = model.cfg
@@ -144,7 +154,7 @@ class DecodeEngine:
         cache = self.model.apply(
             {"params": self.params}, B, method=DALLE.init_cache
         )
-        return EngineState(
+        state = EngineState(
             cache=cache,
             pos=jnp.full((B,), t, jnp.int32),
             prev=jnp.zeros((B,), jnp.int32),
@@ -155,6 +165,9 @@ class DecodeEngine:
             active=jnp.zeros((B,), bool),
             out=jnp.zeros((B, S), jnp.int32),
         )
+        if self.device is not None:
+            state = jax.device_put(state, self.device)
+        return state
 
     def _tick_impl(self, params, state: EngineState) -> EngineState:
         """Advance every active slot by one token (inactive lanes run the

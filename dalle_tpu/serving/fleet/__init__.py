@@ -1,0 +1,26 @@
+"""Replica fleet serving: N-engine scale-out (docs/SERVING.md §8).
+
+One shared :class:`~dalle_tpu.serving.queue.RequestQueue`, N
+:class:`~dalle_tpu.serving.engine.DecodeEngine` replicas each pinned to
+its own device, a load-balancing EDF :class:`Router`, and a
+:class:`ReplicaSupervisor` that drains a dead replica's in-flight work
+onto survivors via the deterministic (text, seed, sampling) replay.
+"""
+
+from dalle_tpu.serving.fleet.fleet import (
+    Fleet,
+    ReplicaSupervisor,
+    fleet_replay_trace,
+)
+from dalle_tpu.serving.fleet.router import ReplicaView, Router
+from dalle_tpu.serving.fleet.worker import ReplicaKilled, ReplicaWorker
+
+__all__ = [
+    "Fleet",
+    "ReplicaSupervisor",
+    "ReplicaView",
+    "ReplicaKilled",
+    "ReplicaWorker",
+    "Router",
+    "fleet_replay_trace",
+]

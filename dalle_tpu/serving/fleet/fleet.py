@@ -1,0 +1,387 @@
+"""The Fleet: N decode-engine replicas behind one router, supervised.
+
+Scale-out half of ROADMAP item 1 (docs/SERVING.md §8).  A
+:class:`Fleet` owns N :class:`DecodeEngine` replicas — each with its own
+slot state, its own jitted tick/admit fns, and its own device (pinned
+via ``jax.device_put``; on CPU, the virtual host devices from
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``) — driven by N
+:class:`ReplicaWorker` threads pulling from ONE shared
+:class:`RequestQueue` through the :class:`Router`.
+
+Crash-drain is deterministic: a replica dying (engine fault past its
+budget, or an injected kill) hands its in-flight + stashed requests to
+the :class:`ReplicaSupervisor`, which resets their decode state and
+requeues them at the shared queue's FRONT — survivors replay them from
+the (text, seed, sampling) tuple, producing codes bitwise equal to an
+uninterrupted run.  No survivors ⇒ the requests fail with a structured
+error (``result()`` never hangs).
+
+Caches are fleet-shared: one ResultCache, one PrefixPool, one model
+fingerprint.  A text prefix exported by replica 0's prefill admits
+replica 1's same-text request with zero prefill; an exact (text, seed,
+sampling) repeat completes from the result cache no matter which replica
+stored it.  Coherence is by construction — entries are host-side,
+content-addressed, and idempotent (two replicas racing the same key
+store identical bytes) — so a replica kill never invalidates anything.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from dalle_tpu import telemetry
+from dalle_tpu.serving.cache import PrefixPool, ResultCache, model_fingerprint
+from dalle_tpu.serving.engine import DecodeEngine
+from dalle_tpu.serving.fleet.router import ReplicaView, Router
+from dalle_tpu.serving.fleet.worker import ReplicaWorker
+from dalle_tpu.serving.queue import Request, RequestQueue
+from dalle_tpu.serving.scheduler import TraceItem, request_stats
+from dalle_tpu.telemetry import MetricsRegistry
+from dalle_tpu.training.logging import log_event
+
+
+class ReplicaSupervisor:
+    """Replica lifecycle: retirement, crash accounting, and drain.
+
+    Shares the router's lock, so "is this replica alive" and "who gets
+    its work" change atomically with respect to every router poll and
+    every other replica's exit.
+    """
+
+    def __init__(self, router: Router, queue: RequestQueue, lock,
+                 metrics: MetricsRegistry):
+        self._router = router
+        self.queue = queue
+        self._lock = lock
+        self.metrics = metrics
+        self._workers: dict = {}  # rid -> ReplicaWorker
+        self.crashes = 0  # replica deaths (fault past budget or kill)
+        self.drained = 0  # requests drained onto survivors
+        self.failed = 0  # requests failed for want of a survivor
+
+    def register(self, worker: ReplicaWorker) -> None:
+        self._workers[worker.replica_id] = worker
+
+    def confirm_exit(self, rid: int) -> bool:
+        """A worker's queue view looks drained — may it retire?
+
+        Atomic under the router lock: re-checks that the shared queue is
+        closed and empty, nothing is stashed for ``rid``, and no OTHER
+        alive replica still has work in flight (if one does, this
+        replica stays alive as a drain target for a potential crash).
+        On True the replica leaves the alive set — after this instant the
+        router never stashes for it and a peer's drain never targets it.
+        """
+        with self._lock:
+            if not self.queue.closed or self.queue.pending():
+                return False
+            if self._router._stash.get(rid):
+                return False
+            for other in list(self._router._alive):
+                if other == rid:
+                    continue
+                w = self._workers[other]
+                if (
+                    w.engine.num_active
+                    or self._router._stash.get(other)
+                    or w._ready
+                    or w._inflight
+                ):
+                    return False
+            self._router.retire(rid)
+            return True
+
+    def on_replica_exit(self, worker: ReplicaWorker) -> None:
+        """Every worker exit path lands here (the fleet override of
+        ``Scheduler._fail_unfinished``).  Clean exits have nothing left;
+        a dead replica's unfinished requests drain onto survivors — or
+        fail, structured, when none remain."""
+        rid = worker.replica_id
+        with self._lock:
+            stashed = self._router.retire(rid)
+            unfinished = worker._collect_unfinished()
+            in_flight_ids = [r.request_id for r in unfinished]
+            unfinished += [r for r in stashed if not r._done.is_set()]
+            fatal = worker._fatal is not None
+            if fatal:
+                self.crashes += 1
+                self.metrics.counter("fleet_replica_crashes").inc()
+                log_event(
+                    "replica_crash", replica=rid, error=worker._fatal,
+                    in_flight=in_flight_ids,
+                )
+            if not unfinished:
+                return
+            survivors = sorted(self._router._alive)
+            if survivors:
+                for r in unfinished:
+                    # deterministic replay: decode restarts from the
+                    # (text, seed, sampling) tuple on whichever survivor
+                    # admits it — codes bitwise equal by construction
+                    r.codes = None
+                    r.finish_time = None
+                    r.admit_time = None
+                    r.slot = None
+                self.queue.requeue(unfinished)
+                self.drained += len(unfinished)
+                self.metrics.counter("fleet_drained_requests").inc(
+                    len(unfinished)
+                )
+                log_event(
+                    "replica_drain", replica=rid, survivors=survivors,
+                    n=len(unfinished),
+                    requests=[r.request_id for r in unfinished],
+                )
+            else:
+                reason = (
+                    f"replica {rid} exited before this request completed"
+                    + (f" ({worker._fatal})" if worker._fatal else "")
+                )
+                for r in unfinished:
+                    r._fail(reason)
+                    worker._c_failed.inc()
+                    worker.completed.append(r)
+                self.failed += len(unfinished)
+
+
+class Fleet:
+    """N engine replicas + router + supervisor behind one submit()."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        replicas: int = 2,
+        num_slots: int = 8,
+        devices=None,
+        filter_thres: float = 0.9,
+        use_top_p: bool = False,
+        policy: str = "continuous",
+        max_pending: Optional[int] = None,
+        shed_policy: str = "reject",
+        result_cache: Optional[ResultCache] = None,
+        prefix_pool: Optional[PrefixPool] = None,
+        fingerprint: Optional[str] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        queue: Optional[RequestQueue] = None,
+        **scheduler_kwargs,
+    ):
+        assert replicas >= 1, f"need at least one replica, got {replicas}"
+        assert policy == "continuous", (
+            "fleet serving requires the continuous admission policy "
+            f"(got {policy!r}): sequential/full_batch are single-engine "
+            "batching experiments, not fleet modes"
+        )
+        import jax
+
+        self.model = model
+        self.S = model.cfg.image_seq_len
+        if metrics is None:
+            metrics = (telemetry.registry() if telemetry.enabled()
+                       else MetricsRegistry())
+        self.metrics = metrics
+        if devices is None:
+            devices = jax.devices()
+        self.devices = [devices[i % len(devices)] for i in range(replicas)]
+        self.queue = (
+            queue if queue is not None
+            else RequestQueue(max_pending=max_pending,
+                              shed_policy=shed_policy, metrics=metrics)
+        )
+        lock = threading.RLock()
+        self.router = Router(self.queue, lock=lock,
+                             ticks_per_request=self.S)
+        self.supervisor = ReplicaSupervisor(
+            self.router, self.queue, lock, metrics
+        )
+        if result_cache is not None and fingerprint is None:
+            fingerprint = model_fingerprint(model.cfg)
+        self.workers: List[ReplicaWorker] = []
+        for rid in range(replicas):
+            engine = DecodeEngine(
+                model, params, num_slots=num_slots,
+                filter_thres=filter_thres, use_top_p=use_top_p,
+                prefix_pool=prefix_pool, replica_id=rid,
+                device=self.devices[rid],
+            )
+            view = ReplicaView(self.router, rid)
+            worker = ReplicaWorker(
+                engine, view, supervisor=self.supervisor, replica_id=rid,
+                policy=policy, metrics=metrics, result_cache=result_cache,
+                fingerprint=fingerprint, **scheduler_kwargs,
+            )
+            view.worker = worker
+            self.router.register(rid, num_slots)
+            self.supervisor.register(worker)
+            self.workers.append(worker)
+        self._errors: dict = {}
+
+    # --- lifecycle -------------------------------------------------------
+    def warmup(self) -> None:
+        for w in self.workers:
+            w.engine.warmup()
+
+    def submit(self, req: Request) -> Request:
+        return self.queue.submit(req)
+
+    def close(self) -> None:
+        self.queue.close()
+
+    def kill(self, rid: int) -> None:
+        """Abruptly kill replica ``rid`` (chaos): its in-flight work
+        drains onto survivors via deterministic replay."""
+        self.workers[rid].kill()
+
+    def run(self) -> dict:
+        """Serve until the shared queue closes and the fleet drains (or
+        every replica dies).  Same no-hang guarantee as the single
+        scheduler, fleet-wide: every submitted request's ``result()``
+        returns — served, drained-and-served, or structurally failed."""
+
+        def main(worker: ReplicaWorker) -> None:
+            try:
+                worker.run()
+            except BaseException as e:  # noqa: BLE001 — recorded, not lost
+                self._errors[worker.replica_id] = (
+                    f"{type(e).__name__}: {e}"
+                )
+
+        threads = [
+            threading.Thread(target=main, args=(w,), daemon=True,
+                             name=f"replica{w.replica_id}")
+            for w in self.workers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every replica has exited — nothing can serve what's left, and
+        # nothing more may be accepted (submit now raises)
+        self.queue.close()
+        leftovers = [
+            r for r in self.queue.drain() if not r._done.is_set()
+        ]
+        for r in leftovers:
+            r._fail("fleet exited before this request completed")
+            self.workers[0]._c_failed.inc()
+            self.workers[0].completed.append(r)
+        stats = self.stats()
+        log_event(
+            "fleet_summary", replicas=len(self.workers),
+            served=stats["served"], dropped=stats["dropped"],
+            crashes=self.supervisor.crashes,
+            drained=self.supervisor.drained,
+            tokens_per_s=round(stats["tokens_per_s"], 3),
+            errors=self._errors or None,
+        )
+        return stats
+
+    # --- stats -----------------------------------------------------------
+    def stats(self) -> dict:
+        """Fleet-level stats: :func:`request_stats` over the union of all
+        replicas' completed requests, the shared-registry counters (which
+        ARE fleet-wide — every worker increments the same registry), and
+        a ``per_replica`` breakdown."""
+        all_completed: List[Request] = []
+        for w in self.workers:
+            all_completed.extend(w.completed)
+        m = self.metrics
+
+        def c(name):
+            return m.counter(name).value
+
+        out = {
+            "replicas": len(self.workers),
+            "policy": "continuous",
+            "num_slots": self.workers[0].engine.num_slots,
+            "ticks": sum(w.engine.tick_count for w in self.workers),
+            **request_stats(all_completed, self.S),
+        }
+        out.update(
+            admitted=c("serve_admitted"),
+            failed=c("serve_failed"),
+            shed=len(self.queue.shed),
+            cache_hits=c("serve_cache_hits"),
+            cache_misses=c("serve_cache_misses"),
+            prefix_reuses=c("serve_prefix_reuses"),
+            prefill_requests=sum(
+                w.engine.prefill_requests for w in self.workers
+            ),
+            prefill_admits=sum(
+                w.engine.prefill_admits for w in self.workers
+            ),
+            pool_admits=sum(w.engine.pool_admits for w in self.workers),
+            engine_restarts=c("serve_engine_restarts"),
+            replays=c("serve_replays"),
+            max_pending_seen=self.queue.max_pending_seen,
+            replica_crashes=self.supervisor.crashes,
+            drained_requests=self.supervisor.drained,
+            drain_failed=self.supervisor.failed,
+            router_steered=self.router.steered,
+            router_denied=self.router.denied,
+            per_replica=[w.replica_stats() for w in self.workers],
+        )
+        return out
+
+
+def fleet_replay_trace(
+    model,
+    params,
+    trace: Sequence[TraceItem],
+    *,
+    replicas: int = 2,
+    devices=None,
+    num_slots: int = 8,
+    filter_thres: float = 0.9,
+    time_scale: float = 1.0,
+    policy: str = "continuous",
+    max_pending: Optional[int] = None,
+    shed_policy: str = "reject",
+    result_cache: Optional[ResultCache] = None,
+    result_cache_bytes: Optional[int] = None,
+    prefix_pool: Optional[PrefixPool] = None,
+    prefix_pool_bytes: Optional[int] = None,
+    fingerprint: Optional[str] = None,
+    **scheduler_kwargs,
+) -> dict:
+    """The fleet twin of :func:`dalle_tpu.serving.scheduler.replay_trace`:
+    same feeder, same trace, N replicas.  ``replay_trace(replicas=N)``
+    delegates here, so every existing bench/CLI path gains ``--replicas``
+    without a second code path."""
+    if result_cache is None and result_cache_bytes:
+        result_cache = ResultCache(result_cache_bytes)
+    if prefix_pool is None and prefix_pool_bytes:
+        prefix_pool = PrefixPool(prefix_pool_bytes)
+    fleet = Fleet(
+        model, params, replicas=replicas, devices=devices,
+        num_slots=num_slots, filter_thres=filter_thres,
+        use_top_p=any(it.top_p is not None for it in trace),
+        policy=policy, max_pending=max_pending, shed_policy=shed_policy,
+        result_cache=result_cache, prefix_pool=prefix_pool,
+        fingerprint=fingerprint, **scheduler_kwargs,
+    )
+    fleet.warmup()
+    q = fleet.queue
+
+    def feeder():
+        t0 = time.monotonic()
+        for it in trace:
+            delay = t0 + it.arrival_s * time_scale - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            q.submit(Request(
+                text_tokens=it.text_tokens, seed=it.seed,
+                temperature=it.temperature, top_p=it.top_p,
+                deadline_s=it.deadline_s, request_id=it.request_id,
+                variations=it.variations, replica_hint=it.replica_hint,
+            ))
+        q.close()
+
+    th = threading.Thread(target=feeder, daemon=True)
+    th.start()
+    stats = fleet.run()
+    th.join()
+    return stats

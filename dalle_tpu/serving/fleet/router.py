@@ -1,0 +1,255 @@
+"""Fleet-level admission: the Router and each replica's queue view.
+
+The single-scheduler loop pulls work with ``RequestQueue.pop(1)``.  In a
+fleet, every :class:`ReplicaWorker` keeps that exact loop, but its
+"queue" is a :class:`ReplicaView` — a facade over ONE shared
+:class:`RequestQueue` that routes each pop through the :class:`Router`:
+
+* **EDF within the fleet** — the shared queue's pop is still
+  earliest-deadline-first; the router only decides *which replica keeps*
+  a popped request, never reorders deadlines.
+* **Least-loaded placement** — each poll reports the replica's load
+  (remaining decode ticks, free slots, per-tick EWMA seconds).  The
+  router deals the pending backlog to alive replicas in
+  least-estimated-finish-time order, capacity-capped, and grants the
+  poller only its share; a loaded replica polling next to an idle one is
+  told "not yours" and the idle one picks the work up on its next poll
+  (≤ one idle-wait quantum later).  Work conservation: a replica is only
+  ever denied work that some other alive replica has capacity for.
+* **Hints** — ``Request.replica_hint`` is advisory: a popped request
+  hinted at a different alive replica with capacity is stashed for it
+  (and that replica's idle wait is kicked); a hint at a dead or saturated
+  replica is ignored.
+
+Shed/degrade lift to fleet pressure for free: bounded admission
+(``max_pending``/shed policies) applies to the SHARED queue — the bound
+is fleet-wide, not per-engine — and each worker's DegradeController
+reads pressure through its view, i.e. the fleet backlog.
+
+Everything here is host-side bookkeeping under one lock shared with the
+supervisor; the router never touches device state.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Set
+
+from dalle_tpu.serving.queue import Request, RequestQueue
+from dalle_tpu.training.logging import log_event
+
+
+class Router:
+    """Places shared-queue work onto the least-loaded alive replica.
+
+    All state (alive set, per-replica stashes, last-poll load snapshots)
+    mutates under ``lock`` — the same lock the :class:`ReplicaSupervisor`
+    holds while retiring replicas, so a poll can never hand work to a
+    replica that is concurrently being declared dead.
+    """
+
+    def __init__(self, queue: RequestQueue, *, lock,
+                 ticks_per_request: int):
+        self.queue = queue
+        self._lock = lock
+        self.S = int(ticks_per_request)  # decode ticks one request costs
+        self._alive: Set[int] = set()
+        self._stash: Dict[int, deque] = {}
+        # rid -> (busy_ticks, free_slots, tick_ewma_s) at its last poll
+        self._load: Dict[int, tuple] = {}
+        self.steered = 0  # hinted requests stashed for another replica
+        self.denied = 0  # poll grants withheld for a less-loaded replica
+        self._last_rebalance_log = 0.0
+
+    def register(self, rid: int, num_slots: int) -> None:
+        with self._lock:
+            self._alive.add(rid)
+            self._stash[rid] = deque()
+            self._load[rid] = (0, num_slots, None)
+
+    def retire(self, rid: int) -> List[Request]:
+        """Remove ``rid`` from the alive set (idempotent) and return
+        whatever was stashed for it — the supervisor redistributes or
+        fails those."""
+        with self._lock:
+            self._alive.discard(rid)
+            out = list(self._stash.get(rid, ()))
+            if rid in self._stash:
+                self._stash[rid].clear()
+            return out
+
+    def alive(self) -> List[int]:
+        with self._lock:
+            return sorted(self._alive)
+
+    # --- placement policy ------------------------------------------------
+    def _tick_s(self, rid: int) -> float:
+        t = self._load[rid][2]
+        if t:
+            return t
+        known = [v[2] for v in self._load.values() if v[2]]
+        return sum(known) / len(known) if known else 1e-3
+
+    def _est_finish_s(self, rid: int) -> float:
+        busy, _, _ = self._load[rid]
+        return (busy + len(self._stash[rid]) * self.S) * self._tick_s(rid)
+
+    def _grant(self, rid: int, want: int) -> int:
+        """How many NEW shared-queue pops ``rid`` may keep right now.
+
+        Deals the pending backlog to alive replicas in
+        least-estimated-finish-time order (stale peers carry their
+        last-poll snapshot; the poller's own load is fresh), capped by
+        each replica's free slots.  Deterministic tie-break on replica id
+        so two equally-idle replicas never livelock denying each other.
+        """
+        pending = self.queue.pending()
+        if pending <= 0 or want <= 0:
+            return 0
+        if len(self._alive) <= 1:
+            return want
+        cap = {}
+        for r in self._alive:
+            free = self._load[r][1]
+            cap[r] = max(0, free - len(self._stash[r]))
+        cap[rid] = max(cap[rid], want)  # the poller's capacity is live
+        share = {r: 0 for r in self._alive}
+        unit = {r: self.S * self._tick_s(r) for r in self._alive}
+        for _ in range(min(pending, sum(cap.values()))):
+            cands = [r for r in self._alive if share[r] < cap[r]]
+            if not cands:
+                break
+            pick = min(
+                cands,
+                key=lambda r: (self._est_finish_s(r) + share[r] * unit[r], r),
+            )
+            share[pick] += 1
+        granted = min(want, share[rid])
+        if granted < want:
+            self.denied += want - granted
+            self._log_rebalance(rid, want, granted)
+        return granted
+
+    def _log_rebalance(self, rid: int, want: int, granted: int) -> None:
+        now = time.monotonic()
+        if now - self._last_rebalance_log < 0.5:
+            return  # throttle: steering decisions happen every poll
+        self._last_rebalance_log = now
+        log_event(
+            "fleet_rebalance", replica=rid, want=want, granted=granted,
+            denied_total=self.denied, steered_total=self.steered,
+        )
+
+    # --- the poll itself -------------------------------------------------
+    def poll(self, rid: int, want: int, *, busy_ticks: int,
+             free_slots: int, tick_s: Optional[float]) -> List[Request]:
+        with self._lock:
+            if rid not in self._alive or want <= 0:
+                return []
+            self._load[rid] = (busy_ticks, free_slots, tick_s)
+            out: List[Request] = []
+            stash = self._stash[rid]
+            while stash and len(out) < want:
+                out.append(stash.popleft())
+            grant = self._grant(rid, want - len(out))
+            kicked = False
+            while grant > 0:
+                got = self.queue.pop(1)
+                if not got:
+                    break
+                r = got[0]
+                hint = r.replica_hint
+                if (
+                    hint is not None and hint != rid
+                    and hint in self._alive
+                    and self._load[hint][1] > len(self._stash[hint])
+                ):
+                    self._stash[hint].append(r)
+                    self.steered += 1
+                    kicked = True
+                    continue
+                out.append(r)
+                grant -= 1
+        if kicked:
+            self.queue.kick()  # end the hinted replica's idle wait now
+        return out
+
+    # --- view support ----------------------------------------------------
+    def pending_for(self, rid: int) -> int:
+        with self._lock:
+            return self.queue.pending() + len(self._stash.get(rid, ()))
+
+
+class ReplicaView:
+    """The queue surface one :class:`Scheduler` loop sees, fleet-backed.
+
+    Duck-types exactly what the scheduler uses on a
+    :class:`RequestQueue` — ``pop/pending/closed/wait/requeue/drain``
+    plus the ``shed``/``max_pending_seen``/``metrics`` bookkeeping —
+    with these fleet semantics:
+
+    * ``pop`` routes through :meth:`Router.poll`, carrying this
+      replica's fresh load snapshot;
+    * ``pending``/``closed`` reflect the SHARED queue (plus this
+      replica's hint stash), so degrade pressure and the drain check see
+      fleet state;
+    * ``requeue`` returns crash replays to the shared queue's front —
+      any survivor may pick them up (results are identical by the
+      determinism contract);
+    * ``drain`` returns nothing: a retiring replica must never empty the
+      shared queue other replicas are still serving.
+    """
+
+    def __init__(self, router: Router, rid: int):
+        self.router = router
+        self.rid = rid
+        self.worker = None  # set by the Fleet once the worker exists
+
+    def _snapshot(self):
+        w = self.worker
+        eng = w.engine
+        busy = sum(
+            eng.remaining_ticks(b) or 0 for b in range(eng.num_slots)
+        )
+        return busy, len(eng.free_slots()), w._tick_ewma
+
+    def pop(self, max_n: int) -> List[Request]:
+        busy, free, tick_s = self._snapshot()
+        return self.router.poll(
+            self.rid, max_n, busy_ticks=busy, free_slots=free,
+            tick_s=tick_s,
+        )
+
+    def pending(self) -> int:
+        return self.router.pending_for(self.rid)
+
+    @property
+    def closed(self) -> bool:
+        return self.router.queue.closed
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self.router.queue.wait(timeout)
+
+    def requeue(self, reqs: List[Request]) -> None:
+        self.router.queue.requeue(reqs)
+
+    def drain(self) -> List[Request]:
+        return []
+
+    @property
+    def shed(self) -> List[Request]:
+        return self.router.queue.shed
+
+    @property
+    def max_pending_seen(self) -> int:
+        return self.router.queue.max_pending_seen
+
+    @property
+    def metrics(self):
+        return self.router.queue.metrics
+
+    @metrics.setter
+    def metrics(self, m) -> None:
+        if self.router.queue.metrics is None:
+            self.router.queue.metrics = m

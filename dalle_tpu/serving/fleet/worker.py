@@ -1,0 +1,89 @@
+"""One fleet replica: the per-engine Scheduler loop, supervised.
+
+:class:`ReplicaWorker` IS a :class:`Scheduler` — same admission, cache
+tiers, eviction, crash recovery, detok worker — with the four seams a
+fleet needs overridden:
+
+* a ``kill()`` switch (chaos / tests) that raises :class:`ReplicaKilled`
+  at the next tick — modeling abrupt replica death, not graceful
+  shutdown;
+* ``_recover`` treats a kill as instantly fatal (no local restart —
+  dead replicas do not come back; the fleet drains instead).  Genuine
+  engine faults keep the per-replica restart/retry budgets;
+* drained-exit goes through the supervisor, which atomically retires the
+  replica — or holds it alive while any peer still has in-flight work a
+  crash could drain onto it;
+* the exit path hands unfinished work to
+  :meth:`ReplicaSupervisor.on_replica_exit` (drain onto survivors)
+  instead of failing it outright.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dalle_tpu.serving.scheduler import Scheduler
+
+
+class ReplicaKilled(RuntimeError):
+    """A replica was killed (fleet.kill / chaos replica-kill scenario)."""
+
+
+class ReplicaWorker(Scheduler):
+    """Drives one replica's engine from its :class:`ReplicaView`."""
+
+    def __init__(self, engine, view, *, supervisor, replica_id: int, **kw):
+        super().__init__(engine, view, replica_id=replica_id, **kw)
+        self.supervisor = supervisor
+        self._kill = threading.Event()
+
+    def kill(self) -> None:
+        """Request abrupt death; observed at the next serve tick (an idle
+        replica is woken so the kill lands within one idle quantum)."""
+        self._kill.set()
+        self.supervisor.queue.kick()
+
+    @property
+    def killed(self) -> bool:
+        return self._kill.is_set()
+
+    def _serve_tick(self) -> bool:
+        if self._kill.is_set():
+            raise ReplicaKilled(f"replica {self.replica_id} killed")
+        return super()._serve_tick()
+
+    def _recover(self, exc: BaseException) -> bool:
+        if isinstance(exc, ReplicaKilled):
+            self._fatal = str(exc)
+            return False  # run() re-raises; the finally hands off to
+            # the supervisor (drain onto survivors, never a local replay)
+        return super()._recover(exc)
+
+    def _confirm_drained(self) -> bool:
+        return self.supervisor.confirm_exit(self.replica_id)
+
+    def _fail_unfinished(self) -> None:
+        self.supervisor.on_replica_exit(self)
+
+    def replica_stats(self) -> dict:
+        """Per-replica slice of the fleet stats: THIS replica's completed
+        requests and engine counters (the registry-backed
+        ``Scheduler.stats()`` would read fleet-wide counters — the
+        registry is shared)."""
+        from dalle_tpu.serving.scheduler import request_stats
+
+        eng = self.engine
+        out = {
+            "replica": self.replica_id,
+            "device": str(eng.device) if eng.device is not None else None,
+            "ticks": eng.tick_count,
+            "restarts": self._restarts,
+            **request_stats(self.completed, eng.S),
+        }
+        out.update(
+            prefill_requests=eng.prefill_requests,
+            prefill_admits=eng.prefill_admits,
+            pool_admits=eng.pool_admits,
+            prefix_reuses=eng.prefix_reuses,
+        )
+        return out

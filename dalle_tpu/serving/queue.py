@@ -53,6 +53,7 @@ class Request:
     request_id: str = ""
     deadline_s: Optional[float] = None  # relative to arrival; None = no deadline
     variations: int = 1  # k > 1: fan out to k seeds (seed, seed+1, ...)
+    replica_hint: Optional[int] = None  # fleet: preferred replica (advisory)
     # --- filled in downstream ---
     arrival_time: Optional[float] = None
     admit_time: Optional[float] = None
@@ -66,6 +67,7 @@ class Request:
     retries: int = 0  # crash-recovery replays consumed so far
     service_tier: int = 0  # degradation tier the request was served at
     slot: Optional[int] = None  # engine slot last occupied (trace track)
+    replica: Optional[int] = None  # fleet: replica that served the request
     # --- serving-cache bookkeeping (docs/SERVING.md §7) ---
     cache_hit: bool = False  # served from the result cache, zero device work
     cache_key: Optional[str] = None  # content address under the result cache
@@ -262,6 +264,14 @@ class RequestQueue:
         return req
 
     # --- dequeue ---------------------------------------------------------
+    # Multi-consumer contract (fleet router, docs/SERVING.md §8): pop(),
+    # requeue(), and drain() each select AND remove under the single
+    # queue lock, so with N consumer threads pulling concurrently every
+    # request is handed to exactly one consumer — never double-popped,
+    # never lost.  EDF order is global: concurrent pop(1) calls serve
+    # the two earliest deadlines, in some interleaving.  (What the lock
+    # does NOT order is which consumer gets the earlier deadline — the
+    # router layers its own placement policy on top.)
     def pop(self, max_n: int) -> list:
         """Pop up to ``max_n`` requests, earliest-deadline-first
         (non-blocking).  Requests without a deadline rank after all
@@ -270,6 +280,16 @@ class RequestQueue:
         with self._cv:
             if not self._q or max_n <= 0:
                 return []
+            if max_n == 1:
+                # the scheduler/router hot path pops one at a time: a
+                # single O(n) min scan instead of a full sort + rebuild
+                i = min(
+                    range(len(self._q)),
+                    key=lambda i: (self._q[i].deadline_abs(), i),
+                )
+                req = self._q[i]
+                del self._q[i]
+                return [req]
             order = sorted(
                 range(len(self._q)),
                 key=lambda i: (self._q[i].deadline_abs(), i),
@@ -320,3 +340,11 @@ class RequestQueue:
         """Block until a request is pending or the queue is closed."""
         with self._cv:
             self._cv.wait_for(lambda: bool(self._q) or self._closed, timeout)
+
+    def kick(self):
+        """Wake every ``wait()``-er without enqueueing anything — the
+        fleet router calls this after stashing a popped request for a
+        DIFFERENT replica, so that replica's idle wait ends now rather
+        than at its next timeout."""
+        with self._cv:
+            self._cv.notify_all()

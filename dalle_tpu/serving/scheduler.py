@@ -167,12 +167,18 @@ class Scheduler:
         tracer=None,
         result_cache: Optional[ResultCache] = None,
         fingerprint: Optional[str] = None,
+        replica_id: Optional[int] = None,
     ):
         assert policy in POLICIES, f"policy must be one of {POLICIES}"
         self.engine = engine
         self.queue = req_queue
         self.policy = policy
         self.on_result = on_result
+        # Fleet: which replica this scheduler drives (None = standalone).
+        # Trace tracks get an "r{id}/" prefix so one telemetry session
+        # shows every replica's slots/queue/detok lanes side by side.
+        self.replica_id = replica_id
+        self._tp = f"r{replica_id}/" if replica_id is not None else ""
         # --- serving cache (docs/SERVING.md §7) ---
         self.result_cache = result_cache
         if result_cache is not None and fingerprint is None:
@@ -200,6 +206,11 @@ class Scheduler:
         self.detok_backlog_peak = 0
         self._fatal: Optional[str] = None
         self._tick_ewma: Optional[float] = None  # seconds per engine tick
+        # crash budget is a LOCAL count: in a fleet the registry is
+        # shared, and one replica's crashes must not exhaust another's
+        # restart budget (the serve_engine_restarts counter still
+        # aggregates fleet-wide for telemetry)
+        self._restarts = 0
         # Request-lifecycle counters live in a MetricsRegistry so stats()
         # is a registry read (docs/OBSERVABILITY.md).  Default: the global
         # telemetry registry when a session is live, else a private
@@ -276,7 +287,7 @@ class Scheduler:
                 tier = self._degrade.tier if self._degrade is not None else 0
                 req.service_tier = tier
                 try:
-                    with self.tracer.span("detok", track="detok",
+                    with self.tracer.span("detok", track=self._tp + "detok",
                                           request_id=req.request_id,
                                           tier=tier):
                         faults.on_detok()  # injected detok_fail (no-op off)
@@ -290,7 +301,7 @@ class Scheduler:
                             )[0]
                             if tier < 1 and self._clip_fn is not None:
                                 with self.tracer.span(
-                                    "clip_rerank", track="detok",
+                                    "clip_rerank", track=self._tp + "detok",
                                     request_id=req.request_id,
                                 ):
                                     score = self._clip_fn(
@@ -524,7 +535,8 @@ class Scheduler:
                 if req.admit_time is not None:
                     self.tracer.complete(
                         "decode(evicted)", req.admit_time, time.monotonic(),
-                        track=f"slot{req.slot}", request_id=req.request_id,
+                        track=f"{self._tp}slot{req.slot}",
+                        request_id=req.request_id,
                         remaining_ticks=rem,
                     )
                 log_event(
@@ -540,11 +552,12 @@ class Scheduler:
         serving can continue."""
         eng = self.engine
         self._c_restarts.inc()
-        crashes = self._c_restarts.value
+        self._restarts += 1
+        crashes = self._restarts
         in_flight = eng.in_flight()
         log_event(
             "engine_crash", error=f"{type(exc).__name__}: {exc}",
-            crash=crashes,
+            crash=crashes, replica=self.replica_id,
             in_flight=[r.request_id for r in in_flight],
         )
         if crashes > self.max_engine_restarts:
@@ -580,6 +593,38 @@ class Scheduler:
         )
         return True
 
+    def _collect_unfinished(self) -> List[Request]:
+        """Pop every not-yet-done request this scheduler is responsible
+        for — engine slots (freed atomically with collection, so
+        ``num_active`` drops to 0), this scheduler's queue view
+        (``drain()``), dedup followers, and the admission-ready list —
+        and return them WITHOUT failing them.  The exit path fails them;
+        a fleet supervisor instead drains them onto surviving replicas
+        (docs/SERVING.md §8)."""
+        out: List[Request] = []
+        eng = self.engine
+        for b in range(eng.num_slots):
+            req = eng.slot_req[b]
+            eng.slot_req[b] = None
+            eng._slot_done[b] = None
+            if req is not None and not req._done.is_set():
+                out.append(req)
+        for req in self.queue.drain():
+            if not req._done.is_set():
+                out.append(req)
+        # dedup followers + not-yet-admitted children/orphans live outside
+        # both the queue and the engine — collect them too
+        for ent in list(self._inflight.values()):
+            for req in ent["followers"]:
+                if not req._done.is_set():
+                    out.append(req)
+        self._inflight.clear()
+        while self._ready:
+            req = self._ready.popleft()
+            if not req._done.is_set():
+                out.append(req)
+        return out
+
     def _fail_unfinished(self):
         """Exit-path guarantee: no admitted-but-unfinished or
         still-queued request may hang a ``result()`` waiter."""
@@ -587,37 +632,20 @@ class Scheduler:
             f"scheduler exited before this request completed"
             + (f" (engine: {self._fatal})" if self._fatal else "")
         )
-        eng = self.engine
-        for b in range(eng.num_slots):
-            req = eng.slot_req[b]
-            eng.slot_req[b] = None
-            eng._slot_done[b] = None
-            if req is not None and not req._done.is_set():
-                req._fail(reason)
-                self._c_failed.inc()
-                self.completed.append(req)
-        for req in self.queue.drain():
-            if not req._done.is_set():
-                req._fail(reason)
-                self._c_failed.inc()
-                self.completed.append(req)
-        # dedup followers + not-yet-admitted children/orphans live outside
-        # both the queue and the engine — release their waiters too
-        for ent in list(self._inflight.values()):
-            for req in ent["followers"]:
-                if not req._done.is_set():
-                    req._fail(reason)
-                    self._c_failed.inc()
-                    self.completed.append(req)
-        self._inflight.clear()
-        while self._ready:
-            req = self._ready.popleft()
-            if not req._done.is_set():
-                req._fail(reason)
-                self._c_failed.inc()
-                self.completed.append(req)
+        for req in self._collect_unfinished():
+            req._fail(reason)
+            self._c_failed.inc()
+            self.completed.append(req)
 
     # --- main loop -------------------------------------------------------
+    def _confirm_drained(self) -> bool:
+        """Hook: the queue view looks drained — may this loop exit?
+        Standalone schedulers always exit; a fleet ReplicaWorker asks its
+        supervisor, which atomically retires the replica (or holds it
+        alive while any peer still has in-flight work that a crash could
+        drain onto it)."""
+        return True
+
     def _serve_tick(self) -> bool:
         """One admission+decode iteration; True when fully drained."""
         eng = self.engine
@@ -626,17 +654,18 @@ class Scheduler:
         if want:
             reqs = self._next_admittable(want)
             if reqs:
-                with self.tracer.span("admit", track="scheduler",
+                with self.tracer.span("admit", track=self._tp + "scheduler",
                                       n=len(reqs)):
                     eng.admit(reqs)
                 self._sync_prefix_counter()
                 self._c_admitted.inc(len(reqs))
                 for r in reqs:
+                    r.replica = self.replica_id
                     # retrospective span: enqueue -> admission (EDF wait)
                     self._h_queue_wait.observe(r.admit_time - r.arrival_time)
                     self.tracer.complete(
                         "queue_wait", r.arrival_time, r.admit_time,
-                        track="queue", request_id=r.request_id,
+                        track=self._tp + "queue", request_id=r.request_id,
                         slot=r.slot,
                     )
         drained = False
@@ -657,7 +686,8 @@ class Scheduler:
                 # along as args
                 self.tracer.complete(
                     "decode", req.admit_time, req.finish_time,
-                    track=f"slot{req.slot}", request_id=req.request_id,
+                    track=f"{self._tp}slot{req.slot}",
+                    request_id=req.request_id,
                     seed=req.seed, ticks=eng.S,
                     tick_ewma_s=round(self._tick_ewma, 6),
                 )
@@ -669,7 +699,12 @@ class Scheduler:
                 self._resolve_cache(req)
         elif (self.queue.closed and self.queue.pending() == 0
               and not self._ready):
-            drained = True
+            drained = self._confirm_drained()
+            if not drained:
+                # a peer replica still has in-flight work: stay available
+                # for crash drain (queue.wait would return immediately —
+                # the queue IS closed — so sleep the idle quantum)
+                time.sleep(self.idle_wait)
         else:
             self.queue.wait(timeout=self.idle_wait)
         backlog = self._detok_q.qsize()
@@ -773,6 +808,7 @@ class TraceItem:
     deadline_s: Optional[float] = None
     request_id: str = ""
     variations: int = 1
+    replica_hint: Optional[int] = None
 
 
 def make_zipf_trace(
@@ -838,6 +874,7 @@ def save_trace(path: str, trace: Sequence[TraceItem]):
                 "deadline_s": it.deadline_s,
                 "request_id": it.request_id,
                 "variations": it.variations,
+                "replica_hint": it.replica_hint,
             }) + "\n")
 
 
@@ -858,6 +895,7 @@ def load_trace(path: str) -> List[TraceItem]:
                 deadline_s=d.get("deadline_s"),
                 request_id=d.get("request_id", ""),
                 variations=int(d.get("variations", 1)),
+                replica_hint=d.get("replica_hint"),
             ))
     return trace
 
@@ -882,6 +920,8 @@ def replay_trace(
     prefix_pool: Optional[PrefixPool] = None,
     prefix_pool_bytes: Optional[int] = None,
     fingerprint: Optional[str] = None,
+    replicas: int = 1,
+    devices=None,
     **scheduler_kwargs,
 ) -> dict:
     """Replay a recorded arrival trace against a fresh engine.
@@ -894,7 +934,24 @@ def replay_trace(
     the queue (overload experiments); ``result_cache``/``prefix_pool``
     (or the ``*_bytes`` shorthands, which build fresh ones) enable the
     serving cache tiers; extra keyword arguments reach the
-    :class:`Scheduler` (degradation, restart budgets, ...)."""
+    :class:`Scheduler` (degradation, restart budgets, ...).
+    ``replicas > 1`` delegates to
+    :func:`dalle_tpu.serving.fleet.fleet_replay_trace` — same traffic,
+    N engine replicas behind the fleet router (docs/SERVING.md §8)."""
+    if replicas > 1:
+        from dalle_tpu.serving.fleet import fleet_replay_trace
+
+        return fleet_replay_trace(
+            model, params, trace, replicas=replicas, devices=devices,
+            num_slots=num_slots, filter_thres=filter_thres,
+            time_scale=time_scale, policy=policy,
+            vae=vae, vae_params=vae_params, clip=clip,
+            clip_params=clip_params, max_pending=max_pending,
+            shed_policy=shed_policy, result_cache=result_cache,
+            result_cache_bytes=result_cache_bytes, prefix_pool=prefix_pool,
+            prefix_pool_bytes=prefix_pool_bytes, fingerprint=fingerprint,
+            **scheduler_kwargs,
+        )
     if result_cache is None and result_cache_bytes:
         result_cache = ResultCache(result_cache_bytes)
     if prefix_pool is None and prefix_pool_bytes:
@@ -923,7 +980,7 @@ def replay_trace(
                 text_tokens=it.text_tokens, seed=it.seed,
                 temperature=it.temperature, top_p=it.top_p,
                 deadline_s=it.deadline_s, request_id=it.request_id,
-                variations=it.variations,
+                variations=it.variations, replica_hint=it.replica_hint,
             ))
         q.close()
 

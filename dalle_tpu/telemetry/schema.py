@@ -62,6 +62,14 @@ EVENT_KINDS: Dict[str, str] = {
                           "instead of device prefill",
     "serve_variations": "variations request fanned out to k seeded "
                         "children",
+    # --- serving fleet (dalle_tpu/serving/fleet/) ------------------------
+    "replica_crash": "fleet replica died (engine fault past budget or "
+                     "injected kill); supervisor engaged",
+    "replica_drain": "dead replica's in-flight/stashed requests requeued "
+                     "for deterministic replay on survivors",
+    "fleet_rebalance": "router steered admission away from a loaded "
+                       "replica (least-loaded placement)",
+    "fleet_summary": "final Fleet.stats() emitted at fleet shutdown",
     # --- telemetry / profiling (dalle_tpu/telemetry/) --------------------
     "telemetry_enabled": "telemetry session configured (run dir, "
                          "snapshot interval)",

@@ -64,6 +64,13 @@ def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
         raise ValueError(
             f"variations must be in [1, 64], got {variations}"
         )
+    replica_hint = d.get("replica_hint")
+    if replica_hint is not None:
+        replica_hint = int(replica_hint)
+        if replica_hint < 0:
+            raise ValueError(
+                f"replica_hint must be >= 0, got {replica_hint}"
+            )
     tokens = tokenizer.tokenize(
         text, text_seq_len, truncate_text=True
     ).astype(np.int32)[0]
@@ -75,6 +82,7 @@ def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
         deadline_s=deadline_s,
         request_id=str(d.get("id", f"req{i}")),
         variations=variations,
+        replica_hint=replica_hint,
     )
 
 
@@ -104,6 +112,23 @@ def validate_serve_flags(args) -> list:
             f"--prefix_pool_bytes must be >= 0 (0 disables), got "
             f"{args.prefix_pool_bytes}"
         )
+    if args.replicas < 1:
+        errors.append(f"--replicas must be >= 1, got {args.replicas}")
+    if args.replicas > 1:
+        if args.serve_policy != "continuous":
+            errors.append(
+                f"--replicas {args.replicas} requires --serve_policy "
+                f"continuous (got {args.serve_policy}; sequential/"
+                "full_batch are single-engine batching experiments)"
+            )
+        from dalle_tpu.parallel.mesh import mesh_kwargs_from_args
+
+        if mesh_kwargs_from_args(args):
+            errors.append(
+                "--replicas (scale-OUT: N independent engine replicas) "
+                "does not compose with --mesh_* (scale-UP: one sharded "
+                "engine) yet — pick one (docs/SERVING.md §8)"
+            )
     return errors
 
 
@@ -124,6 +149,13 @@ def parse_args(argv=None):
                         help="decode slots B (concurrent in-flight "
                              "requests; static shape, no recompile as "
                              "occupancy changes)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="N > 1: serve with a fleet of N engine "
+                             "replicas behind a load-balancing router — "
+                             "each replica on its own device, crashed "
+                             "replicas drain onto survivors "
+                             "(docs/SERVING.md §8; scale-out, vs "
+                             "--mesh_* scale-up)")
     parser.add_argument("--serve_policy", type=str, default="continuous",
                         choices=("continuous", "full_batch", "sequential"),
                         help="admission policy (sequential/full_batch exist "
@@ -591,24 +623,43 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
             model_fingerprint(cfg, checkpoint_path=args.dalle_path)
             if result_cache is not None else None
         )
-        engine = DecodeEngine(
-            model, params, num_slots=args.serve_slots,
-            filter_thres=args.top_k, use_top_p=args.top_p is not None,
-            prefix_pool=prefix_pool,
-        )
-        engine.warmup()
         req_queue = RequestQueue(
             max_pending=args.max_queue, shed_policy=args.shed_policy,
             on_shed=on_shed,
         )
-        sched = Scheduler(
-            engine, req_queue, policy=args.serve_policy,
-            vae=vae, vae_params=vae_params, clip=clip,
-            clip_params=clip_params, on_result=on_result,
-            degrade=args.degrade, result_cache=result_cache,
-            fingerprint=fingerprint,
-        )
-        print(f"serving: {args.serve_slots} slots, policy "
+        if args.replicas > 1:
+            # fleet scale-out (docs/SERVING.md §8): N engine replicas on
+            # distinct devices behind the shared queue + router; the
+            # caches above are fleet-shared by construction
+            from dalle_tpu.serving import Fleet
+
+            server = Fleet(
+                model, params, replicas=args.replicas,
+                num_slots=args.serve_slots, filter_thres=args.top_k,
+                use_top_p=args.top_p is not None,
+                prefix_pool=prefix_pool, result_cache=result_cache,
+                fingerprint=fingerprint, queue=req_queue,
+                vae=vae, vae_params=vae_params, clip=clip,
+                clip_params=clip_params, on_result=on_result,
+                degrade=args.degrade,
+            )
+            server.warmup()
+        else:
+            engine = DecodeEngine(
+                model, params, num_slots=args.serve_slots,
+                filter_thres=args.top_k, use_top_p=args.top_p is not None,
+                prefix_pool=prefix_pool,
+            )
+            engine.warmup()
+            server = Scheduler(
+                engine, req_queue, policy=args.serve_policy,
+                vae=vae, vae_params=vae_params, clip=clip,
+                clip_params=clip_params, on_result=on_result,
+                degrade=args.degrade, result_cache=result_cache,
+                fingerprint=fingerprint,
+            )
+        print(f"serving: {args.replicas} replica(s) x "
+              f"{args.serve_slots} slots, policy "
               f"{args.serve_policy}, "
               f"max_queue={args.max_queue or 'unbounded'} "
               f"shed={args.shed_policy} degrade={args.degrade}, "
@@ -657,7 +708,7 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
         th = threading.Thread(target=feeder, daemon=True)
         th.start()
         try:
-            sched.run()
+            server.run()
             th.join()
         finally:
             # surface the final stats on EVERY exit path — clean drain
@@ -666,8 +717,10 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
             # stdout, so an operator never loses the run's accounting
             from dalle_tpu.training.logging import log_event
 
-            stats = sched.stats()
-            log_event("serve_summary", **stats)
+            stats = server.stats()
+            log_event("serve_summary", **{
+                k: v for k, v in stats.items() if k != "per_replica"
+            })
             print(json.dumps(stats))
     finally:
         trace_path = telemetry.shutdown()

@@ -1,0 +1,443 @@
+"""Fleet serving tests (dalle_tpu/serving/fleet/, docs/SERVING.md §8).
+
+The fleet contract stacks on the single-engine exactness contract
+(tests/test_serving.py): codes are a pure function of (text, seed,
+sampling), so *where* a request decodes — which replica, before or after
+a crash-drain, fleet of 1 or fleet of N — must never change its bytes.
+Pinned here:
+
+* 1-vs-2-replica bitwise parity over one trace, including the
+  kv_int8 + fused_decode composition;
+* the router: least-loaded dealing (a busy replica is denied work an
+  idle peer has capacity for) and advisory ``replica_hint`` steering;
+* kill-drain: a replica killed with work in flight drains onto the
+  survivor, which replays it bitwise; zero ``result()`` hangs;
+* fleet-shared caches: a prefix exported by replica 0 admits replica
+  1's same-text request; an exact repeat hits the shared result cache;
+* the shared queue under true multi-consumer contention: N threads
+  popping/requeueing concurrently — every request delivered exactly
+  once, none lost, none doubled;
+* trace round-trip: every ``TraceItem`` field — including
+  ``variations`` and the new ``replica_hint`` — survives
+  ``save_trace``/``load_trace`` field-for-field;
+* the telemetry report's per-replica span rollup over ``r<N>/`` tracks.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.serving import (
+    Fleet,
+    PrefixPool,
+    Request,
+    RequestQueue,
+    ResultCache,
+    Router,
+    TraceItem,
+    fleet_replay_trace,
+    load_trace,
+    make_poisson_trace,
+    save_trace,
+)
+
+T, F = 4, 2
+GREEDY = dict(temperature=1e-8)
+
+
+def build(rng, *, kv_int8=False, fused_decode=False, **kw):
+    kw.setdefault("image_fmap_size", F)
+    cfg = DALLEConfig(
+        num_text_tokens=30,
+        text_seq_len=T,
+        num_image_tokens=20,
+        dim=32,
+        depth=2,
+        heads=2,
+        dim_head=16,
+        **kw,
+    )
+    text = jax.random.randint(rng, (3, T), 1, 30)
+    codes = jax.random.randint(rng, (3, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    if kv_int8:
+        from dalle_tpu.models.quantize import kv_int8_model
+
+        model = kv_int8_model(model)
+    if fused_decode:
+        from dalle_tpu.models.quantize import fused_decode_model
+
+        model = fused_decode_model(model)
+    return model, params
+
+
+def _texts(cfg, n, seed=7):
+    rng = np.random.RandomState(seed)
+    return rng.randint(
+        1, cfg.num_text_tokens, size=(n, cfg.text_seq_len)
+    ).astype(np.int32)
+
+
+def _req(text, seed, rid, **kw):
+    return Request(
+        text_tokens=text, seed=seed, temperature=GREEDY["temperature"],
+        request_id=rid, **kw,
+    )
+
+
+# --- 1-vs-2-replica bitwise parity --------------------------------------
+
+
+@pytest.mark.parametrize("variant", ["plain", "kv_int8_fused"])
+def test_fleet_parity_one_vs_two_replicas(rng, variant):
+    """The same 12-request trace through a 1-replica and a 2-replica
+    fleet produces bitwise-identical codes per request — including under
+    the int8 KV cache + fused decode tick composition."""
+    model, params = build(
+        rng,
+        kv_int8=(variant == "kv_int8_fused"),
+        fused_decode=(variant == "kv_int8_fused"),
+    )
+    cfg = model.cfg
+    trace = make_poisson_trace(
+        12, 1e5, cfg.text_seq_len, cfg.num_text_tokens, seed=3
+    )
+
+    def run(replicas):
+        codes = {}
+        st = fleet_replay_trace(
+            model, params, trace, replicas=replicas, num_slots=3,
+            filter_thres=0.0,
+            on_result=lambda r: (
+                codes.__setitem__(r.request_id, np.array(r.codes))
+                if r.codes is not None else None
+            ),
+        )
+        return st, codes
+
+    st1, one = run(1)
+    st2, two = run(2)
+    assert st1["served"] == st2["served"] == 12
+    assert set(one) == set(two) and len(one) == 12
+    for k in one:
+        np.testing.assert_array_equal(
+            one[k], two[k], err_msg=f"request {k} differs 1 vs 2 replicas"
+        )
+
+
+# --- the router ---------------------------------------------------------
+
+
+def test_router_denies_busy_replica_for_idle_peer():
+    """Least-loaded dealing: an idle replica polling for the whole
+    backlog only gets its share; a busy replica is denied work an idle
+    peer has capacity for (work the idle peer then picks up)."""
+    q = RequestQueue()
+    router = Router(q, lock=threading.RLock(), ticks_per_request=10)
+    router.register(0, 2)
+    router.register(1, 2)
+    text = np.zeros(T, np.int32)
+    for i in range(4):
+        q.submit(_req(text, i, f"u{i}"))
+
+    # both idle: a greedy poll for all 4 is dealt only its share (2)
+    got0 = router.poll(0, 4, busy_ticks=0, free_slots=2, tick_s=1e-3)
+    assert len(got0) == 2
+    assert router.denied >= 2
+
+    # replica 0 now reports saturated; the backlog goes to idle replica 1
+    assert router.poll(0, 2, busy_ticks=20, free_slots=0, tick_s=1e-3) == []
+    got1 = router.poll(1, 4, busy_ticks=0, free_slots=2, tick_s=1e-3)
+    assert len(got1) == 2
+    assert q.pending() == 0
+
+
+def test_router_hint_steering():
+    """``replica_hint`` is advisory: a request popped by the wrong
+    replica is stashed for the hinted one while it has capacity; a hint
+    at a retired replica is ignored."""
+    q = RequestQueue()
+    router = Router(q, lock=threading.RLock(), ticks_per_request=10)
+    router.register(0, 4)
+    router.register(1, 4)
+    text = np.zeros(T, np.int32)
+    for i in range(3):
+        q.submit(_req(text, i, f"h{i}", replica_hint=1))
+
+    assert router.poll(0, 4, busy_ticks=0, free_slots=4, tick_s=None) == []
+    assert router.steered == 3
+    got1 = router.poll(1, 4, busy_ticks=0, free_slots=4, tick_s=None)
+    assert [r.request_id for r in got1] == ["h0", "h1", "h2"]
+
+    router.retire(1)
+    q.submit(_req(text, 9, "dead_hint", replica_hint=1))
+    got0 = router.poll(0, 1, busy_ticks=0, free_slots=4, tick_s=None)
+    assert [r.request_id for r in got0] == ["dead_hint"]
+
+
+# --- kill-drain ---------------------------------------------------------
+
+
+def test_fleet_kill_drain_bitwise(rng):
+    """Killing a replica with requests in flight: the supervisor drains
+    them onto the survivor, which replays them bitwise equal to an
+    uninterrupted run; every ``result()`` returns; exactly one crash."""
+    model, params = build(rng, image_fmap_size=4)  # 16 decode ticks
+    cfg = model.cfg
+    texts = _texts(cfg, 12)
+
+    def mk(tag):
+        return [_req(texts[i], 50 + i, f"{tag}{i}") for i in range(12)]
+
+    base = mk("b")
+    f1 = Fleet(model, params, replicas=1, num_slots=2, filter_thres=0.0)
+    f1.warmup()
+    for r in base:
+        f1.submit(r)
+    f1.close()
+    f1.run()
+    assert all(r.codes is not None for r in base)
+
+    f2 = Fleet(model, params, replicas=2, num_slots=2, filter_thres=0.0)
+    f2.warmup()
+    reqs = mk("k")
+
+    def chaos():
+        for r in reqs:
+            f2.submit(r)
+        victim = f2.workers[0]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not victim.engine.num_active:
+            time.sleep(5e-4)
+        f2.kill(0)
+        f2.close()
+
+    th = threading.Thread(target=chaos, daemon=True)
+    th.start()
+    stats = f2.run()
+    th.join()
+
+    assert [r.request_id for r in reqs if not r._done.is_set()] == []
+    assert {r.request_id: r.error for r in reqs if r.error} == {}
+    for r, b in zip(reqs, base):
+        np.testing.assert_array_equal(
+            r.codes, b.codes, err_msg=f"{r.request_id} != uninterrupted"
+        )
+    assert stats["replica_crashes"] == 1
+    assert stats["drain_failed"] == 0
+    # the survivor served everything the victim didn't finish
+    assert stats["per_replica"][1]["served"] + stats["per_replica"][0][
+        "served"
+    ] == 12
+
+
+def test_fleet_kill_all_replicas_fails_structured(rng):
+    """No survivors: every unfinished request completes with a
+    structured error — ``result()`` never hangs."""
+    model, params = build(rng, image_fmap_size=4)
+    texts = _texts(model.cfg, 6)
+    fleet = Fleet(model, params, replicas=2, num_slots=2, filter_thres=0.0)
+    fleet.warmup()
+    reqs = [_req(texts[i], 80 + i, f"x{i}") for i in range(6)]
+
+    def chaos():
+        for r in reqs:
+            fleet.submit(r)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not any(
+            w.engine.num_active for w in fleet.workers
+        ):
+            time.sleep(5e-4)
+        fleet.kill(0)
+        fleet.kill(1)
+        fleet.close()
+
+    th = threading.Thread(target=chaos, daemon=True)
+    th.start()
+    stats = fleet.run()
+    th.join()
+
+    assert all(r._done.is_set() for r in reqs)
+    assert all(r.codes is not None or r.error is not None for r in reqs)
+    assert stats["replica_crashes"] == 2
+    assert stats["served"] + stats["dropped"] == 6
+
+
+# --- fleet-shared caches ------------------------------------------------
+
+
+def test_fleet_shared_caches_cross_replica(rng):
+    """One ResultCache + one PrefixPool serve the whole fleet: replica
+    0's prefill admits replica 1's same-text request off the shared
+    pool, and an exact (text, seed) repeat hits the shared result cache
+    bitwise no matter which replica stored it."""
+    model, params = build(rng)
+    cfg = model.cfg
+    text = _texts(cfg, 1)[0]
+    rc, pool = ResultCache(8 << 20), PrefixPool(8 << 20)
+    fleet = Fleet(
+        model, params, replicas=2, num_slots=2, filter_thres=0.0,
+        result_cache=rc, prefix_pool=pool,
+    )
+    fleet.warmup()
+    r1 = _req(text, 1, "warm", replica_hint=0)
+    r2 = _req(text, 2, "reuse", replica_hint=1)  # same text, new seed
+    r3 = _req(text, 1, "repeat", replica_hint=1)  # exact repeat
+
+    def feeder():
+        fleet.submit(r1)
+        r1._done.wait(timeout=60.0)
+        fleet.submit(r2)
+        fleet.submit(r3)
+        fleet.close()
+
+    th = threading.Thread(target=feeder, daemon=True)
+    th.start()
+    stats = fleet.run()
+    th.join()
+
+    assert all(r.codes is not None for r in (r1, r2, r3))
+    assert r1.replica == 0 and r2.replica == 1  # hints honored when idle
+    assert stats["cache_hits"] >= 1  # r3 from the shared result cache
+    assert stats["prefix_reuses"] >= 1  # r2 off replica 0's exported prefix
+    np.testing.assert_array_equal(r3.codes, r1.codes)
+
+
+# --- shared queue under multi-consumer contention -----------------------
+
+
+def test_queue_multiconsumer_stress():
+    """N consumer threads pop (and occasionally requeue) from one queue
+    under a live producer: every request is delivered exactly once —
+    no double-pop, none lost — because selection AND removal happen
+    under the single queue lock."""
+    q = RequestQueue()
+    n, n_consumers = 300, 4
+    text = np.zeros(T, np.int32)
+    reqs = [_req(text, i, f"s{i}") for i in range(n)]
+    delivered, requeued_once = [], set()
+    lock = threading.Lock()
+
+    def producer():
+        for i, r in enumerate(reqs):
+            q.submit(r)
+            if i % 64 == 0:
+                time.sleep(1e-3)
+        q.close()
+
+    def consumer(k):
+        batch = 1 if k % 2 == 0 else 3
+        while True:
+            got = q.pop(batch)
+            if not got:
+                if q.closed and not q.pending():
+                    return
+                q.wait(0.01)
+                continue
+            keep = []
+            for r in got:
+                with lock:
+                    back = (len(requeued_once) < 32
+                            and r.request_id not in requeued_once)
+                    if back:
+                        requeued_once.add(r.request_id)
+                if back:
+                    q.requeue([r])  # contended requeue->re-pop cycle
+                else:
+                    keep.append(r)
+            with lock:
+                delivered.extend(keep)
+
+    threads = [threading.Thread(target=producer, daemon=True)] + [
+        threading.Thread(target=consumer, args=(k,), daemon=True)
+        for k in range(n_consumers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads)
+
+    ids = [r.request_id for r in delivered]
+    assert len(ids) == n, f"lost {n - len(ids)} requests"
+    assert len(set(ids)) == n, "double-pop: a request was delivered twice"
+    assert q.pending() == 0
+
+
+# --- trace round-trip ---------------------------------------------------
+
+
+def test_trace_roundtrip_every_field(tmp_path):
+    """``save_trace``/``load_trace`` round-trip every ``TraceItem``
+    field — including ``variations`` and ``replica_hint`` — exactly."""
+    items = [
+        TraceItem(
+            arrival_s=0.125, text_tokens=np.array([1, 2, 3, 4], np.int32),
+            seed=11, temperature=0.75, top_p=0.9, deadline_s=2.5,
+            request_id="full", variations=3, replica_hint=1,
+        ),
+        TraceItem(
+            arrival_s=1.5, text_tokens=np.array([5, 6, 7, 8], np.int32),
+            seed=0, temperature=1.0, top_p=None, deadline_s=None,
+            request_id="defaults", variations=1, replica_hint=None,
+        ),
+        TraceItem(
+            arrival_s=2.0, text_tokens=np.array([9, 9, 9, 9], np.int32),
+            seed=-3, temperature=1e-8, top_p=0.01, deadline_s=0.0,
+            request_id="", variations=2, replica_hint=0,
+        ),
+    ]
+    path = str(tmp_path / "trace.jsonl")
+    save_trace(path, items)
+    back = load_trace(path)
+    assert len(back) == len(items)
+    for a, b in zip(items, back):
+        np.testing.assert_array_equal(
+            np.asarray(a.text_tokens, np.int32), b.text_tokens
+        )
+        for field in ("arrival_s", "seed", "temperature", "top_p",
+                      "deadline_s", "request_id", "variations",
+                      "replica_hint"):
+            assert getattr(a, field) == getattr(b, field), (
+                f"{field}: {getattr(a, field)!r} != {getattr(b, field)!r}"
+            )
+
+
+# --- telemetry: per-replica tracks + report rollup ----------------------
+
+
+def test_telemetry_report_per_replica(rng, tmp_path):
+    """A fleet run under a live telemetry session prefixes tracks with
+    ``r<N>/``; the report rolls spans up per replica."""
+    from dalle_tpu import telemetry
+    from tools.telemetry_report import render_report
+
+    model, params = build(rng)
+    cfg = model.cfg
+    texts = _texts(cfg, 6)
+    run_dir = str(tmp_path)
+    telemetry.configure(run_dir, metrics_interval_s=3600.0)
+    try:
+        fleet = Fleet(
+            model, params, replicas=2, num_slots=2, filter_thres=0.0
+        )
+        fleet.warmup()
+        for i in range(6):
+            # pin three requests per replica so both emit spans
+            fleet.submit(_req(texts[i], 30 + i, f"t{i}",
+                              replica_hint=i % 2))
+        fleet.close()
+        stats = fleet.run()
+    finally:
+        telemetry.shutdown()
+
+    assert stats["served"] == 6
+    report = render_report(run_dir)
+    assert "per replica:" in report
+    assert "r0" in report and "r1" in report

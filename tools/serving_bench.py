@@ -75,6 +75,13 @@ def parse_args(argv=None):
                          "later replays")
     ap.add_argument("--slots", type=str, default="1,4,8",
                     help="comma-separated slot counts to sweep")
+    ap.add_argument("--replicas", type=str, default="1",
+                    help="comma-separated replica counts to sweep "
+                         "(docs/SERVING.md §8); N>1 replays through a "
+                         "fleet of N engines on distinct devices — on "
+                         "CPU the virtual host devices are forced "
+                         "automatically.  Fleet combinations require "
+                         "the continuous policy")
     ap.add_argument("--policy", type=str, default="continuous",
                     help="comma-separated subset of "
                          "sequential,full_batch,continuous (or 'all')")
@@ -115,6 +122,18 @@ def _quick_model(seed=0):
 
 def main(argv=None):
     args = parse_args(argv)
+
+    replica_counts = [int(r) for r in args.replicas.split(",")]
+    if (max(replica_counts) > 1
+            and "host_platform_device_count" not in
+            os.environ.get("XLA_FLAGS", "")):
+        # must land before the backend initializes; only affects the
+        # CPU host platform (a real TPU fleet uses its own devices)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count"
+              f"={max(replica_counts)}"
+        )
 
     import jax
 
@@ -181,7 +200,7 @@ def main(argv=None):
     if args.prefix_pool_bytes > 0:
         cache_kw["prefix_pool_bytes"] = args.prefix_pool_bytes
 
-    def run(policy, slots, cached):
+    def run(policy, slots, cached, replicas=1):
         codes = {}
         kw = dict(cache_kw) if cached else {}
         if cached and not kw:  # --compare_cache with no explicit budgets
@@ -190,6 +209,7 @@ def main(argv=None):
         stats = replay_trace(
             model, params, trace, policy=policy, num_slots=slots,
             filter_thres=args.filter_thres, time_scale=args.time_scale,
+            replicas=replicas,
             on_result=lambda r: (
                 codes.__setitem__(r.request_id, np.array(r.codes))
                 if r.codes is not None and r.parent is None else None
@@ -203,8 +223,14 @@ def main(argv=None):
             if policy == "sequential" and slots != slot_counts[0]:
                 continue  # batch-of-1 ignores the slot count
             if not args.compare_cache:
-                stats, _ = run(policy, slots, cached=bool(cache_kw))
-                print(json.dumps(stats))
+                for replicas in replica_counts:
+                    if replicas > 1 and policy != "continuous":
+                        continue  # fleet serving is continuous-only
+                    stats, _ = run(policy, slots, cached=bool(cache_kw),
+                                   replicas=replicas)
+                    stats.pop("per_replica", None)
+                    stats["replicas"] = replicas
+                    print(json.dumps(stats))
                 continue
             # cached vs uncached over the SAME trace: the cached pass
             # must produce bitwise-identical codes while paying device

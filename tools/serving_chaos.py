@@ -27,6 +27,13 @@ protocol guarantees, not model quality):
    session: the exported ``trace.json`` is Perfetto-loadable and the
    ``metrics.jsonl`` request counters reconcile exactly with
    ``Scheduler.stats()`` (docs/OBSERVABILITY.md).
+6. **replica_kill** — a 2-replica :class:`Fleet` (docs/SERVING.md §8)
+   with fleet-shared caches loses replica 0 while it has requests in
+   flight: the supervisor drains them onto the survivor, which replays
+   them bitwise equal to an uninterrupted single-engine run; a second
+   wave of exact repeats still hits the shared result cache and
+   same-text-new-seed arrivals still reuse the shared prefix pool after
+   the kill; zero ``result()`` hangs.
 
 Run directly (``python tools/serving_chaos.py``), as the
 ``serving_resilience`` bench rung, or via
@@ -433,9 +440,118 @@ def scenario_telemetry(model, params, *, slots=3, n_req=10, max_pending=2,
     }
 
 
+def scenario_replica_kill(model, params, *, slots=3, replicas=2) -> dict:
+    """Kill a fleet replica with work in flight against WARM fleet-shared
+    caches: the survivor replays the drained requests bitwise equal to an
+    uninterrupted single-engine run, and the shared result cache / prefix
+    pool keep serving hits after the kill — zero ``result()`` hangs."""
+    import numpy as np
+
+    from dalle_tpu.serving import Fleet, PrefixPool, Request, ResultCache
+
+    cfg = model.cfg
+    rng = np.random.RandomState(23)
+    texts = rng.randint(
+        1, cfg.num_text_tokens, size=(4, cfg.text_seq_len)
+    ).astype(np.int32)
+    # wave 1: 8 distinct (text, seed) pairs over 4 texts — enough to put
+    # both replicas in flight.  wave 2 (submitted AFTER the kill): 4
+    # exact repeats of wave 1 (shared result-cache hits) + 4 new seeds
+    # (shared prefix-pool reuses that decode on the survivor)
+    wave1 = [(i % 4, 200 + i) for i in range(8)]
+    wave2 = wave1[:4] + [(ti, 300 + ti) for ti in range(4)]
+
+    def mk(spec, tag):
+        return [
+            Request(
+                text_tokens=texts[ti], seed=s,
+                temperature=GREEDY["temperature"],
+                request_id=f"{tag}_{ti}_{s}",
+            )
+            for ti, s in spec
+        ]
+
+    # cold single-engine baseline over every distinct (text, seed)
+    distinct = list(dict.fromkeys(wave1 + wave2))
+    baseline = mk(distinct, "cold")
+    _serve(model, params, baseline, num_slots=slots)
+    expect = {k: r.codes for k, r in zip(distinct, baseline)}
+    assert all(r.codes is not None for r in baseline)
+
+    rc, pool = ResultCache(16 << 20), PrefixPool(16 << 20)
+    fleet = Fleet(
+        model, params, replicas=replicas, num_slots=slots,
+        filter_thres=GREEDY["filter_thres"], result_cache=rc,
+        prefix_pool=pool,
+    )
+    fleet.warmup()
+    w1, w2 = mk(wave1, "w1"), mk(wave2, "w2")
+    killed = {"in_flight": 0}
+
+    def chaos():
+        for r in w1:
+            fleet.submit(r)
+        victim = fleet.workers[0]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if victim.engine.num_active:
+                break
+            time.sleep(0.001)
+        killed["in_flight"] = victim.engine.num_active
+        fleet.kill(0)
+        # wave 1 fully served (drained work replayed on the survivor)
+        # before wave 2's exact repeats arrive — so the repeats MUST be
+        # result-cache hits if the cache survived the kill coherently
+        for r in w1:
+            r._done.wait(timeout=60.0)
+        for r in w2:
+            fleet.submit(r)
+        fleet.close()
+
+    th = threading.Thread(target=chaos, daemon=True)
+    th.start()
+    stats = fleet.run()
+    th.join()
+
+    allr = w1 + w2
+    hangs = [r.request_id for r in allr if not r._done.is_set()]
+    errors = {r.request_id: r.error for r in allr if r.error is not None}
+    mismatches = [
+        r.request_id
+        for k, r in zip(wave1 + wave2, allr)
+        if r.codes is None or not np.array_equal(r.codes, expect[k])
+    ]
+    ok = (
+        not hangs and not errors and not mismatches
+        and killed["in_flight"] > 0
+        and stats["replica_crashes"] == 1
+        and stats["drained_requests"] > 0
+        and stats["drain_failed"] == 0
+        and stats["cache_hits"] >= len(wave2) - 4
+        and stats["prefix_reuses"] > 0
+    )
+    return {
+        "ok": ok,
+        "replicas": replicas,
+        "victim_in_flight_at_kill": killed["in_flight"],
+        "hangs": hangs,
+        "errors": errors,
+        "replay_mismatches": mismatches,
+        "replica_crashes": stats["replica_crashes"],
+        "drained_requests": stats["drained_requests"],
+        "drain_failed": stats["drain_failed"],
+        "cache_hits": stats["cache_hits"],
+        "prefix_reuses": stats["prefix_reuses"],
+        "served": stats["served"],
+        "per_replica_served": [
+            p["served"] for p in stats["per_replica"]
+        ],
+    }
+
+
 def run_serving_chaos(*, slots=3, n_req=6, p99_gate=2.0,
                       telemetry_dir=None) -> dict:
-    """All five scenarios; ``ok`` iff every gate holds."""
+    """All six scenarios; ``ok`` iff every gate holds."""
     model, params = _quick_model()
     crash = scenario_crash_replay(model, params, slots=slots, n_req=n_req)
     fail_fast = scenario_fail_fast(model, params, slots=slots)
@@ -443,14 +559,16 @@ def run_serving_chaos(*, slots=3, n_req=6, p99_gate=2.0,
     flood = scenario_flood(model, params, p99_gate=p99_gate)
     tel = scenario_telemetry(model, params, slots=slots,
                              run_dir=telemetry_dir)
+    replica_kill = scenario_replica_kill(model, params, slots=slots)
     return {
         "ok": (crash["ok"] and fail_fast["ok"] and cache_crash["ok"]
-               and flood["ok"] and tel["ok"]),
+               and flood["ok"] and tel["ok"] and replica_kill["ok"]),
         "crash_replay": crash,
         "fail_fast": fail_fast,
         "cache_crash": cache_crash,
         "flood": flood,
         "telemetry": tel,
+        "replica_kill": replica_kill,
     }
 
 
@@ -466,6 +584,14 @@ def main(argv=None):
                          "metrics.jsonl + trace.json (default: a "
                          "fresh tempdir)")
     args = ap.parse_args(argv)
+
+    # the replica_kill scenario wants 2 CPU host devices; must land
+    # before the backend initializes (no-op on a real accelerator)
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        )
 
     import jax
 

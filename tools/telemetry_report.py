@@ -168,6 +168,21 @@ def _trace_lines(run_dir):
             f"    {track:<12} {name:<18} n={cnt:<5} "
             f"total={tot_us / 1e6:.3f}s mean={tot_us / cnt / 1e3:.2f}ms"
         )
+    # fleet runs prefix tracks with "r<N>/" (docs/SERVING.md §8): roll
+    # spans up per replica so load balance is readable at a glance
+    per_replica = {}
+    for (track, name), (cnt, tot_us) in agg.items():
+        head, sep, _ = track.partition("/")
+        if sep and head.startswith("r") and head[1:].isdigit():
+            spans, tot = per_replica.get(head, (0, 0.0))
+            per_replica[head] = (spans + cnt, tot + tot_us)
+    if per_replica:
+        lines.append("  per replica:")
+        for rep in sorted(per_replica, key=lambda r: int(r[1:])):
+            cnt, tot_us = per_replica[rep]
+            lines.append(
+                f"    {rep:<12} spans={cnt:<5} busy={tot_us / 1e6:.3f}s"
+            )
     return lines
 
 
