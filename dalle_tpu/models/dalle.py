@@ -130,6 +130,11 @@ class DALLEConfig:
     # decomposed tp collective-matmul rings (parallel/overlap.py) — compute
     # policy; needs tp>1 in the mesh and no sp, falls back silently else
     tp_overlap: bool = False
+    # sharded-decode TP collective mode (serving mesh-aware tick): None =
+    # dense GSPMD decode; "f32" = overlap.py rings on the decode path;
+    # "bf16"/"int8" = parallel/compress.py deterministic quantized
+    # all-reduce.  Compute policy like fused_decode — never an hparam
+    decode_comm: Optional[str] = None
     # fsdp param-gather prefetch under scan_layers (transformer.py
     # ScanStack) — compute policy
     fsdp_prefetch: bool = False
@@ -202,6 +207,7 @@ class DALLEConfig:
             fused_ff=self.fused_ff,
             fused_decode=self.fused_decode,
             tp_overlap=self.tp_overlap,
+            decode_comm=self.decode_comm,
             fsdp_prefetch=self.fsdp_prefetch,
             dtype=self.dtype,
             stream_dtype=self.stream_dtype,
@@ -219,6 +225,7 @@ class DALLEConfig:
         d.pop("fused_ff")
         d.pop("fused_decode")
         d.pop("tp_overlap")
+        d.pop("decode_comm")
         d.pop("fsdp_prefetch")
         d["attn_types"] = list(self.attn_types)
         return d
@@ -231,6 +238,7 @@ class DALLEConfig:
         d.pop("fused_ff", None)
         d.pop("fused_decode", None)
         d.pop("tp_overlap", None)
+        d.pop("decode_comm", None)
         d.pop("fsdp_prefetch", None)
         d.pop("stream_dtype", None)
         d["attn_types"] = tuple(d.get("attn_types", ("full",)))

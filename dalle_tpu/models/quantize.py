@@ -91,6 +91,21 @@ def fused_decode_model(model):
     return DALLE(dataclasses.replace(model.cfg, fused_decode=True))
 
 
+def decode_comm_model(model, mode: str = "f32"):
+    """Rebuild a DALLE with the sharded-decode TP collective mode set
+    (transformer.py decode_comm).  No param change — it is a compute
+    policy.  The shared idiom behind generate.py --decode_comm and the
+    bench decode_shard rung; only engages under an ambient tp>1 mesh
+    (overlap.decode_tp_mesh), so at tp == 1 the model stays bitwise the
+    flag-off model.  Composes with :func:`kv_int8_model` and
+    :func:`fused_decode_model`; ``quant_int8`` params fall back dense."""
+    from dalle_tpu.models.dalle import DALLE
+    from dalle_tpu.parallel.compress import DECODE_COMM_MODES
+
+    assert mode in DECODE_COMM_MODES, mode
+    return DALLE(dataclasses.replace(model.cfg, decode_comm=mode))
+
+
 def quant_model_config(cfg, mode: str = "dynamic"):
     """The decode-time config for a trained ``DALLEConfig``: int8
     projections on, training-only features untouched.  ``mode``:

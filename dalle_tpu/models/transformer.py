@@ -170,6 +170,17 @@ class TransformerConfig:
     # — never an hparam.  Needs tp>1 in the ambient mesh, seq % tp == 0,
     # no sp, no quant_int8, dropout inactive; falls back silently else.
     tp_overlap: bool = False
+    # sharded-decode TP collectives (serving/engine.py mesh-aware tick):
+    # None = dense decode (GSPMD inserts baseline f32 all-reduces; at
+    # tp == 1 this is bitwise the unsharded math).  "f32" reuses the
+    # overlap.py collective-matmul rings on the decode path (slots stand
+    # in for the sequence axis); "bf16"/"int8" run the attention-out and
+    # FF partial sums through parallel/compress.py's deterministic
+    # quantized all-reduce (EQuARX-style, round-to-nearest — decode
+    # replay must stay deterministic).  Compute policy like fused_decode
+    # — never an hparam, popped in to_dict.  Needs tp > 1 in the ambient
+    # mesh; falls back silently else (overlap.decode_tp_mesh).
+    decode_comm: Optional[str] = None
     # fsdp param-gather prefetch (requires scan_layers): layer i+1's
     # param all-gather is issued during layer i's compute via a manual
     # double-buffered lax.scan instead of nn.scan.  Compute policy.
@@ -542,6 +553,39 @@ class FeedForward(nn.Module):
     def __call__(self, x, deterministic=True):
         c = self.cfg
         dropout_active = c.ff_dropout > 0.0 and not deterministic
+        if (
+            x.shape[1] == 1
+            and c.decode_comm is not None
+            and not c.quant_int8
+            and not dropout_active
+        ):
+            # sharded decode tick (SubLayer.decode_step feeds [b, 1, d]):
+            # the whole GEGLU FF runs inside one manual TP region with a
+            # single all-reduce at the decode_comm wire width — either the
+            # overlap.py rings with slots as the sequence axis (f32) or
+            # compress.py's deterministic quantized psum (bf16/int8).
+            from dalle_tpu.parallel import overlap
+
+            dm = overlap.decode_tp_mesh(c, x.shape[0])
+            if dm is not None:
+                inner = c.dim * c.ff_mult
+                x, wi_k, wi_b, wo_k, wo_b = nn.dtypes.promote_dtype(
+                    x, self.wi.kernel, self.wi.bias,
+                    self.wo.kernel, self.wo.bias, dtype=c.dtype,
+                )
+                w3 = wi_k.reshape(c.dim, 2, inner)
+                b2 = wi_b.reshape(2, inner)
+                if c.decode_comm == "f32":
+                    h = x.transpose(1, 0, 2)  # [1, slots, d]
+                    h = overlap.all_gather_geglu_matmul(h, w3, b2, mesh=dm)
+                    h = overlap.matmul_reduce_scatter(h, wo_k, wo_b, mesh=dm)
+                    h = overlap.ring_all_gather(h, mesh=dm)
+                    return h.transpose(1, 0, 2)
+                from dalle_tpu.parallel import compress
+
+                return compress.decode_geglu_matmul_allreduce(
+                    x, w3, b2, wo_k, wo_b, mode=c.decode_comm, mesh=dm
+                )
         if c.tp_overlap and not c.quant_int8 and not dropout_active:
             # decomposed collective-matmul (parallel/overlap.py): wi rides
             # the sequence all-gather ring (GEGLU applied per chunk), wo
@@ -577,6 +621,51 @@ class FeedForward(nn.Module):
         y = y * jax.nn.gelu(gate, approximate=False)  # exact erf (torch F.gelu parity)
         y = self.drop(y, deterministic=deterministic)
         return self.wo(y)
+
+
+def _sharded_flash_decode(c, qg, cache, pos_vec, mask):
+    """``flash_decode_attention`` under an ambient tp>1 mesh: the Pallas
+    kernel is not GSPMD-partitionable, but the decode read is exactly
+    per-(slot, kv-head) independent — so shard_map it over the kv-head
+    axis (q groups, K/V rows, and int8 scales all carry kv on axis 1) and
+    each device runs the kernel on its local heads.  At tp == 1 (or kv
+    heads not divisible) the call is unwrapped and bitwise-identical to
+    the flag-off path."""
+    from dalle_tpu.parallel.mesh import get_ambient_mesh
+    from dalle_tpu.parallel.mesh import shard_map as _smap
+
+    mesh = get_ambient_mesh()
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp <= 1 or c.num_kv_heads % tp != 0:
+        return flash_ops.flash_decode_attention(
+            qg, cache["k"], cache["v"], pos_vec,
+            k_scale=cache.get("k_scale"), v_scale=cache.get("v_scale"),
+            mask=mask,
+        )
+    from jax.sharding import PartitionSpec as _P
+
+    hs = _P(None, "tp", None, None)
+    pm = (_P(None), _P(None, None, None, None))
+    if "k_scale" in cache:
+        fn = _smap(
+            lambda q, k, v, ks, vs, p, m: flash_ops.flash_decode_attention(
+                q, k, v, p, k_scale=ks, v_scale=vs, mask=m
+            ),
+            mesh=mesh, in_specs=(hs, hs, hs, hs, hs) + pm, out_specs=hs,
+            check_vma=False,
+        )
+        return fn(
+            qg, cache["k"], cache["v"], cache["k_scale"], cache["v_scale"],
+            pos_vec, mask,
+        )
+    fn = _smap(
+        lambda q, k, v, p, m: flash_ops.flash_decode_attention(
+            q, k, v, p, mask=m
+        ),
+        mesh=mesh, in_specs=(hs, hs, hs) + pm, out_specs=hs,
+        check_vma=False,
+    )
+    return fn(qg, cache["k"], cache["v"], pos_vec, mask)
 
 
 class JointAttention(nn.Module):
@@ -980,16 +1069,37 @@ class JointAttention(nn.Module):
             # broadcasts to the vector-pos layout (same kernel, no retrace
             # across scalar/vector call sites beyond the batch shape).
             pos_vec = idx if per_slot else jnp.full((b,), idx, jnp.int32)
-            out = flash_ops.flash_decode_attention(
-                qg, new_cache["k"], new_cache["v"], pos_vec,
-                k_scale=new_cache.get("k_scale"),
-                v_scale=new_cache.get("v_scale"),
-                mask=mask,
-            )
+            out = _sharded_flash_decode(c, qg, new_cache, pos_vec, mask)
         else:
             ck, cv = self._cache_kv(new_cache)  # [b, kv, n, d]
             out = attn_ops._sdpa(qg, ck, cv, mask)  # [b,kv,g,d]
-        return self.to_out(out.reshape(b, -1)), new_cache
+        o = out.reshape(b, -1)
+        dm = None
+        if c.decode_comm is not None and not c.quant_int8:
+            from dalle_tpu.parallel import overlap
+
+            dm = overlap.decode_tp_mesh(c, b)
+        if dm is None:
+            return self.to_out(o), new_cache
+        # sharded decode tick: the row-parallel out-projection's partial
+        # sums meet in a manual TP collective at the decode_comm wire
+        # width instead of GSPMD's f32 all-reduce
+        y, k_, b_ = nn.dtypes.promote_dtype(
+            o, self.to_out.kernel, self.to_out.bias, dtype=c.dtype
+        )
+        if c.decode_comm == "f32":
+            from dalle_tpu.parallel import overlap
+
+            h = overlap.matmul_reduce_scatter(y[None], k_, b_, mesh=dm)
+            return overlap.ring_all_gather(h, mesh=dm)[0], new_cache
+        from dalle_tpu.parallel import compress
+
+        return (
+            compress.decode_matmul_allreduce(
+                y, k_, b_, mode=c.decode_comm, mesh=dm
+            ),
+            new_cache,
+        )
 
 
 class CausalSGU(nn.Module):

@@ -29,6 +29,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 GRAD_COMM_MODES = ("f32", "bf16", "int8")
 
@@ -136,3 +137,107 @@ def compressed_reduce(
 def compressed_psum(x, *, mode, key, axes):
     """Full all-reduce at the ``mode`` wire width (see compressed_reduce)."""
     return compressed_reduce(x, mode=mode, key=key, sum_axes=axes)
+
+
+# --- decode-path quantized collectives (--decode_comm) ----------------------
+#
+# The serving engine's TP tick needs the same EQuARX trick on its two
+# per-layer all-reduces (attention-out and FF-down partial sums), with one
+# difference from the grad path: decode replay must be DETERMINISTIC — a
+# request's codes are pinned to (text, seed, sampling) alone, so the int8
+# quantizer rounds to nearest instead of stochastically.  Bias doesn't
+# matter here (nothing accumulates across steps the way grad noise would);
+# determinism does.
+
+DECODE_COMM_MODES = GRAD_COMM_MODES
+
+
+def _rn_quantize(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """Round-to-nearest x/scale into [-127, 127] int32 (deterministic)."""
+    q = jnp.round(x / scale)
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int32)
+
+
+def decode_psum(x: jax.Array, *, mode: str, axes) -> jax.Array:
+    """Deterministic all-reduce at the ``mode`` wire width; shard_map-body
+    only.  Returns x.dtype (the decode residual stream's width)."""
+    if mode not in DECODE_COMM_MODES:
+        raise ValueError(
+            f"mode must be one of {DECODE_COMM_MODES}, got {mode!r}"
+        )
+    axes = tuple(axes)
+    if mode == "f32":
+        return jax.lax.psum(x, axes)
+    if mode == "bf16":
+        return jax.lax.psum(x.astype(jnp.bfloat16), axes).astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    buck, n = _bucketed(xf.ravel())
+    absmax = jnp.max(jnp.abs(buck), axis=-1)
+    gmax = jax.lax.pmax(absmax, axes)
+    scale = jnp.maximum(gmax, _TINY) / 127.0
+    q = _rn_quantize(buck, scale[:, None])
+    s = jax.lax.psum(q, axes)
+    out = s.astype(jnp.float32) * scale[:, None]
+    return out.ravel()[:n].reshape(x.shape).astype(x.dtype)
+
+
+def decode_matmul_allreduce(
+    x, w, bias, *, mode: str, axis: str = "tp", mesh=None
+):
+    """Row-parallel decode projection with a quantized all-reduce.
+
+    ``x`` [b, K] feature-sharded over ``axis`` (the contraction dim — each
+    device holds the activations its row shard of ``w`` consumes); ``w``
+    [K, d] row-sharded; ``bias`` [d] replicated (added once, after the
+    full sum, matching the baseline all-reduce-then-bias).  Each device
+    dots its K/p slice and the partial sums meet in a ``decode_psum`` at
+    the ``mode`` wire width.  Returns [b, d] replicated.
+    """
+    from dalle_tpu.parallel.mesh import get_ambient_mesh, shard_map
+
+    mesh = mesh or get_ambient_mesh()
+
+    def body(x_loc, w_loc, b_full):
+        part = jnp.dot(x_loc, w_loc)
+        return decode_psum(part, mode=mode, axes=(axis,)) + b_full
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None), P(None)),
+        out_specs=P(None, None),
+        check_vma=False,
+    )(x, w, bias)
+
+
+def decode_geglu_matmul_allreduce(
+    x, w3, b2, wo, bo, *, mode: str, axis: str = "tp", mesh=None
+):
+    """Whole GEGLU FF decode step in one shard_map: column-parallel up
+    projection, local gate, row-parallel down projection, ONE quantized
+    all-reduce.
+
+    ``x`` [b, 1, d] replicated (the decode residual); ``w3`` [d, 2, F] is
+    the ``wi`` kernel reshaped so value/gate column PAIRS shard together
+    over the last dim (overlap.all_gather_geglu_matmul's layout); ``b2``
+    [2, F] likewise; ``wo`` [F, d] row-sharded; ``bo`` [d] replicated.
+    Returns [b, 1, d] replicated.
+    """
+    from dalle_tpu.parallel.mesh import get_ambient_mesh, shard_map
+
+    mesh = mesh or get_ambient_mesh()
+
+    def body(x_full, w_loc, b_loc, wo_loc, bo_full):
+        y2 = jnp.tensordot(x_full, w_loc, axes=([2], [0])) + b_loc
+        g = y2[..., 0, :] * jax.nn.gelu(y2[..., 1, :], approximate=False)
+        part = jnp.tensordot(g, wo_loc, axes=([2], [0]))
+        return decode_psum(part, mode=mode, axes=(axis,)) + bo_full
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(
+            P(None, None, None), P(None, None, axis), P(None, axis),
+            P(axis, None), P(None),
+        ),
+        out_specs=P(None, None, None),
+        check_vma=False,
+    )(x, w3, b2, wo, bo)

@@ -70,6 +70,41 @@ def tp_overlap_mesh(cfg, batch: int, seq_len: int):
     return mesh
 
 
+def decode_tp_mesh(cfg, batch: int):
+    """The ambient mesh when the manual TP decode path can run
+    (``cfg.decode_comm`` set, serving/engine.py's sharded tick): tp axis
+    > 1, head/FF inner dims divisible by tp, not the int8-decode param
+    format (QDense hides its kernel).  ``decode_comm='f32'`` reuses the
+    collective-matmul rings above with the SLOT axis standing in for the
+    sequence axis, so it additionally needs batch % tp == 0 and no
+    dp/fsdp axes (the rings' batch dim is the singleton token axis).
+    None -> caller uses the dense path (GSPMD inserts the baseline f32
+    all-reduces); at tp == 1 the dense path is bitwise the unsharded
+    engine's math, which the 1-device-mesh parity gate pins."""
+    mode = getattr(cfg, "decode_comm", None)
+    if mode is None:
+        return None
+    if getattr(cfg, "quant_int8", False):
+        return None
+    mesh = get_ambient_mesh()
+    if mesh is None or "tp" not in mesh.shape:
+        return None
+    tp = mesh.shape["tp"]
+    if tp <= 1:
+        return None
+    inner = cfg.heads * cfg.dim_head
+    ff_inner = cfg.dim * cfg.ff_mult
+    if inner % tp != 0 or ff_inner % tp != 0:
+        return None
+    if mode == "f32":
+        bprod = 1
+        for a in _BATCH:
+            bprod *= mesh.shape.get(a, 1)
+        if bprod != 1 or batch % tp != 0:
+            return None
+    return mesh
+
+
 def _ring_perm(p: int):
     return [(j, (j + 1) % p) for j in range(p)]
 

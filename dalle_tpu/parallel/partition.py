@@ -132,3 +132,56 @@ def param_shardings(params: Any, mesh: Mesh):
 def shard_params(params: Any, mesh: Mesh):
     """Place a param pytree onto the mesh per its specs."""
     return jax.device_put(params, param_shardings(params, mesh))
+
+
+# --- serving: TP-sharded decode state (serving/engine.py) -------------------
+#
+# The decode cache is slot-major ([B, ...]) and mostly head-major after
+# that.  Under tensor parallelism the attention K/V rows (and their int8
+# scales) live naturally split over kv heads — attention is head-local, so
+# a [B, kv, n, d]-class leaf sharded P(None, 'tp', ...) never moves on the
+# wire during a tick.  Everything head-less (gMLP gate values, shift hist,
+# positions, RNG ladders, sampled outputs) replicates: those leaves are
+# tiny next to the K/V rows and several feed cross-head math.
+
+
+def _decode_cache_spec(shape, num_kv_heads: int, tp: int) -> PartitionSpec:
+    if (
+        tp > 1
+        and len(shape) == 4
+        and shape[1] == num_kv_heads
+        and num_kv_heads % tp == 0
+    ):
+        return PartitionSpec(None, "tp", None, None)
+    return PartitionSpec()
+
+
+def decode_cache_specs(cache: Any, mesh: Mesh, *, num_kv_heads: int):
+    """PartitionSpec pytree for a per-slot decode cache pytree."""
+    tp = axis_size(mesh, "tp")
+    return jax.tree_util.tree_map(
+        lambda leaf: _decode_cache_spec(leaf.shape, num_kv_heads, tp), cache
+    )
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return int(sizes.get(name, 1))
+
+
+def engine_state_shardings(state: Any, mesh: Mesh, *, num_kv_heads: int):
+    """NamedSharding pytree for a serving ``EngineState``: K/V cache rows
+    over tp (where kv heads divide), every flat per-slot leaf replicated.
+    Works on any pytree whose first field is the cache — matched
+    structurally via the state's own ``_replace``-style NamedTuple."""
+    cache_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        decode_cache_specs(state.cache, mesh, num_kv_heads=num_kv_heads),
+    )
+    repl = NamedSharding(mesh, PartitionSpec())
+    flat = {
+        f: jax.tree_util.tree_map(lambda _: repl, getattr(state, f))
+        for f in state._fields
+        if f != "cache"
+    }
+    return type(state)(cache=cache_sh, **flat)

@@ -82,15 +82,32 @@ class DecodeEngine:
         prefix_pool=None,
         replica_id: int = 0,
         device=None,
+        mesh=None,
     ):
         self.model = model
         self.replica_id = int(replica_id)
         # Fleet replicas pin params (and hence every jitted dispatch,
         # whose other operands are uncommitted and follow) to their own
         # device — on CPU these are the virtual host devices from
-        # XLA_FLAGS=--xla_force_host_platform_device_count=N.
+        # XLA_FLAGS=--xla_force_host_platform_device_count=N.  ``device``
+        # also accepts a Sharding (the fleet x sharded-engine seam);
+        # ``mesh`` instead makes the whole engine mesh-aware: params per
+        # parallel/partition.py specs, K/V cache rows over tp, and all
+        # three jitted fns pinned to explicit in/out shardings so
+        # occupancy churn can never drift a sharding and recompile.
+        assert device is None or mesh is None, (
+            "pass either device= (replica pinning) or mesh= (sharded "
+            "engine), not both"
+        )
         self.device = device
-        if device is not None:
+        self.mesh = mesh
+        if mesh is not None:
+            from dalle_tpu.parallel import partition
+
+            params = jax.device_put(
+                params, partition.param_shardings(params, mesh)
+            )
+        elif device is not None:
             params = jax.device_put(params, device)
         self.params = params
         self.num_slots = int(num_slots)
@@ -100,13 +117,17 @@ class DecodeEngine:
         self.filter_thres = filter_thres
         self.use_top_p = use_top_p
         self.prefix_pool = prefix_pool
-        self._tick_fn = jax.jit(self._tick_impl, donate_argnums=(1,))
-        self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(1,))
-        self._admit_cached_fn = jax.jit(
-            self._admit_cached_impl, donate_argnums=(1,)
-        )
+        self._state_shardings = None
         self.state = self._init_state()
+        if mesh is not None:
+            from dalle_tpu.parallel import partition
+
+            self._state_shardings = partition.engine_state_shardings(
+                self.state, mesh, num_kv_heads=(c.kv_heads or c.heads)
+            )
+            self.state = jax.device_put(self.state, self._state_shardings)
         self._find_block_axes()
+        self._make_jitted_fns()
         self.tick_count = 0
         self.slot_req: List[Optional[Request]] = [None] * self.num_slots
         self._slot_done: List[Optional[int]] = [None] * self.num_slots
@@ -148,6 +169,62 @@ class DecodeEngine:
         self._block_axes = axes
         self._block_specs = specs
 
+    def _make_jitted_fns(self) -> None:
+        """Jit tick + both admit seams.  Unsharded engines let placement
+        follow the (possibly device-pinned) params.  Mesh-aware engines
+        pin EXPLICIT in/out shardings on all three fns: inferred output
+        shardings can differ from the donated input's and force a
+        recompile on the next call, which would break the zero-recompile
+        occupancy invariant the serving tests pin via _cache_size()."""
+        if self.mesh is None:
+            self._tick_fn = jax.jit(self._tick_impl, donate_argnums=(1,))
+            self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(1,))
+            self._admit_cached_fn = jax.jit(
+                self._admit_cached_impl, donate_argnums=(1,)
+            )
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dalle_tpu.parallel import partition
+
+        psh = partition.param_shardings(self.params, self.mesh)
+        ssh = self._state_shardings
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        # prefix blocks mirror the cache leaves' shardings (slicing the
+        # position axis never touches the kv-head axis)
+        cache_sh = jax.tree_util.tree_leaves(
+            ssh.cache, is_leaf=lambda x: isinstance(x, NamedSharding)
+        )
+        blocks_sh = () if self._block_axes is None else list(cache_sh)
+        self._tick_fn = jax.jit(
+            self._tick_impl, donate_argnums=(1,),
+            in_shardings=(psh, ssh), out_shardings=ssh,
+        )
+        self._admit_fn = jax.jit(
+            self._admit_impl, donate_argnums=(1,),
+            in_shardings=(psh, ssh) + (repl,) * 6,
+            out_shardings=(ssh, blocks_sh),
+        )
+        self._admit_cached_fn = jax.jit(
+            self._admit_cached_impl, donate_argnums=(1,),
+            in_shardings=(psh, ssh, blocks_sh) + (repl,) * 6,
+            out_shardings=ssh,
+        )
+
+    def _mesh_ctx(self):
+        """Ambient-mesh context for jitted dispatches: trace-time hooks
+        (overlap.decode_tp_mesh, the fused-decode shard_map wrap,
+        _constrain_activations) consult get_ambient_mesh().  Only the
+        FIRST dispatch of each fn traces, but wrapping every dispatch is
+        cheap and keeps retrace-on-new-shape correct."""
+        if self.mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from dalle_tpu.parallel.mesh import ambient
+
+        return ambient(self.mesh)
+
     # --- device side -----------------------------------------------------
     def _init_state(self) -> EngineState:
         B, S, t = self.num_slots, self.S, self.t
@@ -165,7 +242,9 @@ class DecodeEngine:
             active=jnp.zeros((B,), bool),
             out=jnp.zeros((B, S), jnp.int32),
         )
-        if self.device is not None:
+        if self._state_shardings is not None:
+            state = jax.device_put(state, self._state_shardings)
+        elif self.device is not None:
             state = jax.device_put(state, self.device)
         return state
 
@@ -345,23 +424,24 @@ class DecodeEngine:
         is untouched."""
         B, t = self.num_slots, self.t
         z = np.zeros
-        st, _ = self._admit_fn(
-            self.params, self.state,
-            jnp.asarray(z((B, t), np.int32)),
-            jnp.asarray(z((B, 2), np.uint32)),
-            jnp.ones((B,), jnp.float32), jnp.ones((B,), jnp.float32),
-            jnp.asarray(z((B,), np.int32)), jnp.asarray(z((B,), bool)),
-        )
-        if self.prefix_pool is not None:
-            st = self._admit_cached_fn(
-                self.params, st,
-                [jnp.zeros(s, d) for s, d in self._block_specs],
-                jnp.asarray(z((B,), np.int32)),
+        with self._mesh_ctx():
+            st, _ = self._admit_fn(
+                self.params, self.state,
+                jnp.asarray(z((B, t), np.int32)),
                 jnp.asarray(z((B, 2), np.uint32)),
                 jnp.ones((B,), jnp.float32), jnp.ones((B,), jnp.float32),
                 jnp.asarray(z((B,), np.int32)), jnp.asarray(z((B,), bool)),
             )
-        st = self._tick_fn(self.params, st)
+            if self.prefix_pool is not None:
+                st = self._admit_cached_fn(
+                    self.params, st,
+                    [jnp.zeros(s, d) for s, d in self._block_specs],
+                    jnp.asarray(z((B,), np.int32)),
+                    jnp.asarray(z((B, 2), np.uint32)),
+                    jnp.ones((B,), jnp.float32), jnp.ones((B,), jnp.float32),
+                    jnp.asarray(z((B,), np.int32)), jnp.asarray(z((B,), bool)),
+                )
+            st = self._tick_fn(self.params, st)
         jax.block_until_ready(st.out)
         self.state = self._init_state()
         self.tick_count = 0
@@ -463,11 +543,12 @@ class DecodeEngine:
             src[slot] = i
             take[slot] = True
             self._bind_slot(req, slot, now)
-        self.state, blocks = self._admit_fn(
-            self.params, self.state, jnp.asarray(texts), jnp.asarray(base),
-            jnp.asarray(temps), jnp.asarray(tps), jnp.asarray(src),
-            jnp.asarray(take),
-        )
+        with self._mesh_ctx():
+            self.state, blocks = self._admit_fn(
+                self.params, self.state, jnp.asarray(texts),
+                jnp.asarray(base), jnp.asarray(temps), jnp.asarray(tps),
+                jnp.asarray(src), jnp.asarray(take),
+            )
         if self.prefix_pool is not None:
             host = [np.array(b) for b in blocks]  # one fetch, all rows
             for i, (req, key) in enumerate(misses):
@@ -500,11 +581,12 @@ class DecodeEngine:
             src[slot] = i
             take[slot] = True
             self._bind_slot(req, slot, now)
-        self.state = self._admit_cached_fn(
-            self.params, self.state, [jnp.asarray(b) for b in bufs],
-            jnp.asarray(first), jnp.asarray(base), jnp.asarray(temps),
-            jnp.asarray(tps), jnp.asarray(src), jnp.asarray(take),
-        )
+        with self._mesh_ctx():
+            self.state = self._admit_cached_fn(
+                self.params, self.state, [jnp.asarray(b) for b in bufs],
+                jnp.asarray(first), jnp.asarray(base), jnp.asarray(temps),
+                jnp.asarray(tps), jnp.asarray(src), jnp.asarray(take),
+            )
 
     def step(self) -> List[Request]:
         """One engine tick.  Returns the requests that just completed,
@@ -512,7 +594,8 @@ class DecodeEngine:
         stamped.  Completion ticks are known host-side — the only device
         sync is fetching each finished slot's output row."""
         faults.on_engine_tick()  # injected slow_tick / tick_fail (no-op off)
-        self.state = self._tick_fn(self.params, self.state)
+        with self._mesh_ctx():
+            self.state = self._tick_fn(self.params, self.state)
         self.tick_count += 1
         done = []
         c = self.model.cfg

@@ -6,7 +6,10 @@ slot state, its own jitted tick/admit fns, and its own device (pinned
 via ``jax.device_put``; on CPU, the virtual host devices from
 ``XLA_FLAGS=--xla_force_host_platform_device_count=N``) — driven by N
 :class:`ReplicaWorker` threads pulling from ONE shared
-:class:`RequestQueue` through the :class:`Router`.
+:class:`RequestQueue` through the :class:`Router`.  With ``mesh_tp > 1``
+each replica is instead a contiguous tp-group of devices running a
+TP-sharded engine (docs/SERVING.md §9) — scale-out and scale-up compose,
+partitioned replica-major.
 
 Crash-drain is deterministic: a replica dying (engine fault past its
 budget, or an injected kill) hands its in-flight + stashed requests to
@@ -157,6 +160,7 @@ class Fleet:
         replicas: int = 2,
         num_slots: int = 8,
         devices=None,
+        mesh_tp: int = 1,
         filter_thres: float = 0.9,
         use_top_p: bool = False,
         policy: str = "continuous",
@@ -185,7 +189,33 @@ class Fleet:
         self.metrics = metrics
         if devices is None:
             devices = jax.devices()
-        self.devices = [devices[i % len(devices)] for i in range(replicas)]
+        # scale-out x scale-up (docs/SERVING.md §9): each replica is a
+        # tp-sized device group, partitioned replica-major — replica r
+        # owns the contiguous group [r*tp, (r+1)*tp) and runs a sharded
+        # engine over its own Mesh.  devices= entries may also be
+        # Sharding objects at tp == 1 (jax.device_put accepts either).
+        self.mesh_tp = int(mesh_tp)
+        if self.mesh_tp > 1:
+            need = replicas * self.mesh_tp
+            assert len(devices) >= need, (
+                f"{replicas} replicas x tp={self.mesh_tp} needs {need} "
+                f"devices, have {len(devices)}"
+            )
+            from dalle_tpu.parallel.mesh import make_mesh
+
+            self.meshes = [
+                make_mesh(
+                    dp=1, tp=self.mesh_tp,
+                    devices=devices[r * self.mesh_tp:(r + 1) * self.mesh_tp],
+                )
+                for r in range(replicas)
+            ]
+            self.devices = [None] * replicas
+        else:
+            self.meshes = [None] * replicas
+            self.devices = [
+                devices[i % len(devices)] for i in range(replicas)
+            ]
         self.queue = (
             queue if queue is not None
             else RequestQueue(max_pending=max_pending,
@@ -205,7 +235,7 @@ class Fleet:
                 model, params, num_slots=num_slots,
                 filter_thres=filter_thres, use_top_p=use_top_p,
                 prefix_pool=prefix_pool, replica_id=rid,
-                device=self.devices[rid],
+                device=self.devices[rid], mesh=self.meshes[rid],
             )
             view = ReplicaView(self.router, rid)
             worker = ReplicaWorker(
@@ -334,6 +364,7 @@ def fleet_replay_trace(
     *,
     replicas: int = 2,
     devices=None,
+    mesh_tp: int = 1,
     num_slots: int = 8,
     filter_thres: float = 0.9,
     time_scale: float = 1.0,
@@ -357,7 +388,7 @@ def fleet_replay_trace(
         prefix_pool = PrefixPool(prefix_pool_bytes)
     fleet = Fleet(
         model, params, replicas=replicas, devices=devices,
-        num_slots=num_slots, filter_thres=filter_thres,
+        mesh_tp=mesh_tp, num_slots=num_slots, filter_thres=filter_thres,
         use_top_p=any(it.top_p is not None for it in trace),
         policy=policy, max_pending=max_pending, shed_policy=shed_policy,
         result_cache=result_cache, prefix_pool=prefix_pool,

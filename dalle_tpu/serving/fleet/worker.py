@@ -73,9 +73,19 @@ class ReplicaWorker(Scheduler):
         from dalle_tpu.serving.scheduler import request_stats
 
         eng = self.engine
+        if eng.device is not None:
+            device = str(eng.device)
+        elif getattr(eng, "mesh", None) is not None:
+            # sharded replica: its "device" is a tp-group (docs/SERVING.md
+            # §9) — report the group so fleet stats stay disjoint-readable
+            device = "mesh[" + ",".join(
+                str(d.id) for d in eng.mesh.devices.flat
+            ) + "]"
+        else:
+            device = None
         out = {
             "replica": self.replica_id,
-            "device": str(eng.device) if eng.device is not None else None,
+            "device": device,
             "ticks": eng.tick_count,
             "restarts": self._restarts,
             **request_stats(self.completed, eng.S),

@@ -922,6 +922,8 @@ def replay_trace(
     fingerprint: Optional[str] = None,
     replicas: int = 1,
     devices=None,
+    mesh=None,
+    mesh_tp: int = 1,
     **scheduler_kwargs,
 ) -> dict:
     """Replay a recorded arrival trace against a fresh engine.
@@ -937,13 +939,20 @@ def replay_trace(
     :class:`Scheduler` (degradation, restart budgets, ...).
     ``replicas > 1`` delegates to
     :func:`dalle_tpu.serving.fleet.fleet_replay_trace` — same traffic,
-    N engine replicas behind the fleet router (docs/SERVING.md §8)."""
+    N engine replicas behind the fleet router (docs/SERVING.md §8).
+    ``mesh`` runs the single engine TP-sharded over that Mesh;
+    ``mesh_tp > 1`` with ``replicas > 1`` gives each replica its own
+    replica-major tp-group (docs/SERVING.md §9)."""
     if replicas > 1:
+        assert mesh is None, (
+            "pass mesh_tp= (per-replica tp-groups), not a global mesh, "
+            "when replicas > 1"
+        )
         from dalle_tpu.serving.fleet import fleet_replay_trace
 
         return fleet_replay_trace(
             model, params, trace, replicas=replicas, devices=devices,
-            num_slots=num_slots, filter_thres=filter_thres,
+            mesh_tp=mesh_tp, num_slots=num_slots, filter_thres=filter_thres,
             time_scale=time_scale, policy=policy,
             vae=vae, vae_params=vae_params, clip=clip,
             clip_params=clip_params, max_pending=max_pending,
@@ -960,7 +969,7 @@ def replay_trace(
     engine = DecodeEngine(
         model, params, num_slots=B, filter_thres=filter_thres,
         use_top_p=any(it.top_p is not None for it in trace),
-        prefix_pool=prefix_pool,
+        prefix_pool=prefix_pool, mesh=mesh,
     )
     engine.warmup()
     q = RequestQueue(max_pending=max_pending, shed_policy=shed_policy)

@@ -423,6 +423,62 @@ def dalle_step_ici_bytes(cfg, batch: int, mesh_shape, *,
     return out
 
 
+def decode_tick_ici_bytes(cfg, slots: int, mesh_shape, *,
+                          decode_comm: str = "f32") -> dict:
+    """Analytic per-chip ICI bytes for ONE sharded-engine decode tick at
+    full occupancy — the inter-chip sibling of
+    :func:`decode_tick_attn_bytes`, gating bench.py's ``decode_shard``
+    rung the way that function gates ``decode_speed``.
+
+    The TP tick moves exactly three kinds of bytes (the K/V cache itself
+    never crosses the wire: rows are sharded over kv heads and attention
+    is head-local):
+
+      * per JointAttention layer, ONE all-reduce of the [slots, dim]
+        attention-out partial sums, at the ``decode_comm`` wire width
+        (``GRAD_COMM_BYTES``: the decode collectives reuse the same
+        per-256-bucket int8 scale format, parallel/compress.py);
+      * per layer (every layer has an FF), ONE all-reduce of the
+        [slots, dim] FF-down partial sums, same width;
+      * 'mlp' (gMLP/CausalSGU) attention sublayers stay on the dense
+        GSPMD path — their proj_out all-reduce is costed at f32;
+      * once per tick, the image-vocab logits all-gather for the head
+        ((tp-1)/tp * slots * num_image_tokens * 4): sampling reads exact
+        f32 logits, never quantized.
+
+    Ring lower bounds as everywhere in this module: all-reduce of B bytes
+    = ``2*(P-1)/P * B``, all-gather = ``(P-1)/P * B``.  The f32 mode
+    prices activations at 4 B/elem (the engine decodes f32 — the
+    collective-matmul ring decomposition moves the same bytes as the
+    baseline all-reduce).  Returns ``{layers, head, total}``; all zeros
+    at tp == 1 (nothing crosses a chip).
+    """
+    if decode_comm not in GRAD_COMM_BYTES:
+        raise ValueError(
+            f"decode_comm must be one of {sorted(GRAD_COMM_BYTES)}, "
+            f"got {decode_comm!r}")
+    sz = _mesh_axis_sizes(mesh_shape)
+    tp = sz.get("tp", 1)
+    if tp <= 1:
+        return {"layers": 0.0, "head": 0.0, "total": 0.0}
+    w = GRAD_COMM_BYTES[decode_comm]
+    ar = 2.0 * (tp - 1) / tp
+    attn_layers = sum(
+        1 for i in range(cfg.depth)
+        if cfg.attn_types[i % len(cfg.attn_types)] != "mlp"
+    )
+    mlp_layers = cfg.depth - attn_layers
+    quant_ars = attn_layers + cfg.depth   # attn-out + every layer's FF
+    f32_ars = mlp_layers                  # CausalSGU proj_out stays dense
+    layers = ar * slots * cfg.dim * (quant_ars * w + f32_ars * 4.0)
+    head = (tp - 1) / tp * slots * cfg.num_image_tokens * 4.0
+    return {
+        "layers": float(layers),
+        "head": float(head),
+        "total": float(layers + head),
+    }
+
+
 def dalle_step_comm_time(cfg, batch: int, mesh_shape, *,
                          grad_comm: str = "f32",
                          tp_overlap: bool = False,

@@ -114,6 +114,7 @@ def validate_serve_flags(args) -> list:
         )
     if args.replicas < 1:
         errors.append(f"--replicas must be >= 1, got {args.replicas}")
+    tp = args.mesh_tp or 1
     if args.replicas > 1:
         if args.serve_policy != "continuous":
             errors.append(
@@ -121,14 +122,35 @@ def validate_serve_flags(args) -> list:
                 f"continuous (got {args.serve_policy}; sequential/"
                 "full_batch are single-engine batching experiments)"
             )
-        from dalle_tpu.parallel.mesh import mesh_kwargs_from_args
-
-        if mesh_kwargs_from_args(args):
+        # scale-out x scale-up composition (docs/SERVING.md §9): each
+        # replica is a tp-group of devices, partitioned replica-major —
+        # replica r owns devices [r*tp, (r+1)*tp).  Only the tp axis
+        # composes; the other mesh axes have no per-replica meaning.
+        bad_axes = [
+            ax for ax in ("dp", "fsdp", "sp", "pp", "ep")
+            if (getattr(args, f"mesh_{ax}") or 1) != 1
+        ]
+        if bad_axes:
             errors.append(
-                "--replicas (scale-OUT: N independent engine replicas) "
-                "does not compose with --mesh_* (scale-UP: one sharded "
-                "engine) yet — pick one (docs/SERVING.md §8)"
+                f"--replicas composes only with --mesh_tp (replica-major "
+                f"tp groups, docs/SERVING.md §9) — drop "
+                + ", ".join(f"--mesh_{ax}" for ax in bad_axes)
             )
+        if tp > 1:
+            import jax as _jax
+
+            have = len(_jax.devices())
+            if args.replicas * tp > have:
+                errors.append(
+                    f"--replicas {args.replicas} x --mesh_tp {tp} needs "
+                    f"{args.replicas * tp} devices, have {have}"
+                )
+    if args.decode_comm != "f32" and tp < 2:
+        errors.append(
+            f"--decode_comm {args.decode_comm} requires --mesh_tp >= 2 "
+            "(the quantized decode collectives ride the tp all-reduce; "
+            "docs/SERVING.md §9)"
+        )
     return errors
 
 
@@ -152,10 +174,13 @@ def parse_args(argv=None):
     parser.add_argument("--replicas", type=int, default=1,
                         help="N > 1: serve with a fleet of N engine "
                              "replicas behind a load-balancing router — "
-                             "each replica on its own device, crashed "
-                             "replicas drain onto survivors "
+                             "crashed replicas drain onto survivors "
                              "(docs/SERVING.md §8; scale-out, vs "
-                             "--mesh_* scale-up)")
+                             "--mesh_* scale-up).  Composes with "
+                             "--mesh_tp T: devices are partitioned "
+                             "replica-major, replica r owning the "
+                             "contiguous tp-group [r*T, (r+1)*T); other "
+                             "--mesh_* axes do not compose")
     parser.add_argument("--serve_policy", type=str, default="continuous",
                         choices=("continuous", "full_batch", "sequential"),
                         help="admission policy (sequential/full_batch exist "
@@ -280,6 +305,17 @@ def parse_args(argv=None):
                              "checkpoint works; off-TPU a bitwise-equal "
                              "lax fallback runs.  Composes with --serve, "
                              "--int8, --kv_int8")
+    parser.add_argument("--decode_comm", type=str, default="f32",
+                        choices=("f32", "bf16", "int8"),
+                        help="with --serve --mesh_tp >= 2: wire width of the "
+                             "per-tick TP collectives (EQuARX-style; "
+                             "parallel/compress.py).  f32 = overlapped "
+                             "collective-matmul rings at full width; "
+                             "bf16/int8 = deterministic bucket-scale "
+                             "quantized all-reduce on the attention-out and "
+                             "FF projections (int8 cuts modeled per-tick "
+                             "ICI bytes >= 40%).  Compute policy: no param "
+                             "change, any checkpoint works")
     # sharded inference (beyond-reference: the reference generates on one
     # GPU only, generate.py:93-95): shard params over a device mesh and run
     # the scan decode under it — needed for models too big for one chip
@@ -295,6 +331,10 @@ def main(argv=None):
     args = parse_args(argv)
     assert args.text is not None or args.serve, (
         "pass --text PROMPTS or --serve STREAM"
+    )
+    assert args.serve or args.decode_comm == "f32", (
+        "--decode_comm is a serving lever (--serve with --mesh_tp >= 2); "
+        "batch generation keeps the dense GSPMD decode"
     )
     if args.serve:
         assert not args.gentxt and not args.prime_image, (
@@ -544,8 +584,20 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
     from dalle_tpu.serving import DecodeEngine, Request, RequestQueue, Scheduler
 
     mesh_kw = mesh_kwargs_from_args(args)
+    mesh = None
+    tp = mesh_kw.get("tp", 1) if mesh_kw else 1
+    if tp > 1:
+        # sharded decode (docs/SERVING.md §9): set the per-tick TP
+        # collective mode on the model before any engine is built — it is
+        # a compute policy, so params are untouched and the checkpoint
+        # fingerprint (output-changing config only) is unaffected by f32
+        from dalle_tpu.models.quantize import decode_comm_model
+
+        model = decode_comm_model(model, args.decode_comm)
+        print(f"decode collectives: tp={tp} wire={args.decode_comm} "
+              "(parallel/compress.py)")
     stack = contextlib.ExitStack()
-    if mesh_kw:
+    if mesh_kw and args.replicas == 1:
         from dalle_tpu.parallel import make_mesh
         from dalle_tpu.parallel.mesh import ambient
         from dalle_tpu.parallel.partition import shard_params
@@ -641,14 +693,14 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                 fingerprint=fingerprint, queue=req_queue,
                 vae=vae, vae_params=vae_params, clip=clip,
                 clip_params=clip_params, on_result=on_result,
-                degrade=args.degrade,
+                degrade=args.degrade, mesh_tp=tp,
             )
             server.warmup()
         else:
             engine = DecodeEngine(
                 model, params, num_slots=args.serve_slots,
                 filter_thres=args.top_k, use_top_p=args.top_p is not None,
-                prefix_pool=prefix_pool,
+                prefix_pool=prefix_pool, mesh=mesh,
             )
             engine.warmup()
             server = Scheduler(

@@ -1,0 +1,283 @@
+"""Sharded decode tests (docs/SERVING.md §9): TP-partitioned engine
+state + quantized decode collectives.
+
+Three pinned layers:
+
+1. **Mesh transparency** — a DecodeEngine built over a 1-device mesh is
+   BITWISE the unsharded engine (greedy and sampled, kv_int8 and
+   fused_decode included), and occupancy churn still reuses ONE compiled
+   tick/admit/pooled-admit.  Sharding is a placement decision, never a
+   numerics decision.
+2. **tp=2 parity** — on the 8 virtual host devices (conftest), a tp=2
+   engine with ``decode_comm`` f32 reproduces the unsharded codes
+   exactly (the collective-matmul rings move full-width activations);
+   bf16/int8 quantized all-reduces reproduce the greedy trajectory on
+   the test model (argmax is robust to the bucket-scale rounding).
+3. **Analytic ICI bytes** — ``decode_tick_ici_bytes`` restated by hand
+   from the ring identities (all-reduce = 2(P-1)/P·B, all-gather =
+   (P-1)/P·B), mirroring test_comms_model.py: the int8 wire width cuts
+   per-tick layer bytes enough to clear the decode_shard rung's >= 40%
+   gate at the flagship shape.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.generate import generate_image_codes
+from dalle_tpu.models.quantize import (
+    decode_comm_model,
+    fused_decode_model,
+    kv_int8_model,
+)
+from dalle_tpu.parallel.mesh import axis_sizes, make_mesh
+from dalle_tpu.serving import DecodeEngine, PrefixPool, Request
+from dalle_tpu.training.profiler import decode_tick_ici_bytes
+
+T, F = 4, 2
+
+
+def build(rng, *, kv_int8=False, fused=False, **kw):
+    kw.setdefault("image_fmap_size", F)
+    cfg = DALLEConfig(
+        num_text_tokens=30,
+        text_seq_len=T,
+        num_image_tokens=20,
+        dim=32,
+        depth=2,
+        heads=2,
+        dim_head=16,
+        **kw,
+    )
+    text = jax.random.randint(rng, (3, T), 1, 30)
+    codes = jax.random.randint(rng, (3, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    if kv_int8:
+        model = kv_int8_model(model)
+    if fused:
+        model = fused_decode_model(model)
+    return model, params
+
+
+def _requests(n, *, seed0=100, temperature=1e-8, top_p=None):
+    texts = np.random.RandomState(0).randint(1, 30, size=(n, T))
+    return [
+        Request(text_tokens=texts[i], seed=seed0 + i,
+                temperature=temperature, top_p=top_p, request_id=f"r{i}")
+        for i in range(n)
+    ]
+
+
+def _drain(engine, reqs, *, stagger_at=2):
+    """Admit 2, stagger the rest in as slots free — occupancy churn by
+    construction.  Returns codes keyed by request id."""
+    pending = list(reqs)
+    engine.warmup()
+    engine.admit([pending.pop(0), pending.pop(0)])
+    while pending or engine.num_active:
+        if engine.tick_count >= stagger_at and pending:
+            free = engine.free_slots()
+            take = min(len(free), len(pending))
+            if take:
+                engine.admit([pending.pop(0) for _ in range(take)])
+        engine.step()
+    return {r.request_id: np.asarray(r.codes) for r in reqs}
+
+
+# --- 1. one-device mesh is bitwise the unsharded engine -----------------
+
+
+VARIANTS = {
+    "plain": dict(),
+    "kv_int8": dict(kv_int8=True),
+    "fused": dict(fused=True),
+    "fused_kv_int8": dict(kv_int8=True, fused=True),
+}
+
+
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "sampled"])
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_one_device_mesh_bitwise(rng, devices, variant, sampled):
+    model, params = build(rng, **VARIANTS[variant])
+    temperature = 1.0 if sampled else 1e-8
+    thres = 0.9 if sampled else 0.0
+    reqs = 4
+
+    base = _drain(
+        DecodeEngine(model, params, num_slots=3, filter_thres=thres),
+        _requests(reqs, temperature=temperature),
+    )
+    mesh = make_mesh(dp=1, tp=1, devices=jax.devices()[:1])
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=thres,
+                          mesh=mesh)
+    sharded = _drain(engine, _requests(reqs, temperature=temperature))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: 1-device mesh != unsharded "
+                    f"({variant}, sampled={sampled})",
+        )
+    # occupancy churn over a mesh reuses the same compiled fns
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+
+
+def test_engine_rejects_device_and_mesh(rng):
+    model, params = build(rng)
+    mesh = make_mesh(dp=1, tp=1, devices=jax.devices()[:1])
+    with pytest.raises(AssertionError):
+        DecodeEngine(model, params, num_slots=2,
+                     device=jax.devices()[0], mesh=mesh)
+
+
+# --- 2. tp=2 parity on virtual host devices -----------------------------
+
+
+@pytest.mark.parametrize("variant", ["plain", "kv_int8", "fused_kv_int8"])
+@pytest.mark.parametrize("mode", ["f32", "bf16", "int8"])
+def test_tp2_parity(rng, devices, mode, variant):
+    """tp=2 over 2 virtual CPU devices: f32 rings are sampled-exact;
+    bf16/int8 quantized all-reduces keep the greedy trajectory (and ARE
+    deterministic — round-to-nearest, never stochastic)."""
+    model, params = build(rng, **VARIANTS[variant])
+    sampled = mode == "f32"
+    temperature = 1.0 if sampled else 1e-8
+    thres = 0.9 if sampled else 0.0
+
+    base = _drain(
+        DecodeEngine(model, params, num_slots=4, filter_thres=thres),
+        _requests(4, temperature=temperature),
+    )
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    engine = DecodeEngine(decode_comm_model(model, mode), params,
+                          num_slots=4, filter_thres=thres, mesh=mesh)
+    sharded = _drain(engine, _requests(4, temperature=temperature))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: tp=2 {mode} != unsharded ({variant})",
+        )
+    assert engine._tick_fn._cache_size() == 1
+
+
+def test_tp2_solo_exactness(rng, devices):
+    """The serving exactness contract survives sharding: a request
+    decoded by a tp=2 engine mid-churn is bitwise `generate_image_codes`
+    run solo (unsharded) with the same seed."""
+    model, params = build(rng)
+    reqs = _requests(4, temperature=1.0)
+    expected = {
+        r.request_id: np.asarray(generate_image_codes(
+            model, params, r.text_tokens[None], jax.random.PRNGKey(r.seed),
+            filter_thres=0.9, temperature=1.0,
+        )[0])
+        for r in reqs
+    }
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    engine = DecodeEngine(decode_comm_model(model, "f32"), params,
+                          num_slots=3, filter_thres=0.9, mesh=mesh)
+    got = _drain(engine, reqs)
+    for rid, want in expected.items():
+        np.testing.assert_array_equal(
+            want, got[rid], err_msg=f"{rid}: tp=2 engine != solo decode"
+        )
+
+
+def test_tp2_no_recompile_with_prefix_pool(rng, devices):
+    """All three jitted admit/tick seams stay single-entry over a tp=2
+    mesh: plain prefill admits, pooled (zero-prefill) admits, and ticks
+    across occupancy churn.  The pool exports/imports sharded cache rows
+    without forcing a second compile."""
+    model, params = build(rng)
+    texts = np.random.RandomState(1).randint(1, 30, size=(2, T))
+
+    def mk(t, s):
+        return Request(text_tokens=texts[t], seed=s, temperature=1e-8,
+                       request_id=f"t{t}s{s}")
+
+    spec = [(0, 1), (1, 2), (0, 5), (1, 6)]  # 2 texts x 2 seeds
+
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    engine = DecodeEngine(decode_comm_model(model, "int8"), params,
+                          num_slots=3, filter_thres=0.0, mesh=mesh,
+                          prefix_pool=PrefixPool(1 << 20))
+    _drain(engine, [mk(*s) for s in spec])
+    assert engine.prefill_requests == 2 and engine.prefix_reuses == 2
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+    assert engine._admit_cached_fn._cache_size() == 1
+
+
+# --- 3. analytic per-tick ICI bytes -------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(
+        num_text_tokens=2000, text_seq_len=32, num_image_tokens=1024,
+        image_fmap_size=8, dim=64, depth=4, heads=4, dim_head=16,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def test_decode_tick_bytes_closed_form():
+    """depth=4, attn_types cycling (full, mlp): 2 attention layers emit a
+    quantized attn-out AR each, all 4 layers a quantized FF AR, the 2
+    gMLP sublayers a dense f32 AR; the head all-gathers f32 logits."""
+    cfg = _cfg(attn_types=("full", "mlp"))
+    slots, tp = 8, 2
+    b = decode_tick_ici_bytes(cfg, slots, {"tp": tp}, decode_comm="int8")
+    ar = 2 * (tp - 1) / tp          # ring all-reduce per-chip factor
+    w = 1 + 4 / 256                 # int8 payload + per-256-bucket scale
+    quant = (2 + 4) * ar * slots * cfg.dim * w
+    dense = 2 * ar * slots * cfg.dim * 4.0
+    head = (tp - 1) / tp * slots * cfg.num_image_tokens * 4.0
+    assert b["layers"] == pytest.approx(quant + dense, rel=1e-12)
+    assert b["head"] == pytest.approx(head, rel=1e-12)
+    assert b["total"] == pytest.approx(quant + dense + head, rel=1e-12)
+
+
+def test_decode_tick_bytes_f32_width():
+    cfg = _cfg()  # all-full: every layer pays attn-out + FF ARs
+    b = decode_tick_ici_bytes(cfg, 4, {"tp": 4}, decode_comm="f32")
+    ar = 2 * 3 / 4
+    layers = (4 + 4) * ar * 4 * cfg.dim * 4.0
+    head = 3 / 4 * 4 * cfg.num_image_tokens * 4.0
+    assert b["layers"] == pytest.approx(layers, rel=1e-12)
+    assert b["total"] == pytest.approx(layers + head, rel=1e-12)
+
+
+def test_decode_tick_bytes_int8_cuts_40pct_at_flagship():
+    """The decode_shard rung's gate, restated: at the flagship serving
+    shape the int8 wire cuts TOTAL per-tick bytes (head included) by
+    >= 40% vs f32."""
+    cfg = _cfg(dim=1024, depth=24, heads=16, dim_head=64,
+               num_image_tokens=8192, image_fmap_size=16)
+    f32 = decode_tick_ici_bytes(cfg, 8, {"tp": 2}, decode_comm="f32")
+    i8 = decode_tick_ici_bytes(cfg, 8, {"tp": 2}, decode_comm="int8")
+    cut = 1.0 - i8["total"] / f32["total"]
+    assert cut >= 0.4, f"int8 byte cut {cut:.3f} < 0.40"
+    # bf16 sits strictly between
+    b16 = decode_tick_ici_bytes(cfg, 8, {"tp": 2}, decode_comm="bf16")
+    assert i8["total"] < b16["total"] < f32["total"]
+
+
+def test_decode_tick_bytes_tp1_zero_and_bad_mode():
+    cfg = _cfg()
+    assert decode_tick_ici_bytes(cfg, 8, {"dp": 8}) == {
+        "layers": 0.0, "head": 0.0, "total": 0.0,
+    }
+    with pytest.raises(ValueError):
+        decode_tick_ici_bytes(cfg, 8, {"tp": 2}, decode_comm="fp8")
+
+
+def test_decode_tick_bytes_mesh_object_matches_dict(devices):
+    cfg = _cfg()
+    mesh = make_mesh(dp=2, tp=4)
+    as_mesh = decode_tick_ici_bytes(cfg, 8, mesh, decode_comm="int8")
+    as_dict = decode_tick_ici_bytes(cfg, 8, axis_sizes(mesh),
+                                    decode_comm="int8")
+    assert as_mesh == as_dict

@@ -53,6 +53,11 @@ CASES = [
     # the decode-tick kernel: one query row per slot over an int8 KV cache
     # (ops/flash.py flash_decode_attention; the --fused_decode hot path)
     ("decode_int8_1280", 1280, 64, "bfloat16", False, False),
+    # the SHARDED decode tick (docs/SERVING.md §9): the same kernel
+    # shard_mapped over a tp=2 mesh's kv-head axis + the int8-quantized
+    # attention-out all-reduce (parallel/compress.py) — the TP engine's
+    # exact per-tick hot path, collectives included
+    ("shard_tick_int8_1280", 1280, 64, "bfloat16", False, False),
     ("causal_bf16_4096", 4096, 64, "bfloat16", False, False),  # VQGAN-f8 scale
 ]
 
@@ -224,6 +229,94 @@ def _run_decode_case(name: str) -> dict:
     }
 
 
+def _run_shard_case(name: str) -> dict:
+    """The sharded decode tick: flash_decode_attention shard_mapped over
+    a tp=2 mesh's kv-head axis, feeding the int8-quantized attention-out
+    all-reduce (parallel/compress.py decode_matmul_allreduce) — the TP
+    engine's per-tick hot path with its collective, in one jit.  Fwd-only
+    like the decode case; on CPU two virtual host devices are forced."""
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        # must land before jax initializes; shapes only the host platform
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        )
+    jax, jnp, import_s = _import_jax_for_probe()
+
+    from jax.sharding import PartitionSpec as P
+
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops.flash import flash_decode_attention
+    from dalle_tpu.ops.quant import dequantize_rows, quantize_rows
+    from dalle_tpu.parallel.compress import decode_matmul_allreduce
+    from dalle_tpu.parallel.mesh import make_mesh, shard_map
+
+    platform = jax.default_backend()
+    n, d = next((n_, d_) for nm, n_, d_, *_ in CASES if nm == name)
+    if len(jax.devices()) < 2:
+        return {"case": name, "platform": platform,
+                "error": "needs >= 2 devices for the tp=2 mesh"}
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    b, kv, g = 8, 8, 1
+    dim = kv * g * d
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, kv, g, d), jnp.bfloat16)
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, kv, n, d))
+    kq, ks = quantize_rows(kc)
+    vq, vs = quantize_rows(vc)
+    pos = jnp.arange(b, dtype=jnp.int32) * ((n - 1) // (b - 1))
+    w = jax.random.normal(jax.random.fold_in(key, 3), (dim, dim)) * 0.05
+    bias = jax.random.normal(jax.random.fold_in(key, 4), (dim,)) * 0.05
+    # dense mask rows ride along for the off-TPU lax fallback (the kernel
+    # rebuilds the same geometry from pos) — exactly the engine's call
+    mask = (jnp.arange(n)[None, :] <= pos[:, None])[:, None, None, :]
+
+    hs = P(None, "tp", None, None)
+    attn = shard_map(
+        lambda q_, kq_, ks_, vq_, vs_, pos_, m_: flash_decode_attention(
+            q_, kq_, vq_, pos_, k_scale=ks_, v_scale=vs_, mask=m_),
+        mesh=mesh,
+        in_specs=(hs, hs, hs, hs, hs, P(None), P(None, None, None, None)),
+        out_specs=hs, check_vma=False,
+    )
+
+    def tick(q_):
+        o = attn(q_, kq, ks, vq, vs, pos, mask)
+        o = o.reshape(b, dim).astype(jnp.float32)
+        return decode_matmul_allreduce(o, w, bias, mode="int8", mesh=mesh)
+
+    fn = jax.jit(tick)
+    t0 = time.perf_counter()
+    out = fn(q)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(q)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+
+    o_ref = A._sdpa(q, dequantize_rows(kq, ks), dequantize_rows(vq, vs),
+                    mask)
+    want = o_ref.reshape(b, dim).astype(jnp.float32) @ w + bias
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - want)))
+    ref_scale = float(jnp.max(jnp.abs(want)))
+    return {
+        "case": name, "slots": b, "kv_heads": kv, "n": n, "d": d,
+        "tp": 2, "decode_comm": "int8", "dtype": "bfloat16",
+        "platform": platform, "interpret": platform != "tpu",
+        "import_s": round(import_s, 1),
+        "fwd_compile_s": round(compile_s, 2),
+        "fwd_ms": round(ms, 3),
+        "fwd_max_err": round(err, 6),
+        # headroom for the kernel's bf16 accumulation PLUS the two int8
+        # bucket-quantized partial sums the all-reduce rounds
+        "numerics_ok": bool(err < 0.05 * max(ref_scale, 1.0)),
+    }
+
+
 def run_case(name: str) -> dict:
     """Child entry: compile+run fwd and bwd for one case, check numerics."""
     if name.startswith("dequant_int8"):
@@ -232,6 +325,8 @@ def run_case(name: str) -> dict:
         return _run_lse_case(name)
     if name.startswith("decode_int8"):
         return _run_decode_case(name)
+    if name.startswith("shard_tick"):
+        return _run_shard_case(name)
     n, d, dtype_name, sparse, masked = next(
         (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
     )

@@ -18,6 +18,10 @@ identical traffic — pick the smallest B whose p99 meets your SLO.
     python tools/serving_bench.py --dalle_path ckpt/ \
         --trace prod_trace.jsonl --slots 8,16 --policy continuous
 
+    # sweep the sharded-decode levers: tp degree x collective wire width
+    python tools/serving_bench.py --quick --synth 16 --slots 4 \
+        --mesh_tp 1,2 --decode_comm f32,int8
+
 ``--quick`` runs a tiny randomly-initialized model (no checkpoint) —
 arrival *pattern* effects (queueing, admission stalls) reproduce fine at
 toy scale; absolute tokens/s obviously does not transfer.  Runs on
@@ -82,6 +86,17 @@ def parse_args(argv=None):
                          "CPU the virtual host devices are forced "
                          "automatically.  Fleet combinations require "
                          "the continuous policy")
+    ap.add_argument("--mesh_tp", type=str, default="1",
+                    help="comma-separated tp degrees to sweep "
+                         "(docs/SERVING.md §9); T>1 replays through a "
+                         "TP-sharded engine (one Mesh per replica, "
+                         "replica-major device groups).  On CPU the "
+                         "virtual host devices are forced automatically")
+    ap.add_argument("--decode_comm", type=str, default="f32",
+                    help="comma-separated wire widths for the per-tick TP "
+                         "collectives (f32,bf16,int8; parallel/"
+                         "compress.py).  bf16/int8 combinations only run "
+                         "at mesh_tp > 1")
     ap.add_argument("--policy", type=str, default="continuous",
                     help="comma-separated subset of "
                          "sequential,full_batch,continuous (or 'all')")
@@ -124,7 +139,10 @@ def main(argv=None):
     args = parse_args(argv)
 
     replica_counts = [int(r) for r in args.replicas.split(",")]
-    if (max(replica_counts) > 1
+    tp_degrees = [int(t) for t in args.mesh_tp.split(",")]
+    comm_modes = args.decode_comm.split(",")
+    need_devices = max(replica_counts) * max(tp_degrees)
+    if (need_devices > 1
             and "host_platform_device_count" not in
             os.environ.get("XLA_FLAGS", "")):
         # must land before the backend initializes; only affects the
@@ -132,7 +150,7 @@ def main(argv=None):
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count"
-              f"={max(replica_counts)}"
+              f"={need_devices}"
         )
 
     import jax
@@ -200,14 +218,29 @@ def main(argv=None):
     if args.prefix_pool_bytes > 0:
         cache_kw["prefix_pool_bytes"] = args.prefix_pool_bytes
 
-    def run(policy, slots, cached, replicas=1):
+    def run(policy, slots, cached, replicas=1, tp=1, comm="f32"):
         codes = {}
         kw = dict(cache_kw) if cached else {}
         if cached and not kw:  # --compare_cache with no explicit budgets
             kw = {"result_cache_bytes": 16 << 20,
                   "prefix_pool_bytes": 16 << 20}
+        m = model
+        if tp > 1:
+            # sharded decode (docs/SERVING.md §9): set the collective
+            # wire width on the model, then shard over a tp mesh —
+            # per-replica (mesh_tp=) under a fleet, one global mesh else
+            from dalle_tpu.models.quantize import decode_comm_model
+
+            m = decode_comm_model(model, comm)
+            if replicas > 1:
+                kw["mesh_tp"] = tp
+            else:
+                from dalle_tpu.parallel.mesh import make_mesh
+
+                kw["mesh"] = make_mesh(dp=1, tp=tp,
+                                       devices=jax.devices()[:tp])
         stats = replay_trace(
-            model, params, trace, policy=policy, num_slots=slots,
+            m, params, trace, policy=policy, num_slots=slots,
             filter_thres=args.filter_thres, time_scale=args.time_scale,
             replicas=replicas,
             on_result=lambda r: (
@@ -226,11 +259,25 @@ def main(argv=None):
                 for replicas in replica_counts:
                     if replicas > 1 and policy != "continuous":
                         continue  # fleet serving is continuous-only
-                    stats, _ = run(policy, slots, cached=bool(cache_kw),
-                                   replicas=replicas)
-                    stats.pop("per_replica", None)
-                    stats["replicas"] = replicas
-                    print(json.dumps(stats))
+                    for tp in tp_degrees:
+                        if tp > 1 and policy != "continuous":
+                            continue  # sharded engine sweeps the lever
+                        for comm in comm_modes:
+                            if comm != "f32" and tp == 1:
+                                continue  # quantized AR needs tp > 1
+                            if tp == 1 and comm != comm_modes[0]:
+                                continue  # unsharded row printed once
+                            stats, _ = run(
+                                policy, slots, cached=bool(cache_kw),
+                                replicas=replicas, tp=tp, comm=comm,
+                            )
+                            stats.pop("per_replica", None)
+                            stats["replicas"] = replicas
+                            stats["mesh_tp"] = tp
+                            stats["decode_comm"] = (
+                                comm if tp > 1 else None
+                            )
+                            print(json.dumps(stats))
                 continue
             # cached vs uncached over the SAME trace: the cached pass
             # must produce bitwise-identical codes while paying device
