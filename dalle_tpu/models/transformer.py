@@ -623,20 +623,100 @@ class FeedForward(nn.Module):
         return self.wo(y)
 
 
+def _decode_mesh_axes(c):
+    """Trace-time (tp, sp) the decode cache path can actually use, from
+    the ambient mesh (serving/engine.py wraps every jitted dispatch in
+    ``mesh.ambient``): tp needs kv heads to divide, sp needs the total
+    sequence to divide.  (1, 1) with no mesh — the flag-off path."""
+    from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+    mesh = get_ambient_mesh()
+    if mesh is None:
+        return 1, 1
+    tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
+    if c.num_kv_heads % tp != 0:
+        tp = 1
+    if c.seq_len % sp != 0:
+        sp = 1
+    return tp, sp
+
+
+def _decode_sp(c) -> int:
+    """The ambient sp factor for decode cache layout (0 hops at 1)."""
+    return _decode_mesh_axes(c)[1]
+
+
+def _sp_storage_tables(c, sp):
+    """(s_of_g, g_of_s) int32 numpy tables for the cyclic balanced
+    storage layout at this (seq_len, sp) — see
+    partition.seq_storage_layout."""
+    from dalle_tpu.parallel.partition import seq_storage_layout
+
+    return seq_storage_layout(c.seq_len, sp)
+
+
+def _sp_flash_decode(c, qg, cache, pos_vec, tp, sp):
+    """Seq-sharded decode read (docs/SERVING.md §10): shard_map over
+    ('tp', 'sp') — each device runs ``flash_decode_attention`` on its
+    local kv heads x locally-resident cache rows only, then the sp axis
+    merges with ONE cross-shard softmax combine.  Under the cyclic
+    storage layout local row ``j`` of sp-shard ``r`` holds global
+    position ``j*sp + r``, so the shard-local attended length is
+    ``floor((pos - r) / sp)`` — negative (all rows masked) on shards
+    that don't yet own a row of a young slot, which the kernel/fallback
+    emit as the combine's zero-weight identity."""
+    from dalle_tpu.parallel.mesh import get_ambient_mesh
+    from dalle_tpu.parallel.mesh import shard_map as _smap
+    from jax.sharding import PartitionSpec as _P
+
+    mesh = get_ambient_mesh()
+    tp_ax = "tp" if tp > 1 else None
+    hs = _P(None, tp_ax, None, None)
+    ks = _P(None, tp_ax, "sp", None)
+    quant = "k_scale" in cache
+
+    def body(*args):
+        if quant:
+            q, k, v, kscale, vscale, p = args
+        else:
+            q, k, v, p = args
+            kscale = vscale = None
+        r = jax.lax.axis_index("sp")
+        pos_loc = jnp.floor_divide(p - r, sp)
+        out, m, l = flash_ops.flash_decode_attention(
+            q, k, v, pos_loc, k_scale=kscale, v_scale=vscale,
+            return_stats=True,
+        )
+        return flash_ops.decode_softmax_combine(out, m, l, "sp")
+
+    in_specs = (hs, ks, ks) + ((ks, ks) if quant else ()) + (_P(None),)
+    fn = _smap(body, mesh=mesh, in_specs=in_specs, out_specs=hs,
+               check_vma=False)
+    args = (qg, cache["k"], cache["v"])
+    if quant:
+        args += (cache["k_scale"], cache["v_scale"])
+    return fn(*args, pos_vec)
+
+
 def _sharded_flash_decode(c, qg, cache, pos_vec, mask):
-    """``flash_decode_attention`` under an ambient tp>1 mesh: the Pallas
-    kernel is not GSPMD-partitionable, but the decode read is exactly
-    per-(slot, kv-head) independent — so shard_map it over the kv-head
-    axis (q groups, K/V rows, and int8 scales all carry kv on axis 1) and
-    each device runs the kernel on its local heads.  At tp == 1 (or kv
-    heads not divisible) the call is unwrapped and bitwise-identical to
-    the flag-off path."""
+    """``flash_decode_attention`` under an ambient tp>1 and/or sp>1 mesh:
+    the Pallas kernel is not GSPMD-partitionable, but the decode read is
+    exactly per-(slot, kv-head) independent — so shard_map it over the
+    kv-head axis (q groups, K/V rows, and int8 scales all carry kv on
+    axis 1) and each device runs the kernel on its local heads.  An sp>1
+    mesh additionally splits the cache rows themselves
+    (:func:`_sp_flash_decode`).  At tp == sp == 1 (or axes not
+    divisible) the call is unwrapped and bitwise-identical to the
+    flag-off path."""
     from dalle_tpu.parallel.mesh import get_ambient_mesh
     from dalle_tpu.parallel.mesh import shard_map as _smap
 
     mesh = get_ambient_mesh()
-    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
-    if tp <= 1 or c.num_kv_heads % tp != 0:
+    tp, sp = _decode_mesh_axes(c)
+    if sp > 1:
+        return _sp_flash_decode(c, qg, cache, pos_vec, tp, sp)
+    if tp <= 1:
         return flash_ops.flash_decode_attention(
             qg, cache["k"], cache["v"], pos_vec,
             k_scale=cache.get("k_scale"), v_scale=cache.get("v_scale"),
@@ -961,9 +1041,18 @@ class JointAttention(nn.Module):
         """Write k/v [b,h,L,d] into the cache at position ``idx`` (int8
         rows + scales under kv_int8, plain ``c.dtype`` otherwise).  A [b]
         ``idx`` vector writes each lane's single row (L == 1) at its own
-        position — the serving engine's staggered-slot layout."""
+        position — the serving engine's staggered-slot layout.
+
+        Under an ambient sp>1 mesh the K/V leaves live in the cyclic
+        balanced storage order (partition.seq_storage_layout): position
+        ``idx`` is rewritten to its storage index here, and the L>1
+        prefill write becomes a static-table scatter.  At sp == 1 every
+        branch below is untouched — bitwise the flag-off path."""
         c = self.cfg
+        sp = _decode_sp(c)
         if jnp.ndim(idx) == 1:  # per-slot positions: scatter one row per lane
+            if sp > 1:  # storage index of each lane's position
+                idx = (idx % sp) * (c.seq_len // sp) + idx // sp
             bi = jnp.arange(k.shape[0])
             if c.kv_int8:
                 from dalle_tpu.ops.quant import quantize_rows
@@ -982,7 +1071,26 @@ class JointAttention(nn.Module):
                 "k": cache["k"].at[bi, :, idx].set(k.astype(c.dtype)[:, :, 0]),
                 "v": cache["v"].at[bi, :, idx].set(v.astype(c.dtype)[:, :, 0]),
             }
-        upd = jax.lax.dynamic_update_slice_in_dim
+        L = k.shape[2]
+        if sp > 1:
+            if L == 1:  # scalar decode step: one row at its storage index
+                idx = (idx % sp) * (c.seq_len // sp) + idx // sp
+            else:  # prefill: L rows from a STATIC offset -> table scatter
+                assert isinstance(idx, (int, np.integer)), (
+                    "sp>1 multi-row cache store needs a static offset "
+                    f"(prefill), got traced idx for L={L}"
+                )
+                tbl = jnp.asarray(_sp_storage_tables(self.cfg, sp)[0][
+                    int(idx):int(idx) + L
+                ])
+
+                def upd(leaf, rows, _idx, axis):
+                    assert axis == 2
+                    return leaf.at[:, :, tbl].set(rows)
+
+                idx = None  # consumed by the table closure
+        if sp <= 1 or L == 1:
+            upd = jax.lax.dynamic_update_slice_in_dim
         if c.kv_int8:
             from dalle_tpu.ops.quant import quantize_rows
 
@@ -1049,7 +1157,15 @@ class JointAttention(nn.Module):
             if c.rotary_v:
                 v = apply_rotary(v, ang)
         new_cache = self._cache_store(cache, k, v, idx)
+        sp = _decode_sp(c)
         mask_table = jnp.asarray(_static_mask(c, self.attn_type))
+        if sp > 1:
+            # cache rows live in cyclic storage order: permute the mask
+            # COLUMNS to match (static gather of a constant table).  The
+            # sp flash path below ignores the mask (it rebuilds key<=pos
+            # from shard-local positions); this covers the dense branch
+            # for non-"full" attention types.
+            mask_table = mask_table[:, jnp.asarray(_sp_storage_tables(c, sp)[1])]
         if per_slot:
             mask = mask_table[idx][:, None, None, :]  # [b,1,1,n] per-lane rows
         else:
@@ -1061,7 +1177,7 @@ class JointAttention(nn.Module):
         # is element-for-element the plain MHA read, same head-major layout.
         g = c.heads // c.num_kv_heads
         qg = q[:, :, 0].reshape(b, c.num_kv_heads, g, c.dim_head)
-        if c.fused_decode and c.causal and self.attn_type == "full":
+        if (c.fused_decode or sp > 1) and c.causal and self.attn_type == "full":
             # fused decode tick: one kernel reads the cache at its stored
             # width (int8 + scales under kv_int8) with each slot masked at
             # its own position — the full-causal mask row IS `key <= pos`,

@@ -598,16 +598,24 @@ def default_decode_block(which: str) -> int:
 
 
 def _decode_kernel(
-    pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref,
     m_scr, l_scr, acc_scr,
-    *, nkb, bk, gp, scale, quantized,
+    *, nkb, bk, gp, scale, quantized, stats,
 ):
     """One query row per slot (grouped [gp, d] for GQA) against its cached
     K/V, online softmax over streamed kv blocks.  With ``quantized`` the
     cache blocks arrive int8 and the per-(token, head) scales are folded
     into the QK scores (``s *= k_scale[j]``) and the AV probabilities
     (``p *= v_scale[j]``) — dequantization happens inside the dots, no
-    f32 cache copy ever exists."""
+    f32 cache copy ever exists.
+
+    With ``stats`` the final (m, l) running softmax stats are emitted
+    alongside the output (lane-replicated, the scratch layout) so a
+    seq-sharded caller can merge partial attentions with one cross-shard
+    softmax combine.  A negative ``pos`` means this shard holds no
+    attended keys at all: every block is skipped and the emit writes the
+    identity element (o = 0, m = NEG_INF, l = 0), which the combine
+    weights to exactly zero."""
     bi, kb = pl.program_id(0), pl.program_id(2)
     pos = pos_ref[bi]  # this slot's write position (attend keys 0..pos)
 
@@ -648,22 +656,29 @@ def _decode_kernel(
     def _emit():
         l_safe = jnp.maximum(l_scr[...][..., :1], 1e-30)
         o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        if stats:
+            m_ref[0] = m_scr[...]
+            l_ref[0] = l_scr[...]
 
 
-def _decode_scales_arg(kernel, ks, vs, bh, bk):
-    """Like :func:`_mask_arg` for the decode kernel's scale operands: the
-    non-quantized cache omits them (and their DMAs) entirely."""
-    if ks is not None:
-        spec = [pl.BlockSpec(
-            (1, bh, bk), lambda bi, hi, j: (bi, hi, j),
-            memory_space=pltpu.VMEM,
-        )] * 2
-        return kernel, spec, (ks, vs)
+def _decode_refs_arg(kernel, has_scales, stats):
+    """Adapter inserting ``None`` for the decode kernel's optional refs:
+    the non-quantized cache omits the scale operands (and their DMAs),
+    the stats-less call omits the (m, l) outputs.  Pallas passes refs
+    positionally as (inputs..., outputs..., scratch...), so the gaps are
+    re-inserted here to keep one kernel body."""
+    if has_scales and stats:
+        return kernel
 
-    def no_scale_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, **kw):
-        return kernel(pos_ref, q_ref, k_ref, v_ref, None, None, *rest, **kw)
+    def adapted(*refs, **kw):
+        refs = list(refs)
+        if not has_scales:
+            refs[4:4] = [None, None]  # ks_ref, vs_ref
+        if not stats:
+            refs[7:7] = [None, None]  # m_ref, l_ref
+        return kernel(*refs, **kw)
 
-    return no_scale_kernel, [], ()
+    return adapted
 
 
 def _decode_fallback(q, k, v, k_scale, v_scale, mask):
@@ -686,6 +701,57 @@ def _decode_fallback(q, k, v, k_scale, v_scale, mask):
     return jax.checkpoint(run)(q, k, v, k_scale, v_scale, mask)
 
 
+def _decode_fallback_stats(q, k, v, k_scale, v_scale, pos):
+    """Dense decode attention WITH softmax stats — the off-kernel arm of
+    ``return_stats=True``.  Mirrors the kernel's math in f32: scores
+    masked to keys ``0..pos`` (a negative ``pos`` masks everything —
+    the all-masked shard's weight underflows to zero in the combine),
+    per-row max ``m``, exp-sum ``l``, and the normalized output."""
+
+    def run(q, k, v, k_scale, v_scale, pos):
+        if k_scale is not None:
+            from dalle_tpu.ops.quant import dequantize_rows
+
+            k = dequantize_rows(k, k_scale, q.dtype)
+            v = dequantize_rows(v, v_scale, q.dtype)
+        d = q.shape[-1]
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32) * d ** -0.5, k.astype(jnp.float32),
+            (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )  # [b, kv, g, n]
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(ki <= pos[:, None, None, None], s, NEG_INF)
+        m = jnp.max(s, axis=-1)  # [b, kv, g]
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        o = jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        ) / jnp.maximum(l, 1e-30)[..., None]
+        return o.astype(q.dtype), m, l
+
+    return jax.checkpoint(run)(q, k, v, k_scale, v_scale, pos)
+
+
+def decode_softmax_combine(out, m, l, axis_name: str):
+    """ONE cross-shard online-softmax merge for seq-sharded decode
+    attention (docs/SERVING.md §10): each shard contributes its partial
+    ``(m, l, out)`` from :func:`flash_decode_attention`'s
+    ``return_stats=True`` arm; the exchanged triple per (slot, head) is
+    (global max, exp-sum weight, weight·V) — one pmax + two psums over
+    ``axis_name``, all f32.  Exact up to a single reassociation of the
+    softmax sum (the documented sp=2 parity contract: greedy tokens
+    match, logits differ in the last ulp).  An all-masked shard arrives
+    as (NEG_INF, 0, 0) and its weight ``exp(m - m_g) * l`` underflows to
+    exactly 0."""
+    m_g = jax.lax.pmax(m, axis_name)
+    w = jnp.exp(m - m_g) * l  # [b, kv, g]
+    num = jax.lax.psum(w[..., None] * out.astype(jnp.float32), axis_name)
+    den = jax.lax.psum(w, axis_name)
+    return (num / jnp.maximum(den, 1e-30)[..., None]).astype(out.dtype)
+
+
 def flash_decode_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -698,6 +764,7 @@ def flash_decode_attention(
     block_k: Optional[int] = None,
     block_kv_heads: Optional[int] = None,
     force_kernel: bool = False,
+    return_stats: bool = False,
 ) -> jnp.ndarray:
     """Fused decode-tick attention: ``q`` [b, kv, g, d] — ONE grouped query
     timestep per slot — against the slot's fixed-length KV cache
@@ -715,13 +782,23 @@ def flash_decode_attention(
     interpret mode off-TPU); otherwise the checkpointed lax fallback,
     which is bitwise-identical to the unfused decode path (``mask`` is the
     caller's dense mask rows, used only by the fallback — the kernel
-    rebuilds the same causal geometry from ``pos``)."""
+    rebuilds the same causal geometry from ``pos``).
+
+    With ``return_stats`` the call returns ``(out, m, l)`` — the final
+    online-softmax running stats per (slot, kv head, group row), f32 —
+    for the seq-sharded engine's cross-shard
+    :func:`decode_softmax_combine`.  In stats mode ``mask`` is ignored:
+    both arms rebuild the ``key <= pos`` geometry from ``pos`` (which
+    may be negative — a shard owning no attended keys returns the
+    combine's identity element)."""
     b, kv, g, d = q.shape
     assert k.shape == v.shape == (b, kv, k.shape[2], d), (q.shape, k.shape)
     n = k.shape[2]
     quantized = k_scale is not None
     if not (force_kernel or jax.default_backend() == "tpu"
             or interpret_forced()):
+        if return_stats:
+            return _decode_fallback_stats(q, k, v, k_scale, v_scale, pos)
         return _decode_fallback(q, k, v, k_scale, v_scale, mask)
     bk = pick_block(
         n, block_k if block_k is not None else default_decode_block("k")
@@ -739,9 +816,30 @@ def flash_decode_attention(
         vs = v_scale.reshape(b, kv, n).astype(jnp.float32)
     kernel = functools.partial(
         _decode_kernel, nkb=n // bk, bk=bk, gp=gp, scale=d ** -0.5,
-        quantized=quantized,
+        quantized=quantized, stats=return_stats,
     )
-    kernel, scale_specs, scale_args = _decode_scales_arg(kernel, ks, vs, bh, bk)
+    kernel = _decode_refs_arg(kernel, quantized, return_stats)
+    scale_specs, scale_args = [], ()
+    if quantized:
+        scale_specs = [pl.BlockSpec(
+            (1, bh, bk), lambda bi, hi, j: (bi, hi, j),
+            memory_space=pltpu.VMEM,
+        )] * 2
+        scale_args = (ks, vs)
+    o_spec = pl.BlockSpec(
+        (1, bh, gp, d), lambda bi, hi, j: (bi, hi, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    o_shape = jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype)
+    out_specs, out_shape = o_spec, o_shape
+    if return_stats:
+        stat_spec = pl.BlockSpec(
+            (1, bh, gp, _LANES), lambda bi, hi, j: (bi, hi, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+        stat_shape = jax.ShapeDtypeStruct((b, kv, gp, _LANES), jnp.float32)
+        out_specs = [o_spec, stat_spec, stat_spec]
+        out_shape = [o_shape, stat_shape, stat_shape]
     out = pl.pallas_call(
         kernel,
         grid=(b, kv // bh, n // bk),
@@ -754,11 +852,8 @@ def flash_decode_attention(
             pl.BlockSpec((1, bh, bk, d), lambda bi, hi, j: (bi, hi, j, 0),
                          memory_space=pltpu.VMEM),
         ] + scale_specs,
-        out_specs=pl.BlockSpec(
-            (1, bh, gp, d), lambda bi, hi, j: (bi, hi, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bh, gp, _LANES), jnp.float32),
             pltpu.VMEM((bh, gp, _LANES), jnp.float32),
@@ -767,6 +862,9 @@ def flash_decode_attention(
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(pos, qp, k, v, *scale_args)
+    if return_stats:
+        o, m, l = out
+        return o[:, :, :g], m[:, :, :g, 0], l[:, :, :g, 0]
     return out[:, :, :g]
 
 

@@ -134,34 +134,70 @@ def shard_params(params: Any, mesh: Mesh):
     return jax.device_put(params, param_shardings(params, mesh))
 
 
-# --- serving: TP-sharded decode state (serving/engine.py) -------------------
+# --- serving: TP/SP-sharded decode state (serving/engine.py) ----------------
 #
 # The decode cache is slot-major ([B, ...]) and mostly head-major after
 # that.  Under tensor parallelism the attention K/V rows (and their int8
 # scales) live naturally split over kv heads — attention is head-local, so
 # a [B, kv, n, d]-class leaf sharded P(None, 'tp', ...) never moves on the
-# wire during a tick.  Everything head-less (gMLP gate values, shift hist,
-# positions, RNG ladders, sampled outputs) replicates: those leaves are
-# tiny next to the K/V rows and several feed cross-head math.
+# wire during a tick.  Under sequence parallelism the same leaves split
+# again over their position axis (docs/SERVING.md §10): each sp shard
+# holds the cyclically-assigned subset of rows (``seq_storage_layout``)
+# and the decode read merges with one softmax combine.  Everything
+# head-less (gMLP gate values, shift hist, positions, RNG ladders,
+# sampled outputs) replicates: those leaves are tiny next to the K/V
+# rows and several feed cross-seq math.
+
+# Axis rules for the attention K/V-cache leaf family — the only sharded
+# decode-cache layout: [slots, kv_heads, seq, feature] (K/V rows and
+# their int8 scales share it).  (leaf axis, mesh axis); a rule engages
+# only when the mesh axis is >1 and the leaf axis divides.
+_DECODE_CACHE_AXIS_RULES = (
+    (1, "tp"),  # kv heads — attention is head-local
+    (2, "sp"),  # positions — cyclic layout + one softmax combine
+)
 
 
-def _decode_cache_spec(shape, num_kv_heads: int, tp: int) -> PartitionSpec:
-    if (
-        tp > 1
-        and len(shape) == 4
-        and shape[1] == num_kv_heads
-        and num_kv_heads % tp == 0
-    ):
-        return PartitionSpec(None, "tp", None, None)
-    return PartitionSpec()
+def _decode_cache_spec(shape, num_kv_heads: int, mesh_shape) -> PartitionSpec:
+    if len(shape) != 4 or shape[1] != num_kv_heads:
+        return PartitionSpec()
+    dims = [None] * len(shape)
+    for leaf_ax, mesh_ax in _DECODE_CACHE_AXIS_RULES:
+        size = mesh_shape.get(mesh_ax, 1)
+        if size > 1 and shape[leaf_ax] % size == 0:
+            dims[leaf_ax] = mesh_ax
+    return PartitionSpec(*dims)
 
 
 def decode_cache_specs(cache: Any, mesh: Mesh, *, num_kv_heads: int):
     """PartitionSpec pytree for a per-slot decode cache pytree."""
-    tp = axis_size(mesh, "tp")
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     return jax.tree_util.tree_map(
-        lambda leaf: _decode_cache_spec(leaf.shape, num_kv_heads, tp), cache
+        lambda leaf: _decode_cache_spec(leaf.shape, num_kv_heads, mesh_shape),
+        cache,
     )
+
+
+def seq_storage_layout(n: int, sp: int):
+    """The db-SP-style balanced position->storage maps for a seq-sharded
+    decode cache (docs/SERVING.md §10): global position ``p`` is stored
+    at ``s_of_g[p] = (p % sp) * (n // sp) + p // sp``, so the contiguous
+    storage block GSPMD places on sp-shard ``r`` holds positions
+    ``{r, r + sp, r + 2*sp, ...}`` — every shard owns ~(pos+1)/sp of any
+    slot's attended rows at EVERY decode position (a contiguous split
+    would leave one shard doing all the work until the slot crossed into
+    the next shard's range).  Returns ``(s_of_g, g_of_s)`` int32 numpy
+    tables (inverse permutations), or ``None`` at sp <= 1 / non-divisible
+    ``n`` — the identity layout."""
+    import numpy as np
+
+    if sp <= 1 or n % sp:
+        return None
+    p = np.arange(n)
+    s_of_g = (p % sp) * (n // sp) + p // sp
+    g_of_s = np.empty(n, np.int64)
+    g_of_s[s_of_g] = p
+    return s_of_g.astype(np.int32), g_of_s.astype(np.int32)
 
 
 def axis_size(mesh: Mesh, name: str) -> int:

@@ -154,6 +154,7 @@ class DecodeEngine:
             if len(cand) != 1:
                 self._block_axes = None
                 self._block_specs = None
+                self._block_perm = None
                 if self.prefix_pool is not None:
                     print(
                         "serving: prefix pool disabled — cache leaf "
@@ -168,6 +169,38 @@ class DecodeEngine:
             specs.append((tuple(shape), leaf.dtype))
         self._block_axes = axes
         self._block_specs = specs
+        # Seq-sharded leaves (sp>1 meshes) store their rows in the cyclic
+        # balanced layout: their prefix blocks are exported/merged through
+        # the position->storage table so pooled blocks stay in GLOBAL
+        # position order (layout-independent pool entries).  One entry per
+        # leaf: the s_of_g table for permuted leaves, None for the rest.
+        self._block_perm = [None] * len(axes)
+        if self.mesh is not None:
+            from dalle_tpu.parallel import partition
+
+            sp = partition.axis_size(self.mesh, "sp")
+            layout = partition.seq_storage_layout(seq, sp)
+            if layout is not None:
+                self._block_perm = [
+                    layout[0] if "sp" in tuple(s) else None
+                    for s in self._cache_spec_leaves()
+                ]
+
+    def _cache_spec_leaves(self):
+        """The cache leaves' PartitionSpecs (flat, leaf order) on this
+        engine's mesh."""
+        from jax.sharding import PartitionSpec
+
+        from dalle_tpu.parallel import partition
+
+        c = self.model.cfg
+        specs = partition.decode_cache_specs(
+            self.state.cache, self.mesh,
+            num_kv_heads=(c.kv_heads or c.heads),
+        )
+        return jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(s, PartitionSpec)
+        )
 
     def _make_jitted_fns(self) -> None:
         """Jit tick + both admit seams.  Unsharded engines let placement
@@ -190,12 +223,22 @@ class DecodeEngine:
         psh = partition.param_shardings(self.params, self.mesh)
         ssh = self._state_shardings
         repl = NamedSharding(self.mesh, PartitionSpec())
-        # prefix blocks mirror the cache leaves' shardings (slicing the
-        # position axis never touches the kv-head axis)
-        cache_sh = jax.tree_util.tree_leaves(
-            ssh.cache, is_leaf=lambda x: isinstance(x, NamedSharding)
-        )
-        blocks_sh = () if self._block_axes is None else list(cache_sh)
+        # prefix blocks mirror the cache leaves' shardings EXCEPT the sp
+        # axis: blocks are t rows in global position order (gathered
+        # through the storage table, length not sp-divisible), so their
+        # position axis replicates while the kv-head axis keeps tp
+        if self._block_axes is None:
+            blocks_sh = ()
+        else:
+            blocks_sh = [
+                NamedSharding(
+                    self.mesh,
+                    PartitionSpec(*[
+                        None if d == "sp" else d for d in tuple(s)
+                    ]),
+                )
+                for s in self._cache_spec_leaves()
+            ]
         self._tick_fn = jax.jit(
             self._tick_impl, donate_argnums=(1,),
             in_shardings=(psh, ssh), out_shardings=ssh,
@@ -314,10 +357,15 @@ class DecodeEngine:
         if self._block_axes is None:
             blocks = ()
         else:
+            # positions [:t] per leaf — a contiguous slice, except for
+            # seq-sharded leaves whose rows sit in cyclic storage order:
+            # those gather through the static table back to global order
             blocks = [
-                jax.lax.slice_in_dim(leaf, 0, t, axis=ax)
-                for leaf, ax in zip(
-                    jax.tree_util.tree_leaves(pcache), self._block_axes
+                jax.lax.slice_in_dim(leaf, 0, t, axis=ax) if perm is None
+                else jnp.take(leaf, jnp.asarray(perm[:t]), axis=ax)
+                for leaf, ax, perm in zip(
+                    jax.tree_util.tree_leaves(pcache), self._block_axes,
+                    self._block_perm,
                 )
             ]
         return EngineState(
@@ -353,13 +401,25 @@ class DecodeEngine:
         ladder = jax.vmap(lambda k: jax.random.split(k, S))(base_keys)
         old_leaves, treedef = jax.tree_util.tree_flatten(state.cache)
         merged_leaves = []
-        for old, new, ax in zip(old_leaves, blocks, self._block_axes):
+        for old, new, ax, perm in zip(
+            old_leaves, blocks, self._block_axes, self._block_perm
+        ):
             tk = take.reshape((-1,) + (1,) * (old.ndim - 1))
-            head = jax.lax.slice_in_dim(old, 0, t, axis=ax)
-            merged = jnp.where(tk, jnp.take(new, src, axis=0), head)
-            merged_leaves.append(
-                jax.lax.dynamic_update_slice_in_dim(old, merged, 0, axis=ax)
-            )
+            if perm is None:
+                head = jax.lax.slice_in_dim(old, 0, t, axis=ax)
+                merged = jnp.where(tk, jnp.take(new, src, axis=0), head)
+                merged_leaves.append(
+                    jax.lax.dynamic_update_slice_in_dim(old, merged, 0, axis=ax)
+                )
+            else:
+                # seq-sharded leaf: blocks are global-order rows, the
+                # cache is cyclic storage — gather/scatter via the table
+                idxs = jnp.asarray(perm[:t])
+                head = jnp.take(old, idxs, axis=ax)
+                merged = jnp.where(tk, jnp.take(new, src, axis=0), head)
+                merged_leaves.append(
+                    old.at[(slice(None),) * ax + (idxs,)].set(merged)
+                )
         cache = jax.tree_util.tree_unflatten(treedef, merged_leaves)
         return EngineState(
             cache=cache,

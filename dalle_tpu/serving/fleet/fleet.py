@@ -161,6 +161,7 @@ class Fleet:
         num_slots: int = 8,
         devices=None,
         mesh_tp: int = 1,
+        mesh_sp: int = 1,
         filter_thres: float = 0.9,
         use_top_p: bool = False,
         policy: str = "continuous",
@@ -189,24 +190,28 @@ class Fleet:
         self.metrics = metrics
         if devices is None:
             devices = jax.devices()
-        # scale-out x scale-up (docs/SERVING.md §9): each replica is a
-        # tp-sized device group, partitioned replica-major — replica r
-        # owns the contiguous group [r*tp, (r+1)*tp) and runs a sharded
-        # engine over its own Mesh.  devices= entries may also be
-        # Sharding objects at tp == 1 (jax.device_put accepts either).
+        # scale-out x scale-up (docs/SERVING.md §9-10): each replica is a
+        # (tp x sp)-sized device group, partitioned replica-major —
+        # replica r owns the contiguous group [r*g, (r+1)*g) with
+        # g = tp*sp and runs a sharded engine over its own 2D decode
+        # Mesh.  devices= entries may also be Sharding objects at
+        # g == 1 (jax.device_put accepts either).
         self.mesh_tp = int(mesh_tp)
-        if self.mesh_tp > 1:
-            need = replicas * self.mesh_tp
+        self.mesh_sp = int(mesh_sp)
+        group = self.mesh_tp * self.mesh_sp
+        if group > 1:
+            need = replicas * group
             assert len(devices) >= need, (
-                f"{replicas} replicas x tp={self.mesh_tp} needs {need} "
-                f"devices, have {len(devices)}"
+                f"{replicas} replicas x tp={self.mesh_tp} x "
+                f"sp={self.mesh_sp} needs {need} devices, have "
+                f"{len(devices)}"
             )
             from dalle_tpu.parallel.mesh import make_mesh
 
             self.meshes = [
                 make_mesh(
-                    dp=1, tp=self.mesh_tp,
-                    devices=devices[r * self.mesh_tp:(r + 1) * self.mesh_tp],
+                    dp=1, tp=self.mesh_tp, sp=self.mesh_sp,
+                    devices=devices[r * group:(r + 1) * group],
                 )
                 for r in range(replicas)
             ]
@@ -365,6 +370,7 @@ def fleet_replay_trace(
     replicas: int = 2,
     devices=None,
     mesh_tp: int = 1,
+    mesh_sp: int = 1,
     num_slots: int = 8,
     filter_thres: float = 0.9,
     time_scale: float = 1.0,
@@ -388,7 +394,8 @@ def fleet_replay_trace(
         prefix_pool = PrefixPool(prefix_pool_bytes)
     fleet = Fleet(
         model, params, replicas=replicas, devices=devices,
-        mesh_tp=mesh_tp, num_slots=num_slots, filter_thres=filter_thres,
+        mesh_tp=mesh_tp, mesh_sp=mesh_sp, num_slots=num_slots,
+        filter_thres=filter_thres,
         use_top_p=any(it.top_p is not None for it in trace),
         policy=policy, max_pending=max_pending, shed_policy=shed_policy,
         result_cache=result_cache, prefix_pool=prefix_pool,

@@ -924,6 +924,7 @@ def replay_trace(
     devices=None,
     mesh=None,
     mesh_tp: int = 1,
+    mesh_sp: int = 1,
     **scheduler_kwargs,
 ) -> dict:
     """Replay a recorded arrival trace against a fresh engine.
@@ -940,19 +941,21 @@ def replay_trace(
     ``replicas > 1`` delegates to
     :func:`dalle_tpu.serving.fleet.fleet_replay_trace` — same traffic,
     N engine replicas behind the fleet router (docs/SERVING.md §8).
-    ``mesh`` runs the single engine TP-sharded over that Mesh;
-    ``mesh_tp > 1`` with ``replicas > 1`` gives each replica its own
-    replica-major tp-group (docs/SERVING.md §9)."""
+    ``mesh`` runs the single engine sharded over that Mesh;
+    ``mesh_tp``/``mesh_sp`` > 1 with ``replicas > 1`` gives each replica
+    its own replica-major (tp x sp) decode group (docs/SERVING.md
+    §9-10)."""
     if replicas > 1:
         assert mesh is None, (
-            "pass mesh_tp= (per-replica tp-groups), not a global mesh, "
-            "when replicas > 1"
+            "pass mesh_tp=/mesh_sp= (per-replica decode groups), not a "
+            "global mesh, when replicas > 1"
         )
         from dalle_tpu.serving.fleet import fleet_replay_trace
 
         return fleet_replay_trace(
             model, params, trace, replicas=replicas, devices=devices,
-            mesh_tp=mesh_tp, num_slots=num_slots, filter_thres=filter_thres,
+            mesh_tp=mesh_tp, mesh_sp=mesh_sp,
+            num_slots=num_slots, filter_thres=filter_thres,
             time_scale=time_scale, policy=policy,
             vae=vae, vae_params=vae_params, clip=clip,
             clip_params=clip_params, max_pending=max_pending,

@@ -214,7 +214,8 @@ def dalle_step_wire_bytes(cfg, batch: int) -> dict:
     return out
 
 
-def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool) -> float:
+def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool,
+                           sp: int = 1) -> float:
     """Analytic HBM attention bytes for ONE engine decode tick at full
     occupancy (the byte-side model behind bench.py's ``decode_speed``
     rung, same term-by-term discipline as :func:`dalle_step_wire_bytes`).
@@ -236,6 +237,17 @@ def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool) -> float:
     Non-"full" layers (mlp/sparse/axial) are counted identically on both
     sides (the fused path only rewires full attention).  Query/output
     vectors (one row per slot) are negligible and counted symmetrically.
+
+    ``sp`` models sequence-parallel decode (docs/SERVING.md §10): the
+    K/V rows (and int8 scales) of every "full" layer are sharded over
+    ``sp`` chips, so the PER-CHIP cache stream divides by ``sp``.  At
+    sp > 1 the "full" path always runs the stats kernel + softmax
+    combine inside the shard_map island — fused semantics regardless of
+    the ``fused`` flag (no dequant copy / score-row HBM round-trips).
+    Non-"full" caches are read densely by GSPMD (gathered, not
+    island-read), so their bytes don't divide.  With an all-"full"
+    stack the sp=2 cut is ~50% — comfortably over the decode_sp rung's
+    45% gate.
     """
     import jax.numpy as jnp
 
@@ -252,8 +264,10 @@ def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool) -> float:
     total = 0.0
     for i in range(cfg.depth):
         at = cfg.attn_types[i % len(cfg.attn_types)]
-        layer = 2 * (cache_row + scale_row) + qo  # K + V streamed once
-        if at == "full" and fused:
+        island = at == "full" and sp > 1  # sp-sharded, island-read
+        div = sp if island else 1
+        layer = 2 * (cache_row + scale_row) / div + qo  # K + V once
+        if at == "full" and (fused or island):
             pass  # kernel: everything else stays in VMEM
         else:
             if quant:
@@ -446,12 +460,21 @@ def decode_tick_ici_bytes(cfg, slots: int, mesh_shape, *,
         ((tp-1)/tp * slots * num_image_tokens * 4): sampling reads exact
         f32 logits, never quantized.
 
+    A seq-parallel axis (``sp``, docs/SERVING.md §10) adds exactly one
+    collective per "full" attention layer: the online-softmax combine
+    exchanges per-shard ``(m, w, w·V)`` triples — ``(dim_head + 2)`` f32
+    values per (slot, head) — as ring all-reduces (the pmax of m plus
+    the psums of w and w·V are the same ring volume), always f32
+    regardless of ``decode_comm`` (exactness up to one reassociation is
+    the contract).  The K/V rows themselves never cross the wire.
+
     Ring lower bounds as everywhere in this module: all-reduce of B bytes
     = ``2*(P-1)/P * B``, all-gather = ``(P-1)/P * B``.  The f32 mode
     prices activations at 4 B/elem (the engine decodes f32 — the
     collective-matmul ring decomposition moves the same bytes as the
-    baseline all-reduce).  Returns ``{layers, head, total}``; all zeros
-    at tp == 1 (nothing crosses a chip).
+    baseline all-reduce).  Returns ``{layers, head, sp_combine, total}``
+    — the legacy 3-key all-zero dict when both tp and sp are 1 (nothing
+    crosses a chip).
     """
     if decode_comm not in GRAD_COMM_BYTES:
         raise ValueError(
@@ -459,7 +482,8 @@ def decode_tick_ici_bytes(cfg, slots: int, mesh_shape, *,
             f"got {decode_comm!r}")
     sz = _mesh_axis_sizes(mesh_shape)
     tp = sz.get("tp", 1)
-    if tp <= 1:
+    sp = sz.get("sp", 1)
+    if tp <= 1 and sp <= 1:
         return {"layers": 0.0, "head": 0.0, "total": 0.0}
     w = GRAD_COMM_BYTES[decode_comm]
     ar = 2.0 * (tp - 1) / tp
@@ -467,15 +491,24 @@ def decode_tick_ici_bytes(cfg, slots: int, mesh_shape, *,
         1 for i in range(cfg.depth)
         if cfg.attn_types[i % len(cfg.attn_types)] != "mlp"
     )
+    full_layers = sum(
+        1 for i in range(cfg.depth)
+        if cfg.attn_types[i % len(cfg.attn_types)] == "full"
+    )
     mlp_layers = cfg.depth - attn_layers
     quant_ars = attn_layers + cfg.depth   # attn-out + every layer's FF
     f32_ars = mlp_layers                  # CausalSGU proj_out stays dense
     layers = ar * slots * cfg.dim * (quant_ars * w + f32_ars * 4.0)
     head = (tp - 1) / tp * slots * cfg.num_image_tokens * 4.0
+    sp_combine = (
+        2.0 * (sp - 1) / sp
+        * slots * cfg.heads * (cfg.dim_head + 2) * 4.0 * full_layers
+    )
     return {
         "layers": float(layers),
         "head": float(head),
-        "total": float(layers + head),
+        "sp_combine": float(sp_combine),
+        "total": float(layers + head + sp_combine),
     }
 
 

@@ -115,6 +115,7 @@ def validate_serve_flags(args) -> list:
     if args.replicas < 1:
         errors.append(f"--replicas must be >= 1, got {args.replicas}")
     tp = args.mesh_tp or 1
+    sp = args.mesh_sp or 1
     if args.replicas > 1:
         if args.serve_policy != "continuous":
             errors.append(
@@ -122,29 +123,49 @@ def validate_serve_flags(args) -> list:
                 f"continuous (got {args.serve_policy}; sequential/"
                 "full_batch are single-engine batching experiments)"
             )
-        # scale-out x scale-up composition (docs/SERVING.md §9): each
-        # replica is a tp-group of devices, partitioned replica-major —
-        # replica r owns devices [r*tp, (r+1)*tp).  Only the tp axis
-        # composes; the other mesh axes have no per-replica meaning.
+        # scale-out x scale-up composition (docs/SERVING.md §9-10): each
+        # replica is a (tp x sp)-group of devices, partitioned
+        # replica-major — replica r owns devices [r*tp*sp, (r+1)*tp*sp).
+        # Only the decode mesh axes compose; the training-only axes have
+        # no per-replica meaning.
         bad_axes = [
-            ax for ax in ("dp", "fsdp", "sp", "pp", "ep")
+            ax for ax in ("dp", "fsdp", "pp", "ep")
             if (getattr(args, f"mesh_{ax}") or 1) != 1
         ]
         if bad_axes:
             errors.append(
-                f"--replicas composes only with --mesh_tp (replica-major "
-                f"tp groups, docs/SERVING.md §9) — drop "
-                + ", ".join(f"--mesh_{ax}" for ax in bad_axes)
+                f"--replicas composes only with --mesh_tp/--mesh_sp "
+                f"(replica-major decode groups, docs/SERVING.md §9-10) — "
+                "drop " + ", ".join(f"--mesh_{ax}" for ax in bad_axes)
             )
-        if tp > 1:
-            import jax as _jax
+    if tp * sp > 1 or args.replicas > 1:
+        import jax as _jax
 
-            have = len(_jax.devices())
-            if args.replicas * tp > have:
-                errors.append(
-                    f"--replicas {args.replicas} x --mesh_tp {tp} needs "
-                    f"{args.replicas * tp} devices, have {have}"
-                )
+        have = len(_jax.devices())
+        if args.replicas * tp * sp > have:
+            errors.append(
+                f"--replicas {args.replicas} x --mesh_tp {tp} x "
+                f"--mesh_sp {sp} needs {args.replicas * tp * sp} "
+                f"devices, have {have}"
+            )
+    if sp > 1:
+        # seq divisibility needs the checkpoint geometry — peek at
+        # meta.json only (cheap; params untouched), and let a missing /
+        # torch-format checkpoint fall through to its own load-time error
+        seq = None
+        try:
+            from dalle_tpu.training.checkpoint import load_meta
+
+            hp = load_meta(args.dalle_path).get("hparams") or {}
+            seq = int(hp["text_seq_len"]) + int(hp["image_fmap_size"]) ** 2
+        except Exception:
+            pass
+        if seq is not None and seq % sp:
+            errors.append(
+                f"--mesh_sp {sp} must divide the decode cache seq length "
+                f"{seq} (text_seq_len + image_fmap_size**2 of the "
+                "checkpoint; docs/SERVING.md §10)"
+            )
     if args.decode_comm != "f32" and tp < 2:
         errors.append(
             f"--decode_comm {args.decode_comm} requires --mesh_tp >= 2 "
@@ -586,6 +607,7 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
     mesh_kw = mesh_kwargs_from_args(args)
     mesh = None
     tp = mesh_kw.get("tp", 1) if mesh_kw else 1
+    sp = mesh_kw.get("sp", 1) if mesh_kw else 1
     if tp > 1:
         # sharded decode (docs/SERVING.md §9): set the per-tick TP
         # collective mode on the model before any engine is built — it is
@@ -693,7 +715,7 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                 fingerprint=fingerprint, queue=req_queue,
                 vae=vae, vae_params=vae_params, clip=clip,
                 clip_params=clip_params, on_result=on_result,
-                degrade=args.degrade, mesh_tp=tp,
+                degrade=args.degrade, mesh_tp=tp, mesh_sp=sp,
             )
             server.warmup()
         else:

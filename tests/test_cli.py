@@ -23,6 +23,7 @@ def tiny_data(tmp_path_factory):
     return str(d)
 
 
+@pytest.mark.slow
 def test_vae_then_dalle_then_generate(tiny_data, tmp_path):
     import train_vae
 
@@ -198,6 +199,7 @@ def test_serve_flag_validation_errors(tmp_path):
     assert any("requires --max_queue" in r["error"] for r in recs)
 
 
+@pytest.mark.slow
 def test_train_dalle_webdataset_cli(tmp_path):
     """train_dalle end to end from tar shards (--wds), the reference's
     webdataset mode (reference: train_dalle.py:353-374,400-405)."""
@@ -258,6 +260,7 @@ def test_train_dalle_webdataset_cli(tmp_path):
     assert (out / "dalle-final" / "meta.json").exists()
 
 
+@pytest.mark.slow
 def test_generate_with_vqgan_override(tmp_path):
     """generate.py --taming/--vqgan_* rebuilds the VAE from a taming-layout
     checkpoint instead of the embedded one (reference: generate.py:86-91) —
@@ -314,6 +317,7 @@ def test_generate_with_vqgan_override(tmp_path):
     assert len(written) == 2, written
 
 
+@pytest.mark.slow
 def test_train_clip_then_rerank_generate(tiny_data, tmp_path):
     """train_clip.py closes the reranking workflow gap: the reference ships
     CLIP training only as a README snippet (README.md:210-235) and no CLI
@@ -470,6 +474,7 @@ def test_config_json_parser_typed_validation(tmp_path):
     assert args.learning_rate == 1.0 and isinstance(args.learning_rate, float)
 
 
+@pytest.mark.slow
 def test_auto_resume_and_ema(tiny_data, tmp_path, capsys):
     """--auto_resume picks the newest checkpoint in --output_path;
     --ema_decay tracks EMA params that generate.py prefers."""
@@ -555,6 +560,7 @@ def test_config_json_null_and_choices(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_ga_lr_decay_and_pruning(tiny_data, tmp_path):
     """Previously-untested trainer knobs in one run: --ga_steps (optax
     MultiSteps), --lr_decay (plateau scheduler through set_learning_rate on
@@ -674,6 +680,7 @@ def test_train_vae_resume(tiny_data, tmp_path, capsys):
     assert load_meta(out + "/vae-final")["step"] == meta2["step"]
 
 
+@pytest.mark.slow
 def test_train_clip_resume(tiny_data, tmp_path, capsys):
     """train_clip --auto_resume: params/opt/step restore, completed runs
     are a no-op on resume."""
@@ -708,6 +715,7 @@ def test_train_clip_resume(tiny_data, tmp_path, capsys):
     assert load_meta(out + "/clip-final")["step"] == meta2["step"]
 
 
+@pytest.mark.slow
 def test_crash_and_auto_resume(tiny_data, tmp_path, capsys):
     """Fault injection (SURVEY.md §5.3 — the reference's recovery model is
     'restart from the latest checkpoint'): SIGKILL a trainer mid-run, then
@@ -802,6 +810,7 @@ def test_crash_and_auto_resume(tiny_data, tmp_path, capsys):
     assert load_meta(str(final))["step"] > killed_step
 
 
+@pytest.mark.slow
 def test_mu_bf16_resume_mismatch_fails_loudly(tmp_path, tiny_data):
     """A moment-dtype flag mismatch on resume must error, not silently
     cast the restored adam moments (the opt_state restore is typed)."""

@@ -11,6 +11,7 @@ import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
@@ -104,6 +105,7 @@ def test_export_kv_int8_decoder(tmp_path):
     np.testing.assert_array_equal(out, np.asarray(dec(params, text, key)))
 
 
+@pytest.mark.slow
 def test_export_flagship_vocab_int8_kv(tmp_path):
     """Flagship-vocab serving stress (VERDICT r4 next #7): the 16k-VQGAN
     vocab + 256-text/256-image sequence at dim 512, exported with int8

@@ -115,6 +115,7 @@ def test_dalle_loss_fused_grads_match_dense():
         )
 
 
+@pytest.mark.slow
 def test_fused_loss_under_tp_sharded_mesh():
     """loss_chunk must compose with GSPMD: a (dp=2,fsdp=2,tp=2) sharded
     train step — to_logits/kernel sharded (None, 'tp') on the vocab axis —

@@ -107,6 +107,7 @@ def test_openai_dvae_golden_tiny(tmp_path):
     _openai_case(tmp_path, cfg, image_px=32)
 
 
+@pytest.mark.slow
 def test_openai_dvae_golden_full_geometry(tmp_path):
     """Released geometry (n_hid 256, vocab 8192, n_init 128) at reduced
     spatial size — channel shapes and layout are exactly the released ones."""
@@ -223,6 +224,7 @@ def test_vqgan_golden_gumbel(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_vqgan_golden_full_channels(tmp_path):
     """f16 ImageNet-VQGAN channel plan (ch 128, mult 1,1,2,2,4) at reduced
     resolution — exercises deep down/up indices and mid attention at the

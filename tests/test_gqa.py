@@ -228,6 +228,7 @@ def test_gqa_sp_model_matches_single_device(rng, devices):
     )
 
 
+@pytest.mark.slow
 def test_gqa_ulysses_and_usp_model_parity(rng, devices):
     """GQA under BOTH remaining SP modes: pure ulysses (expands grouped
     K/V up front — its all_to_all re-shards the head dim itself) and usp

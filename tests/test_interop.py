@@ -13,12 +13,21 @@ reverse.
 """
 
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
 
 import numpy as np
 import pytest
+
+if not os.path.isdir("/root/reference"):
+    pytest.skip(
+        "reference PyTorch checkout not present at /root/reference — "
+        "the .pt round-trip tests build artifacts with the actual "
+        "reference classes (clone the reference repo there to run them)",
+        allow_module_level=True,
+    )
 
 torch = pytest.importorskip("torch")
 

@@ -4,6 +4,7 @@ aux loss, ep-sharded train step, decode parity."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dalle_tpu.models.dalle import DALLE, DALLEConfig
 from dalle_tpu.models.moe import MoEFeedForward, _route
@@ -99,6 +100,7 @@ def test_single_expert_equals_dense_geglu():
     )
 
 
+@pytest.mark.slow
 def test_moe_dalle_train_step_on_ep_mesh():
     from dalle_tpu.training import (
         init_train_state,
@@ -151,6 +153,7 @@ def test_moe_aux_loss_sown():
     assert float(leaves[0]) > 0
 
 
+@pytest.mark.slow
 def test_moe_aux_active_under_reversible():
     """VERDICT weak #5: the load-balancing loss must survive the reversible
     custom-VJP chain — sown, nonzero, and differentiable w.r.t. the router."""

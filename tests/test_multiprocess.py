@@ -23,6 +23,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_backend_and_checkpoint(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
     env = {

@@ -67,6 +67,7 @@ def test_param_specs_tp_and_fsdp(rng):
     assert "fsdp" in tuple(specs["text_emb"]["embedding"])
 
 
+@pytest.mark.slow
 def test_sharded_train_step_matches_single_device(rng, devices):
     """Same params+batch: (dp=2,fsdp=2,tp=2) step == single-device step."""
     model = DALLE(dalle_cfg())

@@ -126,6 +126,7 @@ def test_dalle_pipeline_matches_sequential_stages():
     )
 
 
+@pytest.mark.slow
 def test_dalle_pipeline_train_step():
     """Full sharded train step with pp=2: runs, loss finite, grads update."""
     from dalle_tpu.models.dalle import DALLE

@@ -103,7 +103,19 @@ def baselines(vae_and_params, mesh1):
     return get
 
 
-@pytest.mark.parametrize("name", list(POLICY_CASES))
+@pytest.mark.parametrize(
+    "name",
+    [
+        # the two heaviest multi-step trajectory cases run in the slow tier
+        pytest.param(
+            n,
+            marks=[pytest.mark.slow]
+            if n in ("remat_nothing", "scan_remat_ff_only")
+            else [],
+        )
+        for n in POLICY_CASES
+    ],
+)
 def test_policy_trajectory_matches_f32_baseline(
     name, vae_and_params, mesh1, baselines
 ):

@@ -16,6 +16,7 @@ import itertools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dalle_tpu.models.dalle import DALLE, DALLEConfig
 from dalle_tpu.models.generate import generate_image_codes
@@ -46,6 +47,7 @@ def build_dataset():
     return tok.tokenize(texts, TEXT_LEN), np.stack(images), texts
 
 
+@pytest.mark.slow
 def test_rainbow_pipeline_token_accuracy(rng):
     text_ids, images, texts = build_dataset()
     n = len(texts)

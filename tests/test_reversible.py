@@ -63,6 +63,7 @@ def _dalle(rng, **kw):
     return model, params, text, codes
 
 
+@pytest.mark.slow
 def test_dalle_reversible_custom_vjp_matches_remat_path(rng):
     """Same params: the custom-vjp reversible path and the plain coupled
     loop (use_remat short-circuit) agree in loss and gradients."""

@@ -177,6 +177,7 @@ def test_zigzag_ring_matches_dense(rng, devices):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_pad_mask_and_grads(rng, devices):
     from dalle_tpu.parallel.ring import ring_attention_sharded as ras
 
@@ -258,6 +259,7 @@ def test_ring_flash_matches_dense(rng, devices, schedule):
 
 
 @pytest.mark.parametrize("schedule", ["contiguous", "zigzag"])
+@pytest.mark.slow
 def test_ring_flash_gradients_match_einsum_ring(rng, devices, schedule):
     """The lse-aware flash backward (delta - dlse adjustment) through the
     cross-chunk merge == autodiff of the einsum ring == the dense oracle,

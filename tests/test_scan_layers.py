@@ -131,6 +131,7 @@ def test_scan_config_guards():
         )
 
 
+@pytest.mark.slow
 def test_scan_cli_train_then_generate(tmp_path):
     """--scan_layers end to end: train (stacked checkpoint) -> generate
     (auto-unstacked decode), plus EMA riding along in the stacked layout."""
@@ -186,6 +187,7 @@ def test_scan_cli_train_then_generate(tmp_path):
     assert len(list(Path(gen_out).glob("*/*.jpg"))) == 1
 
 
+@pytest.mark.slow
 def test_scan_composes_with_sequence_parallelism(rng):
     """shard_map-based SP attention inside the lax.scan layer body: the
     scanned stack must train under a dp x tp x sp mesh with either scheme
@@ -239,6 +241,7 @@ def test_clip_scan_layers(rng):
     assert CLIPConfig.from_dict(cfg.to_dict()).scan_layers is True
 
 
+@pytest.mark.slow
 def test_train_step_determinism(rng):
     """Same seed, same data -> bit-identical losses across two fresh
     train-step constructions (regression guard for hidden nondeterminism

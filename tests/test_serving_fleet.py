@@ -272,6 +272,7 @@ def test_fleet_kill_all_replicas_fails_structured(rng):
 # --- fleet-shared caches ------------------------------------------------
 
 
+@pytest.mark.slow
 def test_fleet_shared_caches_cross_replica(rng):
     """One ResultCache + one PrefixPool serve the whole fleet: replica
     0's prefill admits replica 1's same-text request off the shared

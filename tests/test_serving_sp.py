@@ -1,0 +1,483 @@
+"""Sequence-parallel decode tests (docs/SERVING.md §10): seq-sharded KV
+caches + one cross-shard online-softmax combine, composed with TP.
+
+Four pinned layers, mirroring test_serving_shard.py's discipline:
+
+1. **sp=1 transparency** — an engine over a mesh whose sp axis is 1 is
+   BITWISE the unsharded engine for every variant (plain, kv_int8,
+   fused_decode): all sp plumbing (cyclic storage layout, stats kernel,
+   combine) is behind trace-time ``sp > 1`` guards and must be inert.
+2. **sp=2 greedy parity** — seq-sharding reassociates the softmax
+   reduction exactly once (per-shard partials, then one combine), so
+   f32 bits may differ but the greedy trajectory must not, across
+   occupancy churn with slots at staggered positions.
+3. **2D composition** — tp=2 x sp=2 on 4 virtual CPU devices reproduces
+   the greedy codes with every jitted seam (tick, admit, pooled admit)
+   compiled exactly once.
+4. **Analytic byte model** — the sp terms of ``decode_tick_attn_bytes``
+   / ``decode_tick_ici_bytes`` restated by hand: per-chip KV bytes / S
+   for island-read "full" layers, ring-all-reduced f32 (m, w, w*V)
+   combine triples on the wire, and the decode_sp rung's >= 45% cut at
+   the flagship shape.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.quantize import fused_decode_model, kv_int8_model
+from dalle_tpu.parallel.mesh import make_mesh
+from dalle_tpu.parallel.partition import seq_storage_layout
+from dalle_tpu.serving import DecodeEngine, PrefixPool, Request
+from dalle_tpu.training.profiler import (
+    decode_tick_attn_bytes,
+    decode_tick_ici_bytes,
+)
+
+T, F = 4, 2  # text 4 + image 4 => total_seq_len 8, divisible by sp in {2, 4}
+
+
+def build(rng, *, kv_int8=False, fused=False, **kw):
+    kw.setdefault("image_fmap_size", F)
+    cfg = DALLEConfig(
+        num_text_tokens=30,
+        text_seq_len=T,
+        num_image_tokens=20,
+        dim=32,
+        depth=2,
+        heads=2,
+        dim_head=16,
+        **kw,
+    )
+    text = jax.random.randint(rng, (3, T), 1, 30)
+    codes = jax.random.randint(rng, (3, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    if kv_int8:
+        model = kv_int8_model(model)
+    if fused:
+        model = fused_decode_model(model)
+    return model, params
+
+
+def _requests(n, *, seed0=100, temperature=1e-8, top_p=None):
+    texts = np.random.RandomState(0).randint(1, 30, size=(n, T))
+    return [
+        Request(text_tokens=texts[i], seed=seed0 + i,
+                temperature=temperature, top_p=top_p, request_id=f"r{i}")
+        for i in range(n)
+    ]
+
+
+def _drain(engine, reqs, *, stagger_at=2):
+    """Admit 2, stagger the rest in as slots free — active slots sit at
+    STAGGERED positions by construction, so every tick exercises
+    different per-shard attended lengths.  Returns codes by request id."""
+    pending = list(reqs)
+    engine.warmup()
+    engine.admit([pending.pop(0), pending.pop(0)])
+    while pending or engine.num_active:
+        if engine.tick_count >= stagger_at and pending:
+            free = engine.free_slots()
+            take = min(len(free), len(pending))
+            if take:
+                engine.admit([pending.pop(0) for _ in range(take)])
+        engine.step()
+    return {r.request_id: np.asarray(r.codes) for r in reqs}
+
+
+VARIANTS = {
+    "plain": dict(),
+    "kv_int8": dict(kv_int8=True),
+    "fused": dict(fused=True),
+    "fused_kv_int8": dict(kv_int8=True, fused=True),
+}
+
+
+# --- 0. the cyclic storage layout itself --------------------------------
+
+
+@pytest.mark.parametrize("n,sp", [(8, 2), (8, 4), (12, 3), (16, 2)])
+def test_seq_storage_layout_cyclic_and_inverse(n, sp):
+    s_of_g, g_of_s = seq_storage_layout(n, sp)
+    # mutually inverse permutations of range(n)
+    assert sorted(s_of_g) == list(range(n))
+    np.testing.assert_array_equal(g_of_s[s_of_g], np.arange(n))
+    np.testing.assert_array_equal(s_of_g[g_of_s], np.arange(n))
+    # the contiguous storage block of shard r holds positions r, r+sp, ...
+    per = n // sp
+    for r in range(sp):
+        np.testing.assert_array_equal(
+            np.sort(g_of_s[r * per:(r + 1) * per]),
+            np.arange(r, n, sp),
+        )
+    # balance: after p+1 writes, every shard owns within 1 of (p+1)/sp rows
+    for p in range(n):
+        owned = np.bincount(s_of_g[: p + 1] // per, minlength=sp)
+        assert owned.max() - owned.min() <= 1, (p, owned)
+
+
+def test_seq_storage_layout_identity_cases():
+    assert seq_storage_layout(8, 1) is None
+    assert seq_storage_layout(8, 3) is None  # non-divisible => identity
+
+
+# --- 1. sp=1 is bitwise the unsharded engine ----------------------------
+
+
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "sampled"])
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_sp1_mesh_bitwise(rng, devices, variant, sampled):
+    model, params = build(rng, **VARIANTS[variant])
+    temperature = 1.0 if sampled else 1e-8
+    thres = 0.9 if sampled else 0.0
+
+    base = _drain(
+        DecodeEngine(model, params, num_slots=3, filter_thres=thres),
+        _requests(4, temperature=temperature),
+    )
+    mesh = make_mesh(dp=1, tp=1, sp=1, devices=jax.devices()[:1])
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=thres,
+                          mesh=mesh)
+    sharded = _drain(engine, _requests(4, temperature=temperature))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: sp=1 mesh != unsharded "
+                    f"({variant}, sampled={sampled})",
+        )
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+
+
+# --- 2. sp=2 greedy parity across staggered occupancy churn -------------
+
+
+@pytest.mark.parametrize(
+    "variant",
+    [
+        # fused alone is the heaviest variant and is subsumed for tier-1 by
+        # fused_kv_int8 (fused island + int8 rows); the decode_sp rung also
+        # gates it
+        pytest.param(v, marks=[pytest.mark.slow] if v == "fused" else [])
+        for v in sorted(VARIANTS)
+    ],
+)
+def test_sp2_greedy_parity(rng, devices, variant):
+    """sp=2 over 2 virtual CPU devices: per-shard flash partials + ONE
+    softmax combine reproduce the greedy trajectory for every engine
+    variant, with slots mid-churn at staggered positions (different
+    shard-local attended lengths every tick)."""
+    model, params = build(rng, **VARIANTS[variant])
+    base = _drain(
+        DecodeEngine(model, params, num_slots=3, filter_thres=0.0),
+        _requests(5),
+    )
+    mesh = make_mesh(dp=1, tp=1, sp=2, devices=jax.devices()[:2])
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=0.0,
+                          mesh=mesh)
+    sharded = _drain(engine, _requests(5))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: sp=2 != unsharded greedy ({variant})",
+        )
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+
+
+def test_sp2_mixed_attn_types(rng, devices):
+    """Non-"full" attention at sp > 1 takes the dense masked path with
+    mask COLUMNS permuted into storage order while GSPMD reads the
+    seq-sharded cache — the sparse layer must agree with the unsharded
+    engine too."""
+    model, params = build(rng, attn_types=("full", "sparse"))
+    base = _drain(
+        DecodeEngine(model, params, num_slots=2, filter_thres=0.0),
+        _requests(3),
+    )
+    mesh = make_mesh(dp=1, tp=1, sp=2, devices=jax.devices()[:2])
+    engine = DecodeEngine(model, params, num_slots=2, filter_thres=0.0,
+                          mesh=mesh)
+    sharded = _drain(engine, _requests(3))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: sp=2 mixed attn_types != unsharded",
+        )
+
+
+def test_sp4_greedy_parity(rng, devices):
+    """sp=4 (every position its own shard family on the 8-row cache):
+    the combine handles shards whose local cache is still empty."""
+    model, params = build(rng)
+    base = _drain(
+        DecodeEngine(model, params, num_slots=2, filter_thres=0.0),
+        _requests(3),
+    )
+    mesh = make_mesh(dp=1, tp=1, sp=4, devices=jax.devices()[:4])
+    engine = DecodeEngine(model, params, num_slots=2, filter_thres=0.0,
+                          mesh=mesh)
+    sharded = _drain(engine, _requests(3))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: sp=4 != unsharded greedy",
+        )
+    assert engine._tick_fn._cache_size() == 1
+
+
+# --- 3. 2D (tp, sp) composition -----------------------------------------
+
+
+@pytest.mark.parametrize("variant", ["plain", "kv_int8", "fused_kv_int8"])
+def test_tp2_sp2_parity(rng, devices, variant):
+    """The 2D decode mesh: KV leaves sharded P(None, 'tp', 'sp', None),
+    head-local flash partials per (tp, sp) tile, combine over sp, GSPMD
+    all-reduce over tp — greedy codes match the unsharded engine on 4
+    virtual CPU devices."""
+    model, params = build(rng, **VARIANTS[variant])
+    base = _drain(
+        DecodeEngine(model, params, num_slots=3, filter_thres=0.0),
+        _requests(4),
+    )
+    mesh = make_mesh(dp=1, tp=2, sp=2, devices=jax.devices()[:4])
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=0.0,
+                          mesh=mesh)
+    sharded = _drain(engine, _requests(4))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: tp=2 x sp=2 != unsharded greedy ({variant})",
+        )
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+
+
+def test_tp2_sp2_no_recompile_with_prefix_pool(rng, devices):
+    """All three jitted seams stay single-entry over the 2D mesh: plain
+    prefill admits, pooled (zero-prefill) admits whose block export /
+    merge crosses the cyclic storage permutation, and ticks across
+    occupancy churn."""
+    model, params = build(rng)
+    texts = np.random.RandomState(1).randint(1, 30, size=(2, T))
+
+    def mk(t, s):
+        return Request(text_tokens=texts[t], seed=s, temperature=1e-8,
+                       request_id=f"t{t}s{s}")
+
+    spec = [(0, 1), (1, 2), (0, 5), (1, 6)]  # 2 texts x 2 seeds
+
+    mesh = make_mesh(dp=1, tp=2, sp=2, devices=jax.devices()[:4])
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=0.0,
+                          mesh=mesh, prefix_pool=PrefixPool(1 << 20))
+    _drain(engine, [mk(*s) for s in spec])
+    assert engine.prefill_requests == 2 and engine.prefix_reuses == 2
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+    assert engine._admit_cached_fn._cache_size() == 1
+
+
+def test_sp2_prefix_pool_parity(rng, devices):
+    """Pooled admits at sp=2 reproduce the unsharded pooled codes: pool
+    entries are stored in GLOBAL position order (layout-independent), so
+    export gathers and merge scatters through the permutation tables."""
+    model, params = build(rng)
+    texts = np.random.RandomState(1).randint(1, 30, size=(2, T))
+
+    def mk(t, s):
+        return Request(text_tokens=texts[t], seed=s, temperature=1e-8,
+                       request_id=f"t{t}s{s}")
+
+    spec = [(0, 1), (1, 2), (0, 5), (1, 6)]
+    base = _drain(
+        DecodeEngine(model, params, num_slots=3, filter_thres=0.0,
+                     prefix_pool=PrefixPool(1 << 20)),
+        [mk(*s) for s in spec],
+    )
+    mesh = make_mesh(dp=1, tp=1, sp=2, devices=jax.devices()[:2])
+    engine = DecodeEngine(model, params, num_slots=3, filter_thres=0.0,
+                          mesh=mesh, prefix_pool=PrefixPool(1 << 20))
+    sharded = _drain(engine, [mk(*s) for s in spec])
+    assert engine.prefix_reuses == 2
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: sp=2 pooled admit != unsharded pooled",
+        )
+
+
+# --- 4. analytic sp byte terms ------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(
+        num_text_tokens=2000, text_seq_len=32, num_image_tokens=1024,
+        image_fmap_size=8, dim=64, depth=4, heads=4, dim_head=16,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def test_attn_bytes_sp_divides_full_layers():
+    """Per-chip HBM: "full" layers stream cache rows / sp (island-read,
+    fused semantics at sp > 1); non-"full" layers are unchanged."""
+    import jax.numpy as jnp
+
+    cfg = _cfg(attn_types=("full", "mlp"))
+    n, h, dh = cfg.total_seq_len, cfg.heads, cfg.dim_head
+    s_act = 2 if cfg.dtype == jnp.bfloat16 else 4
+    cache_row = h * n * dh * s_act
+    qo = 2 * h * dh * s_act
+    sp = 2
+    # 2 full layers: rows/sp + qo; 2 mlp layers: full rows + score rows
+    full = 2 * (2 * cache_row / sp + qo)
+    mlp = 2 * (2 * cache_row + qo + 2 * h * n * 4)
+    got = decode_tick_attn_bytes(cfg, 8, fused=False, sp=sp)
+    assert got == pytest.approx(8 * (full + mlp), rel=1e-12)
+    # sp=1 keyword default matches the legacy positional behaviour
+    assert decode_tick_attn_bytes(cfg, 8, fused=False) == \
+        decode_tick_attn_bytes(cfg, 8, fused=False, sp=1)
+
+
+def test_attn_bytes_sp2_cuts_45pct_at_flagship():
+    """The decode_sp rung's off-chip gate, restated: at the flagship
+    8-slot serving shape sp=2 cuts per-chip attention bytes >= 45%."""
+    cfg = _cfg(dim=1024, depth=24, heads=16, dim_head=64,
+               num_image_tokens=8192, image_fmap_size=16)
+    for fused in (False, True):
+        b1 = decode_tick_attn_bytes(cfg, 8, fused=fused, sp=1)
+        b2 = decode_tick_attn_bytes(cfg, 8, fused=fused, sp=2)
+        cut = 1.0 - b2 / b1
+        assert cut >= 0.45, f"sp=2 byte cut {cut:.3f} < 0.45 (fused={fused})"
+        b4 = decode_tick_attn_bytes(cfg, 8, fused=fused, sp=4)
+        assert b4 < b2 < b1
+
+
+def test_ici_bytes_sp_combine_closed_form():
+    """The combine moves (dim_head + 2) f32 values per (slot, head) per
+    "full" layer — pmax(m) + psum(w) + psum(w*V) cost one ring
+    all-reduce's 2(S-1)/S factor — and is always f32, independent of
+    decode_comm."""
+    cfg = _cfg(attn_types=("full", "mlp"))  # 2 full layers
+    slots, sp = 8, 2
+    b = decode_tick_ici_bytes(cfg, slots, {"sp": sp})
+    want = 2 * (sp - 1) / sp * slots * cfg.heads * (cfg.dim_head + 2) * 4.0 * 2
+    assert b["sp_combine"] == pytest.approx(want, rel=1e-12)
+    assert b["layers"] == 0.0 and b["head"] == 0.0  # tp=1: no tp terms
+    assert b["total"] == pytest.approx(want, rel=1e-12)
+    # decode_comm never changes the combine width
+    b_i8 = decode_tick_ici_bytes(cfg, slots, {"sp": sp}, decode_comm="int8")
+    assert b_i8["sp_combine"] == b["sp_combine"]
+
+
+def test_ici_bytes_2d_mesh_sums_axes():
+    """tp=2 x sp=2: the tp terms are exactly the tp-only model's, the sp
+    term exactly the sp-only model's — the 2D tick is their sum."""
+    cfg = _cfg()
+    tp_only = decode_tick_ici_bytes(cfg, 8, {"tp": 2}, decode_comm="int8")
+    sp_only = decode_tick_ici_bytes(cfg, 8, {"sp": 2}, decode_comm="int8")
+    both = decode_tick_ici_bytes(cfg, 8, {"tp": 2, "sp": 2},
+                                 decode_comm="int8")
+    assert both["layers"] == tp_only["layers"]
+    assert both["head"] == tp_only["head"]
+    assert both["sp_combine"] == sp_only["sp_combine"]
+    assert both["total"] == pytest.approx(
+        tp_only["layers"] + tp_only["head"] + sp_only["sp_combine"],
+        rel=1e-12)
+
+
+def test_ici_bytes_legacy_zero_dict():
+    """tp=1 and sp=1: the legacy 3-key all-zero dict, unchanged."""
+    cfg = _cfg()
+    assert decode_tick_ici_bytes(cfg, 8, {"dp": 8}) == {
+        "layers": 0.0, "head": 0.0, "total": 0.0,
+    }
+    z = decode_tick_ici_bytes(cfg, 8, {"sp": 1})
+    assert z == {"layers": 0.0, "head": 0.0, "total": 0.0}
+
+
+# --- 5. generate.py mesh composition validator --------------------------
+
+
+def _serve_args(tmp_path, *extra):
+    import generate
+
+    return generate.parse_args([
+        "--dalle_path", str(tmp_path / "ckpt"),
+        "--serve", "-", *extra,
+    ])
+
+
+def _write_meta(tmp_path, *, text_seq_len=4, image_fmap_size=2):
+    import json
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir(exist_ok=True)
+    (ckpt / "meta.json").write_text(json.dumps({
+        "format": "dalle_tpu/v3",
+        "hparams": {"text_seq_len": text_seq_len,
+                    "image_fmap_size": image_fmap_size},
+    }))
+
+
+def test_validate_mesh_sp_divisibility(tmp_path):
+    """--mesh_sp must divide the checkpoint's decode cache seq length —
+    caught from meta.json alone, BEFORE any params load."""
+    import generate
+
+    _write_meta(tmp_path)  # seq = 4 + 2**2 = 8
+    errs = generate.validate_serve_flags(
+        _serve_args(tmp_path, "--mesh_sp", "3"))
+    assert any("--mesh_sp 3 must divide" in e for e in errs), errs
+    assert not generate.validate_serve_flags(
+        _serve_args(tmp_path, "--mesh_sp", "2"))
+
+
+def test_validate_replicas_compose_with_sp(tmp_path, devices):
+    """--replicas now composes with --mesh_sp (replica-major (tp x sp)
+    groups); the training-only axes are still rejected, and the device
+    budget is replicas x tp x sp."""
+    import generate
+
+    _write_meta(tmp_path)
+    assert not generate.validate_serve_flags(
+        _serve_args(tmp_path, "--replicas", "2", "--mesh_sp", "2"))
+    errs = generate.validate_serve_flags(
+        _serve_args(tmp_path, "--replicas", "2", "--mesh_dp", "2"))
+    assert any("composes only with --mesh_tp/--mesh_sp" in e
+               for e in errs), errs
+    # 3 x tp2 x sp2 = 12 > the 8 virtual devices
+    errs = generate.validate_serve_flags(
+        _serve_args(tmp_path, "--replicas", "3",
+                    "--mesh_tp", "2", "--mesh_sp", "2"))
+    assert any("needs 12 devices" in e for e in errs), errs
+
+
+def test_fleet_mesh_sp_replica_major(rng, devices):
+    """Fleet(mesh_sp=2) carves replica-major sp-groups: 2 replicas x
+    (tp=1 x sp=2) = 4 devices, greedy codes match the unsharded fleet."""
+    from dalle_tpu.serving import Fleet
+
+    model, params = build(rng)
+
+    def run(**kw):
+        fleet = Fleet(model, params, replicas=2, num_slots=2,
+                      filter_thres=0.0, **kw)
+        fleet.warmup()
+        reqs = _requests(4)
+        for r in reqs:
+            fleet.submit(r)
+        fleet.close()
+        fleet.run()
+        return {r.request_id: np.asarray(r.codes) for r in reqs}
+
+    base = run()
+    sharded = run(mesh_sp=2, devices=jax.devices()[:4])
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], sharded[rid],
+            err_msg=f"{rid}: fleet mesh_sp=2 != unsharded fleet",
+        )

@@ -85,6 +85,7 @@ def test_conv_sp_matches_dense(rng, devices, ksize, dil, sp):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_conv_sp_pad_mask_and_grads(rng, devices):
     mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
     q, k, v = qkv(rng)

@@ -93,7 +93,14 @@ MESH_CASES = {
 }
 
 
-@pytest.mark.parametrize("name", list(MESH_CASES))
+@pytest.mark.parametrize(
+    "name",
+    [
+        # sp_usp/pp are multi-minute and need >1 core to be meaningful
+        pytest.param(n, marks=[pytest.mark.slow] if n in ("sp_usp", "pp") else [])
+        for n in MESH_CASES
+    ],
+)
 def test_multi_step_trajectory_matches_single_device(
     name, vae_and_params, single_trajectories
 ):

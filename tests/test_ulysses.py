@@ -77,6 +77,7 @@ def test_ulysses_grad_matches_dense(rng, devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_dalle_train_step_with_ulysses(rng, devices):
     """Full jitted train step with sp_mode='ulysses' on a dp×tp×sp mesh —
     the integration the dryrun exercises for ring."""
@@ -124,6 +125,7 @@ def test_ulysses_key_pad_mask(rng, devices):
     )
 
 
+@pytest.mark.slow
 def test_ulysses_flash_forced_matches_dense(rng, devices):
     """use_flash=True forces the Pallas kernel through the all_to_all
     re-shard (interpret mode off-TPU) — the --use_flash on/off override

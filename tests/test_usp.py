@@ -51,6 +51,7 @@ def test_usp_pure_ring_degenerate(rng, devices):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_usp_gradients_match_dense(rng, devices):
     mesh = make_mesh(dp=1, fsdp=1, tp=1, sp=4)
     q, k, v = qkv(rng)
@@ -103,6 +104,7 @@ def test_usp_composes_with_dp_tp(rng, devices):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_usp_dalle_train_step(rng, devices):
     """Full flagship-style train step with --sp_mode usp on the sp mesh."""
     from dalle_tpu.models.dalle import DALLE, DALLEConfig
@@ -183,6 +185,7 @@ def test_usp_zigzag_request_warns(rng, devices):
     )
 
 
+@pytest.mark.slow
 def test_usp_gqa_fused_ce_train_step(rng, devices):
     """The deepest production compose: GQA (grouped K/V transport) + USP
     hybrid SP + fused range-split CE in one sharded train step."""

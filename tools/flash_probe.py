@@ -58,6 +58,13 @@ CASES = [
     # attention-out all-reduce (parallel/compress.py) — the TP engine's
     # exact per-tick hot path, collectives included
     ("shard_tick_int8_1280", 1280, 64, "bfloat16", False, False),
+    # the SEQUENCE-PARALLEL decode tick (docs/SERVING.md §10): the same
+    # kernel in stats mode shard_mapped over an sp=2 mesh's seq axis
+    # (cyclic storage layout, partition.seq_storage_layout), per-shard
+    # partials merged by ONE online-softmax combine
+    # (flash.decode_softmax_combine) — the sp engine's per-tick hot
+    # path with its collective, in one jit
+    ("sp_tick_int8_1280", 1280, 64, "bfloat16", False, False),
     ("causal_bf16_4096", 4096, 64, "bfloat16", False, False),  # VQGAN-f8 scale
 ]
 
@@ -317,6 +324,101 @@ def _run_shard_case(name: str) -> dict:
     }
 
 
+def _run_sp_case(name: str) -> dict:
+    """The sequence-parallel decode tick: flash_decode_attention in
+    stats mode shard_mapped over an sp=2 mesh's seq axis — each shard
+    attends only its cyclically-assigned KV rows
+    (partition.seq_storage_layout) — merged by ONE online-softmax
+    combine (flash.decode_softmax_combine), in one jit.  Fwd-only like
+    the decode case; on CPU two virtual host devices are forced."""
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        # must land before jax initializes; shapes only the host platform
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        )
+    jax, jnp, import_s = _import_jax_for_probe()
+
+    from jax.sharding import PartitionSpec as P
+
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops.flash import (
+        decode_softmax_combine, flash_decode_attention,
+    )
+    from dalle_tpu.ops.quant import dequantize_rows, quantize_rows
+    from dalle_tpu.parallel.mesh import make_mesh, shard_map
+    from dalle_tpu.parallel.partition import seq_storage_layout
+
+    platform = jax.default_backend()
+    n, d = next((n_, d_) for nm, n_, d_, *_ in CASES if nm == name)
+    if len(jax.devices()) < 2:
+        return {"case": name, "platform": platform,
+                "error": "needs >= 2 devices for the sp=2 mesh"}
+    sp = 2
+    mesh = make_mesh(dp=1, sp=sp, devices=jax.devices()[:sp])
+    b, kv, g = 8, 8, 1
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, kv, g, d), jnp.bfloat16)
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, kv, n, d))
+    kq, ks = quantize_rows(kc)
+    vq, vs = quantize_rows(vc)
+    # staggered slot positions, as mid-churn occupancy would leave them
+    pos = jnp.arange(b, dtype=jnp.int32) * ((n - 1) // (b - 1))
+    # the engine stores rows in the cyclic balanced layout: shard r's
+    # contiguous block holds global positions {r, r+sp, ...}
+    _, g_of_s = seq_storage_layout(n, sp)
+    inv = jnp.asarray(g_of_s)  # storage row s holds global position g_of_s[s]
+    kq_s, ks_s = kq[:, :, inv], ks[:, :, inv]
+    vq_s, vs_s = vq[:, :, inv], vs[:, :, inv]
+
+    ss = P(None, None, "sp", None)
+
+    def body(q_, kq_, ks_, vq_, vs_, pos_):
+        r = jax.lax.axis_index("sp")
+        pos_loc = jnp.floor_divide(pos_ - r, sp)
+        o, m, l = flash_decode_attention(
+            q_, kq_, vq_, pos_loc, k_scale=ks_, v_scale=vs_,
+            return_stats=True,
+        )
+        return decode_softmax_combine(o, m, l, "sp")
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), ss, ss, ss, ss, P()),
+        out_specs=P(), check_vma=False,
+    ))
+    t0 = time.perf_counter()
+    out = fn(q, kq_s, ks_s, vq_s, vs_s, pos)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(q, kq_s, ks_s, vq_s, vs_s, pos)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+
+    mask = (jnp.arange(n)[None, :] <= pos[:, None])[:, None, None, :]
+    want = A._sdpa(q, dequantize_rows(kq, ks), dequantize_rows(vq, vs),
+                   mask)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    ref_scale = float(jnp.max(jnp.abs(want.astype(jnp.float32))))
+    return {
+        "case": name, "slots": b, "kv_heads": kv, "n": n, "d": d,
+        "sp": sp, "dtype": "bfloat16",
+        "platform": platform, "interpret": platform != "tpu",
+        "import_s": round(import_s, 1),
+        "fwd_compile_s": round(compile_s, 2),
+        "fwd_ms": round(ms, 3),
+        "fwd_max_err": round(err, 6),
+        # headroom for the kernel's bf16 accumulation plus the combine's
+        # single f32 reassociation
+        "numerics_ok": bool(err < 0.05 * max(ref_scale, 1.0)),
+    }
+
+
 def run_case(name: str) -> dict:
     """Child entry: compile+run fwd and bwd for one case, check numerics."""
     if name.startswith("dequant_int8"):
@@ -327,6 +429,8 @@ def run_case(name: str) -> dict:
         return _run_decode_case(name)
     if name.startswith("shard_tick"):
         return _run_shard_case(name)
+    if name.startswith("sp_tick"):
+        return _run_sp_case(name)
     n, d, dtype_name, sparse, masked = next(
         (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
     )

@@ -22,6 +22,13 @@ identical traffic — pick the smallest B whose p99 meets your SLO.
     python tools/serving_bench.py --quick --synth 16 --slots 4 \
         --mesh_tp 1,2 --decode_comm f32,int8
 
+    # sweep sequence-parallel decode (docs/SERVING.md §10), alone and
+    # composed with tp into the 2D decode mesh
+    python tools/serving_bench.py --quick --synth 16 --slots 4 \
+        --mesh_sp 1,2
+    python tools/serving_bench.py --quick --synth 16 --slots 4 \
+        --mesh_tp 2 --mesh_sp 2
+
 ``--quick`` runs a tiny randomly-initialized model (no checkpoint) —
 arrival *pattern* effects (queueing, admission stalls) reproduce fine at
 toy scale; absolute tokens/s obviously does not transfer.  Runs on
@@ -92,6 +99,15 @@ def parse_args(argv=None):
                          "TP-sharded engine (one Mesh per replica, "
                          "replica-major device groups).  On CPU the "
                          "virtual host devices are forced automatically")
+    ap.add_argument("--mesh_sp", type=str, default="1",
+                    help="comma-separated sp degrees to sweep "
+                         "(docs/SERVING.md §10); S>1 replays through a "
+                         "seq-sharded engine (KV rows split over "
+                         "positions, one softmax combine per tick).  "
+                         "Composes with --mesh_tp into a 2D (tp x sp) "
+                         "decode mesh; the cache seq length must divide "
+                         "by S.  On CPU the virtual host devices are "
+                         "forced automatically")
     ap.add_argument("--decode_comm", type=str, default="f32",
                     help="comma-separated wire widths for the per-tick TP "
                          "collectives (f32,bf16,int8; parallel/"
@@ -140,8 +156,9 @@ def main(argv=None):
 
     replica_counts = [int(r) for r in args.replicas.split(",")]
     tp_degrees = [int(t) for t in args.mesh_tp.split(",")]
+    sp_degrees = [int(s) for s in args.mesh_sp.split(",")]
     comm_modes = args.decode_comm.split(",")
-    need_devices = max(replica_counts) * max(tp_degrees)
+    need_devices = max(replica_counts) * max(tp_degrees) * max(sp_degrees)
     if (need_devices > 1
             and "host_platform_device_count" not in
             os.environ.get("XLA_FLAGS", "")):
@@ -218,7 +235,7 @@ def main(argv=None):
     if args.prefix_pool_bytes > 0:
         cache_kw["prefix_pool_bytes"] = args.prefix_pool_bytes
 
-    def run(policy, slots, cached, replicas=1, tp=1, comm="f32"):
+    def run(policy, slots, cached, replicas=1, tp=1, sp=1, comm="f32"):
         codes = {}
         kw = dict(cache_kw) if cached else {}
         if cached and not kw:  # --compare_cache with no explicit budgets
@@ -227,18 +244,22 @@ def main(argv=None):
         m = model
         if tp > 1:
             # sharded decode (docs/SERVING.md §9): set the collective
-            # wire width on the model, then shard over a tp mesh —
-            # per-replica (mesh_tp=) under a fleet, one global mesh else
+            # wire width on the model (the tp all-reduces; the sp
+            # combine is always f32)
             from dalle_tpu.models.quantize import decode_comm_model
 
             m = decode_comm_model(model, comm)
+        if tp > 1 or sp > 1:
+            # 2D decode mesh (docs/SERVING.md §9-10) — per-replica
+            # (mesh_tp=/mesh_sp=) under a fleet, one global mesh else
             if replicas > 1:
                 kw["mesh_tp"] = tp
+                kw["mesh_sp"] = sp
             else:
                 from dalle_tpu.parallel.mesh import make_mesh
 
-                kw["mesh"] = make_mesh(dp=1, tp=tp,
-                                       devices=jax.devices()[:tp])
+                kw["mesh"] = make_mesh(dp=1, tp=tp, sp=sp,
+                                       devices=jax.devices()[:tp * sp])
         stats = replay_trace(
             m, params, trace, policy=policy, num_slots=slots,
             filter_thres=args.filter_thres, time_scale=args.time_scale,
@@ -262,22 +283,27 @@ def main(argv=None):
                     for tp in tp_degrees:
                         if tp > 1 and policy != "continuous":
                             continue  # sharded engine sweeps the lever
-                        for comm in comm_modes:
-                            if comm != "f32" and tp == 1:
-                                continue  # quantized AR needs tp > 1
-                            if tp == 1 and comm != comm_modes[0]:
-                                continue  # unsharded row printed once
-                            stats, _ = run(
-                                policy, slots, cached=bool(cache_kw),
-                                replicas=replicas, tp=tp, comm=comm,
-                            )
-                            stats.pop("per_replica", None)
-                            stats["replicas"] = replicas
-                            stats["mesh_tp"] = tp
-                            stats["decode_comm"] = (
-                                comm if tp > 1 else None
-                            )
-                            print(json.dumps(stats))
+                        for sp in sp_degrees:
+                            if sp > 1 and policy != "continuous":
+                                continue
+                            for comm in comm_modes:
+                                if comm != "f32" and tp == 1:
+                                    continue  # quantized AR needs tp > 1
+                                if tp == 1 and comm != comm_modes[0]:
+                                    continue  # unsharded row printed once
+                                stats, _ = run(
+                                    policy, slots, cached=bool(cache_kw),
+                                    replicas=replicas, tp=tp, sp=sp,
+                                    comm=comm,
+                                )
+                                stats.pop("per_replica", None)
+                                stats["replicas"] = replicas
+                                stats["mesh_tp"] = tp
+                                stats["mesh_sp"] = sp
+                                stats["decode_comm"] = (
+                                    comm if tp > 1 else None
+                                )
+                                print(json.dumps(stats))
                 continue
             # cached vs uncached over the SAME trace: the cached pass
             # must produce bitwise-identical codes while paying device
