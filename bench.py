@@ -68,6 +68,11 @@ CACHE_DIR = os.path.join(REPO, ".jax_cache")
 # needs_chip=False phases are host-side and still run/record when the chip
 # has wedged mid-run.
 PHASES = [
+    # static invariant gate (docs/LINT.md): tools/graftlint.py over the
+    # whole tree — pure-AST, sub-second, host-side.  Runs FIRST so a
+    # broken contract (policy drift, recompile hazard, unregistered
+    # event kind) is named before any chip time is spent on it
+    ("lint", 120, False),
     ("flash_probe", 1150, True),  # tools/flash_probe.py: kernel-only, per-case subprocesses (7 cases x 150s worst case incl. the int8-dequant and ring-lse kernels)
     ("train_tiny", 480, True),
     ("train", 1200, True),        # flagship, dense XLA attention (can't hang in Mosaic)
@@ -178,7 +183,10 @@ PHASE_ARGV = {
 # main) so the tail rungs aren't silently starved on a tuned run.
 _TUNE_BUDGET_S = 600
 if os.environ.get("BENCH_TUNE"):
-    PHASES.insert(1, ("flash_tune", _TUNE_BUDGET_S, True))
+    PHASES.insert(
+        [p[0] for p in PHASES].index("flash_probe") + 1,
+        ("flash_tune", _TUNE_BUDGET_S, True),
+    )
 RUNGS_PATH = os.path.join(LOG_DIR, "rungs.jsonl")
 
 _PREFLIGHT_CODE = """
@@ -1790,6 +1798,49 @@ def _comms_budget_bench():
     }
 
 
+def _lint_bench():
+    """Static invariant gate: tools/graftlint.py --format json over the
+    whole tree (docs/LINT.md).  Pure-AST and jax-free, so it runs in a
+    subprocess in ~1s and records the per-rule counts as evidence; any
+    unsuppressed finding (or a malformed baseline, rc=2) sets
+    ``rung_failed`` with the findings inline."""
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         "--format", "json"],
+        capture_output=True, text=True, timeout=100, cwd=REPO,
+    )
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        report = {}
+    res = {
+        "exit_code": proc.returncode,
+        "files_scanned": report.get("files_scanned"),
+        "rules_run": report.get("rules_run"),
+        "counts": report.get("counts"),
+        "suppressed_inline": report.get("suppressed_inline"),
+        "suppressed_baseline": report.get("suppressed_baseline"),
+        "stale_baseline": report.get("stale_baseline"),
+        "lint_s": report.get("duration_s"),
+    }
+    _hb(
+        f"lint: rc={proc.returncode} files={res['files_scanned']} "
+        f"inline={res['suppressed_inline']} "
+        f"baselined={res['suppressed_baseline']}"
+    )
+    if proc.returncode != 0:
+        findings = report.get("findings") or []
+        detail = "; ".join(
+            f"{f['path']}:{f['line']} [{f['rule']}]" for f in findings[:8]
+        ) or (proc.stderr or proc.stdout).strip()[:500]
+        res["rung_failed"] = (
+            f"graftlint exit {proc.returncode}: {detail}"[:2000]
+        )
+    res["wall_s"] = round(time.time() - t0, 1)
+    return res
+
+
 def _ingest_bench():
     from dalle_tpu.data.ingest_bench import ingest_benchmark
 
@@ -2250,6 +2301,7 @@ def _serving_fleet_bench():
 
 
 PHASE_FNS = {
+    "lint": _lint_bench,
     "train_tiny": lambda: _train_bench(tiny=True),
     "train": _train_bench,
     "train_fused": lambda: _train_bench(loss_chunk=256),

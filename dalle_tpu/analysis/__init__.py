@@ -1,0 +1,25 @@
+"""graftlint — the repo's AST invariant linter (docs/LINT.md).
+
+Pure stdlib: this package must never import jax/flax/numpy at module
+scope, so ``python tools/graftlint.py`` stays a sub-second AST pass that
+can run as a pre-commit hook and a tier-1 test.  Eleven PRs of growth
+accumulated load-bearing invariants that existed only as convention —
+the compute-policy pop lists, the event schema, the no-recompile /
+donation rules on the jitted seams, the f32 accumulation contracts, the
+lock discipline in serving — and every one of them has either drifted
+already or sits in the blast radius of the next refactor (ROADMAP items
+1, 2, 5).  These rules are the safety net that lets those PRs move.
+
+Layout:
+
+* :mod:`walker`   — module loading, Finding, Rule base, suppressions;
+* :mod:`rules`    — one module per rule, registered in ``ALL_RULES``;
+* :mod:`baseline` — reviewed suppression file (tools/lint_baseline.json);
+* :mod:`report`   — text / JSON rendering;
+* :mod:`cli`      — the driver behind ``tools/graftlint.py`` and the
+  ``graftlint`` console script.
+"""
+
+from dalle_tpu.analysis.walker import Finding, LintContext, Rule  # noqa: F401
+
+__all__ = ["Finding", "LintContext", "Rule"]

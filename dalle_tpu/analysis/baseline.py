@@ -1,0 +1,121 @@
+"""Reviewed suppression file support (tools/lint_baseline.json).
+
+The baseline is the reviewed debt ledger: findings that predate a rule
+(or are accepted false positives of a heuristic rule) live here with a
+one-line justification each, so ``graftlint`` exits 0 on the tree while
+every NEW violation still fails.  Entries match on (rule, path, message)
+— not line numbers, which churn under unrelated edits.
+
+Two invariants the loader enforces (exit 2 at the driver, not a silent
+pass):
+
+* every entry carries a non-empty ``justification`` — an unreviewed
+  waiver is exactly the drift this linter exists to stop;
+* the file parses as ``{"version": 1, "entries": [...]}``.
+
+Stale entries (matching no current finding) are reported so the ledger
+shrinks as debt is paid; they are a warning, not a failure, because a
+fix and the baseline edit may land in different commits of one PR.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+from dalle_tpu.analysis.walker import Finding
+
+VERSION = 1
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — the driver exits 2, never 'clean'."""
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str
+    message: str
+    justification: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    """Parse + validate a baseline file.  Missing file == empty ledger."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except FileNotFoundError:
+        return []
+    except json.JSONDecodeError as e:
+        raise BaselineError(f"{path}: not valid JSON: {e}") from e
+    if not isinstance(raw, dict) or raw.get("version") != VERSION:
+        raise BaselineError(
+            f"{path}: expected {{'version': {VERSION}, 'entries': [...]}}"
+        )
+    entries = []
+    for i, e in enumerate(raw.get("entries", [])):
+        missing = [k for k in ("rule", "path", "message") if not e.get(k)]
+        if missing:
+            raise BaselineError(
+                f"{path}: entries[{i}] missing {', '.join(missing)}"
+            )
+        just = str(e.get("justification", "")).strip()
+        if not just:
+            raise BaselineError(
+                f"{path}: entries[{i}] ({e['rule']} @ {e['path']}) has no "
+                "justification — every baselined finding must say why it "
+                "is acceptable"
+            )
+        entries.append(
+            BaselineEntry(e["rule"], e["path"], e["message"], just)
+        )
+    return entries
+
+
+def apply_baseline(
+    findings: Sequence[Finding], entries: Sequence[BaselineEntry]
+) -> Tuple[List[Finding], int, List[BaselineEntry]]:
+    """(unsuppressed findings, n suppressed, stale entries).
+
+    One entry suppresses every finding with its key — a rule firing
+    twice on identical (path, message) is one reviewed decision."""
+    table: Dict[Tuple[str, str, str], BaselineEntry] = {
+        e.key(): e for e in entries
+    }
+    used = set()
+    kept: List[Finding] = []
+    n = 0
+    for f in findings:
+        if f.key() in table:
+            used.add(f.key())
+            n += 1
+        else:
+            kept.append(f)
+    stale = [e for e in entries if e.key() not in used]
+    return kept, n, stale
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Serialize current findings as a baseline SKELETON: justifications
+    are left empty on purpose, so the file fails validation until a
+    human reviews each entry and says why it may stand."""
+    payload = {
+        "version": VERSION,
+        "entries": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "message": f.message,
+                "justification": "",
+            }
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")

@@ -1,0 +1,197 @@
+"""The graftlint driver (``tools/graftlint.py`` / ``graftlint`` script).
+
+Modes:
+
+* ``graftlint``                      — whole tree, text report;
+* ``graftlint --changed``            — only files touched vs HEAD
+  (staged + unstaged + untracked), for pre-commit speed; whole-tree
+  checks that need every callsite (dead event kinds) are skipped;
+* ``graftlint --rule policy-sync --rule f32-accum`` — a rule subset;
+* ``graftlint --format json``        — machine output (bench.py lint
+  phase, CI);
+* ``graftlint --write-baseline``     — snapshot current findings into
+  the baseline file with EMPTY justifications (the file then fails
+  validation until a reviewer fills each one in — by design).
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 configuration error
+(unknown rule, malformed baseline).  Keep this module jax-free: the
+whole point is a sub-second pass importable anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Set
+
+from dalle_tpu.analysis import baseline as baseline_mod
+from dalle_tpu.analysis import report as report_mod
+from dalle_tpu.analysis.rules import ALL_RULES, get_rules
+from dalle_tpu.analysis.walker import (
+    LintContext, apply_suppressions, collect_modules, framework_findings,
+)
+
+DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
+
+
+def repo_root() -> str:
+    """Repo root = two levels above this package (…/dalle_tpu/analysis)."""
+    return os.path.normpath(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+    )
+
+
+def changed_files(root: str) -> Set[str]:
+    """Repo-relative paths changed vs HEAD: staged, unstaged, untracked."""
+    out: Set[str] = set()
+    cmds = [
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "diff", "--name-only", "--cached"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ]
+    for cmd in cmds:
+        try:
+            res = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode == 0:
+            out.update(
+                ln.strip() for ln in res.stdout.splitlines() if ln.strip()
+            )
+    return {p for p in out if p.endswith(".py")}
+
+
+def run_lint(root: str, *, rules: Optional[List[str]] = None,
+             selected: Optional[Set[str]] = None,
+             baseline_path: Optional[str] = None,
+             whole_tree: bool = True) -> report_mod.LintResult:
+    """Programmatic entry (tests, bench.py).  Raises KeyError on an
+    unknown rule name and BaselineError on a malformed baseline."""
+    t0 = time.monotonic()
+    modules = collect_modules(root)
+    ctx = LintContext(
+        root=root, modules=modules, selected=selected,
+        whole_tree=whole_tree and selected is None,
+    )
+    active = get_rules(rules or [])
+    findings = list(framework_findings(ctx))
+    for rule in active:
+        findings.extend(rule.run(ctx))
+    findings, n_inline = apply_suppressions(modules, findings)
+
+    n_base = 0
+    stale: list = []
+    if baseline_path:
+        entries = baseline_mod.load_baseline(baseline_path)
+        findings, n_base, stale = baseline_mod.apply_baseline(
+            findings, entries
+        )
+    return report_mod.LintResult(
+        findings=findings,
+        files_scanned=sum(
+            1 for m in modules
+            if selected is None or m.rel in selected
+        ),
+        rules_run=[r.name for r in active],
+        suppressed_inline=n_inline,
+        suppressed_baseline=n_base,
+        stale_baseline=stale,
+        duration_s=time.monotonic() - t0,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST invariant linter for this repo (docs/LINT.md)",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="tree to lint (default: this repo)",
+    )
+    ap.add_argument(
+        "--rule", action="append", default=[],
+        metavar="NAME", help=f"run a rule subset (known: "
+        f"{', '.join(sorted(ALL_RULES))}); repeatable",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs HEAD (pre-commit mode; "
+        "skips whole-tree dead-kind detection)",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help=f"suppression ledger (default {DEFAULT_BASELINE} under "
+        "the root; 'none' disables)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="snapshot current findings as a baseline skeleton with "
+        "empty justifications, then exit 1 until they are reviewed",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(ALL_RULES):
+            print(f"{name:20s} {ALL_RULES[name].summary}")
+        return 0
+
+    root = os.path.abspath(args.root or repo_root())
+    if args.baseline == "none":
+        baseline_path = None
+    else:
+        baseline_path = args.baseline or os.path.join(
+            root, DEFAULT_BASELINE
+        )
+
+    selected: Optional[Set[str]] = None
+    if args.changed:
+        selected = changed_files(root)
+        if not selected:
+            print("graftlint: no changed .py files")
+            return 0
+
+    try:
+        if args.write_baseline:
+            res = run_lint(
+                root, rules=args.rule, selected=selected,
+                baseline_path=None,
+            )
+            path = baseline_path or os.path.join(root, DEFAULT_BASELINE)
+            baseline_mod.write_baseline(path, res.findings)
+            print(
+                f"graftlint: wrote {len(res.findings)} entries to {path} "
+                "— fill in every justification before committing"
+            )
+            return 1 if res.findings else 0
+        res = run_lint(
+            root, rules=args.rule, selected=selected,
+            baseline_path=baseline_path,
+        )
+    except KeyError as e:
+        print(f"graftlint: {e.args[0]}", file=sys.stderr)
+        return 2
+    except baseline_mod.BaselineError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    out = (report_mod.render_json(res) if args.format == "json"
+           else report_mod.render_text(res))
+    print(out)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Rendering for graftlint results: human text and machine JSON.
+
+The JSON shape is stable (consumed by bench.py's ``lint`` phase and any
+CI glue): one object with ``findings`` (each ``{rule, path, line,
+message}``), per-rule ``counts``, scan/suppression bookkeeping, and
+``ok`` mirroring the process exit."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+from dalle_tpu.analysis.baseline import BaselineEntry
+from dalle_tpu.analysis.walker import Finding
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    rules_run: List[str] = field(default_factory=list)
+    suppressed_inline: int = 0
+    suppressed_baseline: int = 0
+    stale_baseline: List[BaselineEntry] = field(default_factory=list)
+    duration_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def _sorted(findings: Sequence[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def render_text(res: LintResult) -> str:
+    lines = [str(f) for f in _sorted(res.findings)]
+    for e in res.stale_baseline:
+        lines.append(
+            f"warning: stale baseline entry [{e.rule}] {e.path}: "
+            f"{e.message!r} matches nothing — remove it from the ledger"
+        )
+    tally = ", ".join(
+        f"{k}={v}" for k, v in sorted(res.counts().items())
+    ) or "none"
+    lines.append(
+        f"graftlint: {len(res.findings)} finding(s) ({tally}) across "
+        f"{res.files_scanned} files, {len(res.rules_run)} rules in "
+        f"{res.duration_s:.2f}s "
+        f"({res.suppressed_inline} inline-suppressed, "
+        f"{res.suppressed_baseline} baselined)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(res: LintResult) -> str:
+    return json.dumps(
+        {
+            "ok": res.ok,
+            "findings": [f.to_dict() for f in _sorted(res.findings)],
+            "counts": res.counts(),
+            "files_scanned": res.files_scanned,
+            "rules_run": res.rules_run,
+            "suppressed_inline": res.suppressed_inline,
+            "suppressed_baseline": res.suppressed_baseline,
+            "stale_baseline": [
+                {"rule": e.rule, "path": e.path, "message": e.message}
+                for e in res.stale_baseline
+            ],
+            "duration_s": round(res.duration_s, 3),
+        },
+        indent=2,
+    )

@@ -1,0 +1,41 @@
+"""Rule registry: one module per rule, all instances in ``ALL_RULES``.
+
+Adding a rule = new module here defining a :class:`~..walker.Rule`
+subclass + a registry row + fixture tests (firing AND clean) in
+tests/test_graftlint.py + a docs/LINT.md catalog row."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from dalle_tpu.analysis.rules.donation_after_use import DonationAfterUseRule
+from dalle_tpu.analysis.rules.event_kinds import EventKindsRule
+from dalle_tpu.analysis.rules.f32_accum import F32AccumRule
+from dalle_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from dalle_tpu.analysis.rules.policy_sync import PolicySyncRule
+from dalle_tpu.analysis.rules.recompile_hazard import RecompileHazardRule
+from dalle_tpu.analysis.walker import Rule
+
+ALL_RULES: Dict[str, Rule] = {
+    r.name: r
+    for r in (
+        PolicySyncRule(),
+        EventKindsRule(),
+        RecompileHazardRule(),
+        DonationAfterUseRule(),
+        F32AccumRule(),
+        LockDisciplineRule(),
+    )
+}
+
+
+def get_rules(names: Iterable[str] = ()) -> List[Rule]:
+    names = list(names)
+    if not names:
+        return list(ALL_RULES.values())
+    unknown = [n for n in names if n not in ALL_RULES]
+    if unknown:
+        raise KeyError(
+            f"unknown rule(s) {unknown}; known: {sorted(ALL_RULES)}"
+        )
+    return [ALL_RULES[n] for n in names]

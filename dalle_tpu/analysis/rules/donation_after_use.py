@@ -1,0 +1,245 @@
+"""donation-after-use: never read a buffer you just donated.
+
+The bug class: the engine tick and all three train steps donate their
+state (``donate_argnums``) so XLA reuses the input buffers in place.
+Reading the donated Python reference afterwards touches a deleted
+buffer — jax raises on CPU, but on TPU with async dispatch the error
+surfaces as a delayed, hard-to-attribute crash (PR 2 added post-restore
+donation copy guards in the trainers for exactly this).  Convention
+until now; this rule makes it static.
+
+Mechanics (per module, by AST):
+
+* registrations: ``X = jax.jit(f, donate_argnums=(…))`` /
+  ``self.X = jax.jit(…)`` bind X as a donating callable with literal
+  donated positions; ``@partial(jax.jit, donate_argnums=…)`` (or
+  ``@jax.jit`` called with the kwarg) binds the decorated function name.
+* per function scope, a source-order scan: a call of a donating
+  callable marks the plain-name / ``self.attr`` arguments at donated
+  positions; a later *load* of that name before a *store* to it is a
+  finding.  The canonical safe shape ``state = tick(params, state)``
+  stays clean (the store rebinds immediately after the call).
+
+The scan is branch-aware where it matters: ``if``/``else`` arms are
+simulated separately and a branch that ends in ``return``/``raise``
+cannot leak its donations past the ``if`` — so the train loop's
+``if anomaly: out = jstep(…); return …`` arm does not poison the
+plain-path call below it.  Marks surviving BOTH live arms merge
+conservatively (donated in either arm counts).  Textual order inside
+loop bodies remains the documented approximation: a donation at the
+loop tail read again at the head next iteration is not caught unless it
+also reads later in source.  Keep donating calls in the ``x = f(x)``
+shape and the rule (and XLA) stay happy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dalle_tpu.analysis.walker import (
+    Finding, LintContext, Module, Rule, call_name, int_literals,
+)
+
+
+def _is_jit_name(name: Optional[str]) -> bool:
+    return name is not None and (name == "jit" or name.endswith(".jit"))
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return int_literals(kw.value) or ()
+    return ()
+
+
+def collect_donating(module: Module) -> Dict[str, Tuple[int, ...]]:
+    """{callable name: donated positions}.  Names are dotted strings as
+    they appear at callsites ("jstep", "self._tick_fn", "f")."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    assert module.tree is not None
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if not _is_jit_name(call_name(call.func)):
+                continue
+            pos = _donate_positions(call)
+            if not pos:
+                continue
+            for t in node.targets:
+                tname = call_name(t)
+                if tname is not None:
+                    out[tname] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                dname = call_name(dec.func)
+                if _is_jit_name(dname):
+                    pos = _donate_positions(dec)
+                elif (dname == "partial" or (dname or "").endswith(
+                        ".partial")) and dec.args \
+                        and _is_jit_name(call_name(dec.args[0])):
+                    pos = _donate_positions(dec)
+                else:
+                    continue
+                if pos:
+                    out[node.name] = pos
+    return out
+
+
+def _events(node: ast.AST, out: List[Tuple[str, object, ast.AST]]) -> None:
+    """Flatten a function body into execution-ordered events:
+    ("load"/"store", dotted name, node) and ("call", Call node, node).
+    Assign visits value before targets; a Call's argument loads precede
+    its own event (donation happens AT the call, after the arg reads)."""
+    if isinstance(node, ast.Assign):
+        _events(node.value, out)
+        for t in node.targets:
+            _events(t, out)
+    elif isinstance(node, ast.AugAssign):
+        # target is read, combined, then written
+        tname = call_name(node.target)
+        if tname is not None:
+            out.append(("load", tname, node.target))
+        _events(node.value, out)
+        if tname is not None:
+            out.append(("store", tname, node.target))
+    elif isinstance(node, (ast.Name, ast.Attribute)):
+        dotted = call_name(node)
+        if dotted is not None:
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, ast.Store):
+                out.append(("store", dotted, node))
+            elif isinstance(ctx, ast.Load):
+                # a load of state.pos is a load of donated `state` too:
+                # emit every dotted prefix, root first
+                parts = dotted.split(".")
+                for i in range(1, len(parts) + 1):
+                    out.append(("load", ".".join(parts[:i]), node))
+            elif isinstance(ctx, ast.Del):
+                out.append(("store", dotted, node))  # del unbinds: safe
+    elif isinstance(node, ast.Call):
+        for child in ast.iter_child_nodes(node):
+            _events(child, out)
+        out.append(("call", node, node))
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda, ast.ClassDef)):
+        return  # nested scopes have their own pass / own semantics
+    else:
+        for child in ast.iter_child_nodes(node):
+            _events(child, out)
+
+
+class DonationAfterUseRule(Rule):
+    name = "donation-after-use"
+    summary = (
+        "a local passed at a donate_argnums position must not be read "
+        "after the donating call"
+    )
+
+    def _sim_events(self, module: Module,
+                    events: List[Tuple[str, object, ast.AST]],
+                    donated: Dict[str, ast.Call],
+                    donating: Dict[str, Tuple[int, ...]],
+                    findings: List[Finding]) -> None:
+        for kind, payload, node in events:
+            if kind == "call":
+                call = payload  # type: ignore[assignment]
+                cname = call_name(call.func)  # type: ignore[attr-defined]
+                if cname in donating:
+                    for p in donating[cname]:
+                        args = call.args  # type: ignore[attr-defined]
+                        if p < len(args):
+                            aname = call_name(args[p])
+                            if aname is not None:
+                                donated[aname] = call
+            elif kind == "store":
+                donated.pop(payload, None)  # rebound: old buffer gone
+            elif kind == "load" and payload in donated:
+                call = donated.pop(payload)  # one finding per donation
+                findings.append(self.finding(
+                    module, node.lineno,
+                    f"{payload!r} is read after being donated at line "
+                    f"{call.lineno} "  # type: ignore[attr-defined]
+                    "(donate_argnums) — the buffer is deleted by XLA; "
+                    "rebind the result or copy before the call",
+                ))
+
+    def _sim_expr(self, module: Module, node: ast.AST,
+                  donated: Dict[str, ast.Call],
+                  donating: Dict[str, Tuple[int, ...]],
+                  findings: List[Finding]) -> None:
+        events: List[Tuple[str, object, ast.AST]] = []
+        _events(node, events)
+        self._sim_events(module, events, donated, donating, findings)
+
+    def _sim_stmts(self, module: Module, stmts: List[ast.stmt],
+                   donated: Dict[str, ast.Call],
+                   donating: Dict[str, Tuple[int, ...]],
+                   findings: List[Finding]) -> bool:
+        """Simulate a statement list; True when it definitely terminates
+        (ends in return/raise on every path)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes have their own pass
+            if isinstance(stmt, ast.If):
+                self._sim_expr(module, stmt.test, donated, donating,
+                               findings)
+                b = dict(donated)
+                bterm = self._sim_stmts(module, stmt.body, b, donating,
+                                        findings)
+                o = dict(donated)
+                oterm = self._sim_stmts(module, stmt.orelse, o, donating,
+                                        findings)
+                donated.clear()
+                if bterm and oterm:
+                    return True  # nothing reachable below
+                if bterm:
+                    donated.update(o)
+                elif oterm:
+                    donated.update(b)
+                else:
+                    # donated in either live arm counts (conservative)
+                    donated.update(b)
+                    donated.update(o)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._sim_expr(module, item.context_expr, donated,
+                                   donating, findings)
+                    if item.optional_vars is not None:
+                        self._sim_expr(module, item.optional_vars,
+                                       donated, donating, findings)
+                if self._sim_stmts(module, stmt.body, donated, donating,
+                                   findings):
+                    return True
+                continue
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                self._sim_expr(module, stmt, donated, donating, findings)
+                return True
+            # everything else (loops, try, plain statements) keeps the
+            # documented linear approximation
+            self._sim_expr(module, stmt, donated, donating, findings)
+        return False
+
+    def _check_scope(self, module: Module, fn: ast.AST,
+                     donating: Dict[str, Tuple[int, ...]]
+                     ) -> Iterator[Finding]:
+        body = fn.body if hasattr(fn, "body") else [fn]
+        donated: Dict[str, ast.Call] = {}
+        findings: List[Finding] = []
+        self._sim_stmts(module, body, donated, donating, findings)
+        yield from findings
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        for module in ctx.iter_selected():
+            if module.tree is None:
+                continue
+            donating = collect_donating(module)
+            if not donating:
+                continue
+            for fn in ast.walk(module.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_scope(module, fn, donating)

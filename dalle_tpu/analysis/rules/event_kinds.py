@@ -1,0 +1,140 @@
+"""event-kinds: every ``log_event`` kind is registered, none are dead.
+
+Absorbs tools/check_events.py (which is now a thin shim over this rule)
+and extends it with dead-kind detection: a kind declared in
+``dalle_tpu/telemetry/schema.py`` that no scanned callsite ever emits is
+schema rot — consumers (telemetry_report, dashboards) believe a failure
+mode is observable when nothing can produce it.
+
+Checks per callsite (unchanged semantics from the shim era):
+
+* literal first arg  -> must be a registered kind;
+* dynamic first arg  -> only the ``Run.log_event`` forwarder in
+  ``dalle_tpu/training/logging.py`` may do that;
+* zero args          -> malformed call.
+
+The kinds table is read by AST from the scanned tree's schema.py when
+present (so fixture trees can carry their own schema); otherwise it
+falls back to this repo's packaged schema file — never an import, so
+the linter stays jax-free.  Dead-kind detection needs every callsite
+and is skipped on ``--changed`` runs and on trees without a schema.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dalle_tpu.analysis.walker import (
+    Finding, LintContext, Module, Rule,
+)
+
+SCHEMA_PATH = "dalle_tpu/telemetry/schema.py"
+FORWARDER_PATH = "dalle_tpu/training/logging.py"
+TABLE_NAME = "EVENT_KINDS"
+
+#: fallback schema location: this repo's own copy, resolved relative to
+#: the analysis package so the shim works on arbitrary scan roots
+_PACKAGED_SCHEMA = os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..", "..",
+                 "telemetry", "schema.py")
+)
+
+
+def parse_kinds(tree: ast.Module) -> Dict[str, int]:
+    """{kind: lineno} from the EVENT_KINDS dict literal, {} if absent."""
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == TABLE_NAME \
+                    and isinstance(value, ast.Dict):
+                return {
+                    k.value: k.lineno
+                    for k in value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+    return {}
+
+
+def _is_log_event_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Name) and f.id == "log_event") or (
+        isinstance(f, ast.Attribute) and f.attr == "log_event"
+    )
+
+
+def load_kinds(ctx: LintContext) -> Tuple[Dict[str, int], Optional[Module]]:
+    """(kinds table, in-tree schema Module or None)."""
+    schema = ctx.module(SCHEMA_PATH)
+    if schema is not None and schema.tree is not None:
+        return parse_kinds(schema.tree), schema
+    try:
+        with open(_PACKAGED_SCHEMA, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=_PACKAGED_SCHEMA)
+    except (OSError, SyntaxError):
+        return {}, None
+    return parse_kinds(tree), None
+
+
+class EventKindsRule(Rule):
+    name = "event-kinds"
+    summary = (
+        "log_event kinds are registered in telemetry/schema.py; "
+        "registered kinds are actually emitted somewhere"
+    )
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        kinds, schema = load_kinds(ctx)
+        if not kinds:
+            return  # no schema anywhere: nothing to validate against
+        emitted = set()
+        for m in ctx.modules:  # full tree: dead-kind needs every emitter
+            if m.tree is None:
+                continue
+            in_selection = ctx.selected is None or m.rel in ctx.selected
+            for node in ast.walk(m.tree):
+                if not (isinstance(node, ast.Call)
+                        and _is_log_event_call(node)):
+                    continue
+                if not node.args:
+                    if in_selection:
+                        yield self.finding(
+                            m, node.lineno, "log_event() with no kind"
+                        )
+                    continue
+                first = node.args[0]
+                if isinstance(first, ast.Constant) \
+                        and isinstance(first.value, str):
+                    emitted.add(first.value)
+                    if first.value not in kinds and in_selection:
+                        yield self.finding(
+                            m, node.lineno,
+                            f"unknown event kind {first.value!r} — "
+                            "register it in "
+                            "dalle_tpu/telemetry/schema.py",
+                        )
+                elif m.rel != FORWARDER_PATH and in_selection:
+                    yield self.finding(
+                        m, node.lineno,
+                        "non-literal event kind — only the forwarder "
+                        f"in {FORWARDER_PATH} may do that",
+                    )
+        # dead kinds: only meaningful over the whole tree, with the
+        # schema itself part of the scanned set
+        if schema is not None and ctx.whole_tree:
+            for kind, line in sorted(kinds.items()):
+                if kind not in emitted:
+                    yield self.finding(
+                        schema, line,
+                        f"dead event kind {kind!r}: registered in the "
+                        "schema but no scanned callsite ever emits it — "
+                        "fire it or drop the row",
+                    )

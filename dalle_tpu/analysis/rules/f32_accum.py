@@ -1,0 +1,133 @@
+"""f32-accum: exp/log-space reductions in ops/ accumulate in float32.
+
+The bug class (fixed by hand in PR 6): sampling math that ran in the
+bf16 stream dtype degraded — the old logits→softmax→cumsum nucleus
+chain lost mass in bf16 and the fix was "ALL sampling math f32
+regardless of stream dtype, cast once at the head".  The same contract
+backs the EQuARX-style quantized collectives (PR 10) and the db-SP
+cross-shard combine (PR 11): their exactness statements are "exact up
+to ONE f32 reassociation", which is only true if the reduction really
+is f32.  Nothing checked it statically; this rule does.
+
+Scope: calls to ``softmax`` / ``log_softmax`` / ``logsumexp`` in
+``dalle_tpu/ops/``.  A call is clean when an explicit float32 marker is
+visible either
+
+* in the enclosing statement (``.astype(jnp.float32)``, a
+  ``float32``/``"float32"`` dtype mention, ``preferred_element_type``)
+  — or
+* in ANY prior assignment, within the same function, to the root name
+  of one of the call's arguments (one-level local dataflow: covers the
+  ``l32 = logits.astype(jnp.float32); lse = logsumexp(l32)`` and the
+  einsum-with-``preferred_element_type`` idioms).
+
+Sites that are intentionally not-f32 (none today) take the standard
+inline waiver: ``# graftlint: ok f32-accum: <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from dalle_tpu.analysis.walker import (
+    Finding, LintContext, Module, Rule, call_name,
+)
+
+OPS_PREFIX = "dalle_tpu/ops/"
+REDUCTIONS = {"softmax", "log_softmax", "logsumexp"}
+
+
+def _has_f32_marker(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "float32":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "float32":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "float32":
+            return True
+    return False
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The variable a call argument is rooted in: logits, x[0], y.T."""
+    cur = node
+    while isinstance(cur, (ast.Attribute, ast.Subscript)):
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        return cur.id
+    return None
+
+
+def _enclosing_function(module: Module, node: ast.AST) -> Optional[ast.AST]:
+    for anc in module.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _prior_assignments_f32(module: Module, call: ast.Call,
+                           names: Set[str]) -> bool:
+    """True when some assignment to one of ``names``, earlier in the
+    same function, carries an f32 marker."""
+    fn = _enclosing_function(module, call)
+    if fn is None or not names:
+        return False
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            continue
+        if node.lineno > call.lineno:
+            continue
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        else:
+            continue
+        tnames = {
+            t.id for t in targets if isinstance(t, ast.Name)
+        }
+        if tnames & names and _has_f32_marker(node):
+            return True
+    return False
+
+
+class F32AccumRule(Rule):
+    name = "f32-accum"
+    summary = (
+        "softmax/logsumexp/CE/sampling reductions in ops/ carry an "
+        "explicit float32 cast (or a justified waiver)"
+    )
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        for module in ctx.iter_selected():
+            if module.tree is None \
+                    or not module.rel.startswith(OPS_PREFIX):
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node.func)
+                if cname is None:
+                    continue
+                base = cname.rsplit(".", 1)[-1]
+                if base not in REDUCTIONS:
+                    continue
+                stmt = module.enclosing_stmt(node)
+                if _has_f32_marker(stmt):
+                    continue
+                roots = {
+                    r for r in (
+                        _root_name(a) for a in node.args
+                    ) if r is not None
+                }
+                if _prior_assignments_f32(module, node, roots):
+                    continue
+                yield self.finding(
+                    module, node.lineno,
+                    f"{base}() without a visible float32 accumulation "
+                    "path — exp/log-space reductions degrade in "
+                    "bf16 (PR 6 bug class); cast the operand with "
+                    ".astype(jnp.float32) or waive with "
+                    "`# graftlint: ok f32-accum: <why>`",
+                )

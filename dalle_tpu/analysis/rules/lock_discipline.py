@@ -1,0 +1,161 @@
+"""lock-discipline: `# guarded-by:` attributes mutate only under their lock.
+
+The bug class: the serving stack is threaded — scheduler loop, detok
+worker, fleet replica threads, router polls, shared caches — and its
+correctness arguments ("exactly-once pop under 4 concurrent consumers",
+"a poll can never hand work to a replica being declared dead") all
+reduce to *this state only mutates under that lock*.  The convention
+was docstrings; a refactor that hoists one mutation out of its ``with``
+block compiles, passes single-threaded tests, and corrupts a deque
+under load.  This rule makes the convention machine-checked.
+
+Usage: annotate the attribute at its construction site::
+
+    class RequestQueue:
+        def __init__(self):
+            self._q = deque()  # guarded-by: _cv
+
+Every subsequent mutation of ``self._q`` anywhere in the class —
+assignment, augmented assignment, ``del``, subscript store, or a call
+of a known mutator method (``append``/``pop``/``update``/…) — must sit
+lexically inside ``with self._cv`` (Lock, RLock and Condition all work:
+the rule matches the attribute name in the ``with`` item).  The
+annotating scope itself (normally ``__init__``) is exempt: construction
+precedes publication.  Reads are not checked — many are intentionally
+lock-free snapshots; guarding reads is the docstring's job.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dalle_tpu.analysis.walker import (
+    Finding, LintContext, Module, Rule, call_name,
+)
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "pop", "popleft", "popitem", "remove", "clear", "add", "discard",
+    "update", "setdefault", "move_to_end", "rotate",
+}
+
+
+def _annotation_on(module: Module, lineno: int) -> Optional[str]:
+    """The guarded-by lock name on a line or the line above it."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(module.lines):
+            m = GUARDED_RE.search(module.lines[ln - 1])
+            if m:
+                return m.group(1)
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for an expression shaped ``self.x`` (possibly subscripted)."""
+    cur = node
+    while isinstance(cur, ast.Subscript):
+        cur = cur.value
+    if isinstance(cur, ast.Attribute) and isinstance(cur.value, ast.Name) \
+            and cur.value.id == "self":
+        return cur.attr
+    return None
+
+
+def collect_guarded(module: Module,
+                    cls: ast.ClassDef) -> Dict[str, Tuple[str, ast.AST, int]]:
+    """{attr: (lock, annotating scope, annotation line)} for one class."""
+    out: Dict[str, Tuple[str, ast.AST, int]] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                lock = _annotation_on(module, node.lineno)
+                if lock is not None:
+                    scope = next(
+                        (a for a in module.ancestors(node)
+                         if isinstance(a, ast.FunctionDef)), None)
+                    out[attr] = (lock, scope, node.lineno)
+    return out
+
+
+def _under_lock(module: Module, node: ast.AST, lock: str) -> bool:
+    for anc in module.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if call_name(item.context_expr) == f"self.{lock}":
+                    return True
+        if isinstance(anc, ast.ClassDef):
+            break
+    return False
+
+
+def _mutations(cls: ast.ClassDef) -> Iterator[Tuple[str, ast.AST, str]]:
+    """(attr, node, verb) for every self.<attr> mutation in the class."""
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, node, "assigned"
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            attr = _self_attr(node.target)
+            if attr is not None:
+                yield attr, node, "assigned"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, node, "deleted"
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                yield attr, node, f"mutated via .{node.func.attr}()"
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    summary = (
+        "attributes annotated `# guarded-by: <lock>` mutate only "
+        "inside `with self.<lock>`"
+    )
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        for module in ctx.iter_selected():
+            if module.tree is None:
+                continue
+            for cls in ast.walk(module.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                guarded = collect_guarded(module, cls)
+                if not guarded:
+                    continue
+                for attr, node, verb in _mutations(cls):
+                    if attr not in guarded:
+                        continue
+                    lock, scope, _ann_line = guarded[attr]
+                    if scope is not None and any(
+                        a is scope for a in module.ancestors(node)
+                    ):
+                        continue  # construction before publication
+                    if _under_lock(module, node, lock):
+                        continue
+                    # NOTE: no line numbers in the message — baseline
+                    # entries key on (rule, path, message) and must not
+                    # churn when the annotated __init__ shifts
+                    yield self.finding(
+                        module, node.lineno,
+                        f"self.{attr} {verb} outside `with self.{lock}` "
+                        "(see its `# guarded-by` annotation) — "
+                        "unsynchronized mutation of shared serving "
+                        "state",
+                    )

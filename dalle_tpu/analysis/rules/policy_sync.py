@@ -1,0 +1,193 @@
+"""policy-sync: the compute-policy field set is declared ONCE.
+
+The bug class (CHANGES.md PR 2/PR 8): ``DALLEConfig`` knobs that pick an
+execution path — never the function the params parameterize — must be
+(a) popped in ``to_dict`` so checkpoints don't pin them, (b) popped in
+``from_dict`` so old checkpoints that DID serialize them load, and
+(c) known to ``serving/cache/fingerprint.py``, whose model fingerprint
+assumes ``to_dict`` stripped exactly that set.  A knob added to the
+dataclass but missed in one of the three lists silently rolls (or fails
+to roll) ``model_fingerprint`` and poisons the result cache with codes
+from a different function.
+
+The declared source of truth is the ``COMPUTE_POLICY_FIELDS`` tuple in
+``dalle_tpu/models/dalle.py``; this rule cross-checks, by AST only:
+
+* every declared field is an actual ``DALLEConfig`` dataclass field;
+* the literal ``.pop("...")`` sets in ``to_dict`` / ``from_dict`` equal
+  the declared set;
+* ``STRIPPED_POLICY_FIELDS`` in fingerprint.py equals the declared set
+  (the runtime assert there guards the same contract dynamically).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set, Tuple
+
+from dalle_tpu.analysis.walker import (
+    Finding, LintContext, Module, Rule, call_name, str_literals,
+)
+
+DALLE_PATH = "dalle_tpu/models/dalle.py"
+FINGERPRINT_PATH = "dalle_tpu/serving/cache/fingerprint.py"
+DECLARATION = "COMPUTE_POLICY_FIELDS"
+FINGERPRINT_DECLARATION = "STRIPPED_POLICY_FIELDS"
+CONFIG_CLASS = "DALLEConfig"
+
+
+def _module_tuple(tree: ast.Module, name: str) -> Tuple[Optional[Tuple[str, ...]], int]:
+    """(string-tuple value, lineno) of a module-level assignment, or
+    (None, 0) when absent / not a literal tuple of strings."""
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                return str_literals(value), node.lineno
+    return None, 0
+
+
+def _class_def(tree: ast.Module, name: str) -> Optional[ast.ClassDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _method(cls: ast.ClassDef, name: str) -> Optional[ast.FunctionDef]:
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _pop_literals(fn: ast.FunctionDef) -> Set[str]:
+    """Every ``<x>.pop("<lit>" ...)`` first-arg string literal in a body."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node.func)
+        if name is None or not name.endswith(".pop"):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.add(node.args[0].value)
+    return out
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> Set[str]:
+    return {
+        node.target.id
+        for node in cls.body
+        if isinstance(node, ast.AnnAssign)
+        and isinstance(node.target, ast.Name)
+    }
+
+
+class PolicySyncRule(Rule):
+    name = "policy-sync"
+    summary = (
+        "COMPUTE_POLICY_FIELDS is declared once and the to_dict/"
+        "from_dict pop lists plus the fingerprint strip set match it"
+    )
+
+    def _check_set(self, module: Module, line: int, what: str,
+                   got: Set[str], declared: Set[str]) -> Iterator[Finding]:
+        for f in sorted(declared - got):
+            yield self.finding(
+                module, line,
+                f"{what} is missing compute-policy field {f!r} — a "
+                f"missed pop rolls model_fingerprint and poisons the "
+                f"result cache (declared in {DECLARATION})",
+            )
+        for f in sorted(got - declared):
+            yield self.finding(
+                module, line,
+                f"{what} pops {f!r} which is not in {DECLARATION} — "
+                f"either declare it or stop stripping it",
+            )
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        dalle = ctx.module(DALLE_PATH)
+        fingerprint = ctx.module(FINGERPRINT_PATH)
+        if dalle is None or dalle.tree is None:
+            # not this repo's layout (fixture trees) — nothing to check
+            return
+        if ctx.selected is not None and not (
+            {DALLE_PATH, FINGERPRINT_PATH} &
+            {m.rel for m in ctx.iter_selected()}
+        ):
+            return  # --changed run that touched neither contract file
+
+        declared_t, decl_line = _module_tuple(dalle.tree, DECLARATION)
+        if declared_t is None:
+            yield self.finding(
+                dalle, decl_line or 1,
+                f"{DALLE_PATH} must declare {DECLARATION} as a "
+                "module-level tuple of string literals — the single "
+                "source of truth for compute-policy knobs",
+            )
+            return
+        declared = set(declared_t)
+
+        cls = _class_def(dalle.tree, CONFIG_CLASS)
+        if cls is None:
+            yield self.finding(
+                dalle, 1, f"class {CONFIG_CLASS} not found"
+            )
+            return
+
+        fields = _dataclass_fields(cls)
+        for f in sorted(declared - fields):
+            yield self.finding(
+                dalle, decl_line,
+                f"{DECLARATION} names {f!r} which is not a "
+                f"{CONFIG_CLASS} dataclass field (typo?)",
+            )
+
+        for meth_name in ("to_dict", "from_dict"):
+            meth = _method(cls, meth_name)
+            if meth is None:
+                yield self.finding(
+                    dalle, cls.lineno,
+                    f"{CONFIG_CLASS}.{meth_name} not found",
+                )
+                continue
+            pops = _pop_literals(meth)
+            yield from self._check_set(
+                dalle, meth.lineno, f"{CONFIG_CLASS}.{meth_name}",
+                pops, declared,
+            )
+
+        if fingerprint is None or fingerprint.tree is None:
+            yield self.finding(
+                dalle, decl_line,
+                f"{FINGERPRINT_PATH} not found — the fingerprint strip "
+                "contract cannot be checked",
+            )
+            return
+        strip_t, strip_line = _module_tuple(
+            fingerprint.tree, FINGERPRINT_DECLARATION
+        )
+        if strip_t is None:
+            yield self.finding(
+                fingerprint, 1,
+                f"{FINGERPRINT_PATH} must declare "
+                f"{FINGERPRINT_DECLARATION} as a module-level tuple of "
+                f"string literals mirroring {DECLARATION}",
+            )
+            return
+        yield from self._check_set(
+            fingerprint, strip_line,
+            f"fingerprint {FINGERPRINT_DECLARATION}",
+            set(strip_t), declared,
+        )

@@ -1,0 +1,245 @@
+"""recompile-hazard: no Python control flow / coercion on traced values.
+
+The bug class: the serving engine's three jitted seams (tick, admit,
+cached-admit) are pinned to compile EXACTLY once across occupancy and
+cache churn (tests/test_serving*.py `_cache_size` pins), and the train
+steps donate their buffers — a Python ``if`` on a traced operand either
+raises ``TracerBoolConversionError`` at trace time or, when the operand
+is accidentally static-ified (``.item()``, ``int()``), silently bakes a
+new executable per VALUE, which is how a zero-recompile contract rots
+into a compile-per-request serving tick.
+
+Scope (deliberately conservative — heuristics with a baseline beat a
+vague always-on warning): inside any function that is handed to
+``jax.jit`` — decorated, wrapped via ``functools.partial(jax.jit, …)``,
+or registered as an engine seam (``jax.jit(self._x_impl, …)``) — flag,
+on the function's *traced parameters* (positional/kw-only params minus
+``static_argnums`` / ``static_argnames``):
+
+* ``if`` / ``while`` whose test reads a traced parameter dynamically;
+* ``float()`` / ``int()`` / ``bool()`` / ``.item()`` coercions of one;
+* f-strings formatting one (host formatting of a tracer).
+
+Static escapes that do NOT count as dynamic reads: ``.shape`` /
+``.ndim`` / ``.dtype`` / ``.size`` attribute chains, ``len(...)`` /
+``isinstance(...)`` calls, and ``is / is not`` identity tests (all
+resolved at trace time).  Values *derived* from traced params are out of
+scope — the rule is a tripwire on the seam signature, not an abstract
+interpreter.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dalle_tpu.analysis.walker import (
+    Finding, LintContext, Module, Rule, call_name, int_literals,
+    str_literals,
+)
+
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+STATIC_CALLS = {"len", "isinstance", "type"}
+COERCIONS = {"float", "int", "bool"}
+
+
+def _is_jit_name(name: Optional[str]) -> bool:
+    return name is not None and (name == "jit" or name.endswith(".jit"))
+
+
+def _is_partial_name(name: Optional[str]) -> bool:
+    return name is not None and (
+        name == "partial" or name.endswith(".partial")
+    )
+
+
+@dataclass
+class JitSpec:
+    """One function registered with jax.jit and how its args map."""
+
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    #: positional offset between jit-visible arg i and the def's arg
+    #: list: 1 for bound methods (jax.jit(self._impl) hides ``self``)
+    offset: int = 0
+
+
+def _jit_kwargs(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = int_literals(kw.value) or ()
+        elif kw.arg == "static_argnames":
+            names = str_literals(kw.value) or ()
+    return nums, names
+
+
+def collect_jitted(module: Module) -> Dict[str, JitSpec]:
+    """{function name: JitSpec} for every jit registration in a module.
+
+    Matches by bare function/method name within the module — collisions
+    across classes are possible in principle and acceptable for a lint
+    (both homonyms being seams is the common case)."""
+    out: Dict[str, JitSpec] = {}
+    assert module.tree is not None
+    # wrapped forms: jax.jit(f, ...) / jax.jit(self._impl, ...)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_jit_name(call_name(node.func)) or not node.args:
+            continue
+        target = node.args[0]
+        nums, names = _jit_kwargs(node)
+        tname = call_name(target)
+        if tname is None:
+            continue
+        if tname.startswith("self."):
+            out[tname[len("self."):]] = JitSpec(nums, names, offset=1)
+        elif "." not in tname:
+            out[tname] = JitSpec(nums, names, offset=0)
+    # decorated forms
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in fn.decorator_list:
+            if _is_jit_name(call_name(dec)):
+                out[fn.name] = JitSpec()
+            elif isinstance(dec, ast.Call):
+                dname = call_name(dec.func)
+                if _is_jit_name(dname):
+                    nums, names = _jit_kwargs(dec)
+                    out[fn.name] = JitSpec(nums, names)
+                elif _is_partial_name(dname) and dec.args \
+                        and _is_jit_name(call_name(dec.args[0])):
+                    nums, names = _jit_kwargs(dec)
+                    out[fn.name] = JitSpec(nums, names)
+    return out
+
+
+def traced_params(fn: ast.FunctionDef, spec: JitSpec) -> Set[str]:
+    """Parameter names the tracer sees as dynamic values."""
+    pos: List[str] = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    kwonly = [a.arg for a in fn.args.kwonlyargs]
+    if pos and pos[0] in ("self", "cls"):
+        pos = pos[1:]
+    static = set(spec.static_argnames)
+    names = set(pos) | set(kwonly)
+    # static_argnums index the callable jit wrapped: a bound-method
+    # registration (offset=1) hides self, so jit position i is the
+    # def's arg i+1; decorated functions line up directly
+    all_pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for i in spec.static_argnums:
+        k = i + spec.offset
+        if 0 <= k < len(all_pos):
+            static.add(all_pos[k])
+    return {n for n in names if n not in static} - {"self", "cls"}
+
+
+def _dynamic_refs(module: Module, sub: ast.AST,
+                  traced: Set[str]) -> Iterator[ast.Name]:
+    """Name loads of traced params not inside a static escape."""
+    for node in ast.walk(sub):
+        if not (isinstance(node, ast.Name) and node.id in traced
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        static = False
+        prev: ast.AST = node
+        for anc in module.ancestors(node):
+            if isinstance(anc, ast.Attribute) and prev is anc.value \
+                    and anc.attr in STATIC_ATTRS:
+                static = True
+                break
+            if isinstance(anc, ast.Call):
+                fname = call_name(anc.func)
+                if fname in STATIC_CALLS and prev in anc.args:
+                    static = True
+                    break
+            if isinstance(anc, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in anc.ops
+            ):
+                static = True
+                break
+            if anc is sub:
+                break
+            prev = anc
+        if not static:
+            yield node
+
+
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    summary = (
+        "jitted seams must not branch on, coerce, or format traced "
+        "parameters"
+    )
+
+    def _check_fn(self, module: Module, fn: ast.FunctionDef,
+                  spec: JitSpec) -> Iterator[Finding]:
+        traced = traced_params(fn, spec)
+        if not traced:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                for ref in _dynamic_refs(module, node.test, traced):
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    yield self.finding(
+                        module, node.lineno,
+                        f"`{kw}` on traced parameter {ref.id!r} inside "
+                        f"jitted {fn.name!r} — Python control flow on a "
+                        "tracer fails or forces a recompile per value; "
+                        "use lax.cond/select or mark the arg static",
+                    )
+                    break  # one finding per statement
+            elif isinstance(node, ast.Call):
+                fname = call_name(node.func)
+                if fname in COERCIONS and node.args:
+                    for ref in _dynamic_refs(module, node.args[0], traced):
+                        yield self.finding(
+                            module, node.lineno,
+                            f"{fname}() coercion of traced parameter "
+                            f"{ref.id!r} inside jitted {fn.name!r} — "
+                            "concretizes the tracer (recompile per "
+                            "value, or TracerConversionError)",
+                        )
+                        break
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item":
+                    for ref in _dynamic_refs(
+                        module, node.func.value, traced
+                    ):
+                        yield self.finding(
+                            module, node.lineno,
+                            f".item() on traced parameter {ref.id!r} "
+                            f"inside jitted {fn.name!r} — host sync + "
+                            "concrete value at trace time",
+                        )
+                        break
+            elif isinstance(node, ast.JoinedStr):
+                for val in node.values:
+                    if not isinstance(val, ast.FormattedValue):
+                        continue
+                    hit = next(
+                        _dynamic_refs(module, val.value, traced), None
+                    )
+                    if hit is not None:
+                        yield self.finding(
+                            module, node.lineno,
+                            f"f-string formats traced parameter "
+                            f"{hit.id!r} inside jitted {fn.name!r} — "
+                            "tracers render as abstract values (or "
+                            "force a sync); format outside the seam",
+                        )
+                        break
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        for module in ctx.iter_selected():
+            if module.tree is None:
+                continue
+            jitted = collect_jitted(module)
+            if not jitted:
+                continue
+            for fn in ast.walk(module.tree):
+                if isinstance(fn, ast.FunctionDef) and fn.name in jitted:
+                    yield from self._check_fn(module, fn, jitted[fn.name])

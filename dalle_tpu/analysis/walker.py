@@ -1,0 +1,320 @@
+"""Visitor infrastructure for graftlint: modules, findings, suppressions.
+
+Everything here is plain ``ast`` + file IO — no repo imports, no jax.
+A :class:`LintContext` owns the parsed tree of the repo (or the changed
+subset) and each :class:`Rule` walks it producing :class:`Finding`\\ s.
+
+Inline suppression: a finding is suppressed when the line it fires on —
+or the line directly above it — carries::
+
+    # graftlint: ok <rule>[,<rule>...]: <justification>
+
+The justification is mandatory; a suppression comment without one does
+not suppress and instead fires the framework's own ``suppression``
+finding, so silent blanket waivers cannot accrete.  File-level /
+pre-existing debt goes in tools/lint_baseline.json (see baseline.py),
+which has the same justification rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+#: directories scanned recursively, relative to the repo root (tests/ is
+#: deliberately absent: fixture snippets there exist to violate rules)
+SCAN_DIRS = ("dalle_tpu", "tools")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*ok\s+([a-z0-9_,\- ]+?)\s*(?::\s*(\S.*))?$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers churn under unrelated edits,
+        so the baseline matches on (rule, path, message) only."""
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """One parsed source file plus the lazy indexes rules share."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=self.rel)
+        except SyntaxError as e:  # surfaced as a framework finding
+            self.parse_error = e
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._suppress: Optional[Dict[int, Set[str]]] = None
+        self._bad_suppress: Optional[List[int]] = None
+
+    # --- parent map -------------------------------------------------------
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """child node -> parent node, built once per module."""
+        if self._parents is None:
+            p: Dict[ast.AST, ast.AST] = {}
+            assert self.tree is not None
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    p[child] = node
+            self._parents = p
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        p = self.parents.get(node)
+        while p is not None:
+            yield p
+            p = self.parents.get(p)
+
+    def enclosing_stmt(self, node: ast.AST) -> ast.AST:
+        """The statement a node belongs to (the node itself if it is one)."""
+        cur = node
+        while not isinstance(cur, ast.stmt):
+            nxt = self.parents.get(cur)
+            if nxt is None:
+                return cur
+            cur = nxt
+        return cur
+
+    # --- suppressions -----------------------------------------------------
+    def _scan_suppressions(self) -> None:
+        self._suppress = {}
+        self._bad_suppress = []
+        for i, raw in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if m.group(2):  # justification present
+                self._suppress[i] = rules
+            else:
+                self._bad_suppress.append(i)
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        if self._suppress is None:
+            self._scan_suppressions()
+        return self._suppress  # type: ignore[return-value]
+
+    @property
+    def bad_suppressions(self) -> List[int]:
+        if self._bad_suppress is None:
+            self._scan_suppressions()
+        return self._bad_suppress  # type: ignore[return-value]
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """Suppression holds on the finding's own line or the line above."""
+        for ln in (line, line - 1):
+            if rule in self.suppressions.get(ln, ()):
+                return True
+        return False
+
+
+@dataclass
+class LintContext:
+    """Everything a rule may consult: the scanned tree + selection."""
+
+    root: str
+    modules: List[Module] = field(default_factory=list)
+    #: rel paths selected for per-file rules (``--changed``); None = all
+    selected: Optional[Set[str]] = None
+    #: False under ``--changed`` — whole-tree checks that need every
+    #: callsite (dead event kinds) are skipped rather than half-run
+    whole_tree: bool = True
+
+    def module(self, rel: str) -> Optional[Module]:
+        rel = rel.replace(os.sep, "/")
+        for m in self.modules:
+            if m.rel == rel:
+                return m
+        return None
+
+    def iter_selected(self) -> Iterator[Module]:
+        for m in self.modules:
+            if self.selected is None or m.rel in self.selected:
+                yield m
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``summary`` and yield findings.
+
+    ``run`` receives the whole context; per-file rules should iterate
+    ``ctx.iter_selected()`` so ``--changed`` narrows them, while
+    invariant rules pinned to specific files (policy-sync) consult
+    ``ctx.module(...)`` directly and decide their own applicability.
+    """
+
+    name: str = ""
+    summary: str = ""
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    # convenience for subclasses
+    def finding(self, module: Module, line: int, message: str) -> Finding:
+        return Finding(self.name, module.rel, line, message)
+
+
+def iter_py_files(root: str) -> Iterator[str]:
+    """Every lintable .py path under ``root`` (absolute), sorted walk."""
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(
+                dn for dn in dirnames if dn != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+    if os.path.isdir(root):
+        for fn in sorted(os.listdir(root)):
+            if fn.endswith(".py") and os.path.isfile(os.path.join(root, fn)):
+                yield os.path.join(root, fn)
+
+
+def collect_modules(root: str,
+                    only: Optional[Iterable[str]] = None) -> List[Module]:
+    """Parse the scan set under ``root``.  ``only`` (rel paths) narrows
+    the read for ``--changed`` runs; paths outside the scan set are
+    ignored silently (a changed test file is not lintable)."""
+    root = os.path.abspath(root)
+    want = None
+    if only is not None:
+        want = {p.replace(os.sep, "/") for p in only}
+    out: List[Module] = []
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if want is not None and rel not in want:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        out.append(Module(path, rel, src))
+    return out
+
+
+def apply_suppressions(
+    modules: List[Module], findings: Iterable[Finding]
+) -> Tuple[List[Finding], int]:
+    """Drop inline-suppressed findings; returns (kept, n_suppressed)."""
+    by_rel = {m.rel: m for m in modules}
+    kept: List[Finding] = []
+    dropped = 0
+    for f in findings:
+        m = by_rel.get(f.path)
+        if m is not None and m.is_suppressed(f.rule, f.line):
+            dropped += 1
+        else:
+            kept.append(f)
+    return kept, dropped
+
+
+def framework_findings(ctx: LintContext) -> Iterator[Finding]:
+    """The walker's own checks: unparseable files and suppression
+    comments missing their mandatory justification."""
+    for m in ctx.iter_selected():
+        if m.parse_error is not None:
+            yield Finding(
+                "parse", m.rel, m.parse_error.lineno or 1,
+                f"unparseable: {m.parse_error.msg}",
+            )
+        for ln in m.bad_suppressions:
+            yield Finding(
+                "suppression", m.rel, ln,
+                "graftlint suppression without a justification — use "
+                "`# graftlint: ok <rule>: <why>`",
+            )
+
+
+# --- shared AST helpers ----------------------------------------------------
+
+def call_name(node: ast.AST) -> Optional[str]:
+    """Dotted name of a call target / attribute chain, or None.
+
+    ``jax.jit`` -> "jax.jit", ``self._tick_fn`` -> "self._tick_fn",
+    ``f`` -> "f".  Subscripts/calls inside the chain return None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_calls(tree: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node  # type: ignore[misc]
+
+
+def int_literals(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """A literal int or tuple/list of ints, else None (dynamic)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def str_literals(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """A literal str or tuple/list of strs, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None
+        return tuple(out)
+    return None

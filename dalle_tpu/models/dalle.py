@@ -38,6 +38,27 @@ from dalle_tpu.ops.fused_ce import range_ce
 
 NEG_INF = -1e30
 
+#: The compute-policy knobs of :class:`DALLEConfig` — THE declaration.
+#: These pick an *execution path* (precision, kernel choice, collective
+#: width), never the function the params parameterize, so checkpoints
+#: must not pin them and the serving cache must not fingerprint them.
+#: Three places strip them and must agree: ``DALLEConfig.to_dict`` /
+#: ``from_dict`` below, and ``STRIPPED_POLICY_FIELDS`` in
+#: serving/cache/fingerprint.py.  graftlint's policy-sync rule checks
+#: all three against this tuple (tools/graftlint.py, docs/LINT.md) —
+#: a missed pop silently rolls model_fingerprint and poisons the
+#: result cache.
+COMPUTE_POLICY_FIELDS = (
+    "dtype",
+    "stream_dtype",
+    "use_flash",
+    "fused_ff",
+    "fused_decode",
+    "tp_overlap",
+    "decode_comm",
+    "fsdp_prefetch",
+)
+
 
 class VocabHead(nn.Module):
     """Drop-in for ``nn.Dense`` as the logits head, with ``kernel``/``bias``
@@ -215,10 +236,13 @@ class DALLEConfig:
 
     def to_dict(self):
         d = dataclasses.asdict(self)
-        # dtype, stream_dtype, use_flash and fused_ff are compute policy,
-        # not hparams: they pick an execution path (precision /
-        # Pallas-vs-dense kernel), never the function the params
-        # parameterize — checkpoints must not pin them
+        # Compute-policy knobs are not hparams: they pick an execution
+        # path (precision / Pallas-vs-dense kernel / collective width),
+        # never the function the params parameterize — checkpoints must
+        # not pin them.  The pop list below is kept literal so
+        # graftlint's policy-sync rule can diff it against
+        # COMPUTE_POLICY_FIELDS (the declaration at module top) by AST
+        # alone; add a knob there first, then here and in from_dict.
         d.pop("dtype")
         d.pop("stream_dtype")
         d.pop("use_flash")
@@ -233,14 +257,21 @@ class DALLEConfig:
     @classmethod
     def from_dict(cls, d):
         d = dict(d)
-        # pre-r5 checkpoints serialized use_flash; it is compute policy now
+        # Old checkpoints serialized compute policies before each knob
+        # was reclassified (pre-r5 use_flash, etc.) — strip the full
+        # declared set defensively.  ``dtype`` was missing from this
+        # list until r17 (policy-sync's first real catch): a pre-r5
+        # checkpoint carrying a serialized dtype string would have been
+        # passed straight into the config.  Literal pops, same
+        # policy-sync contract as to_dict.
+        d.pop("dtype", None)
+        d.pop("stream_dtype", None)
         d.pop("use_flash", None)
         d.pop("fused_ff", None)
         d.pop("fused_decode", None)
         d.pop("tp_overlap", None)
         d.pop("decode_comm", None)
         d.pop("fsdp_prefetch", None)
-        d.pop("stream_dtype", None)
         d["attn_types"] = tuple(d.get("attn_types", ("full",)))
         return cls(**d)
 

@@ -207,6 +207,7 @@ def axial_attention(q, k, v, text_seq_len, fmap_size, axis, key_pad_mask=None):
         )
 
     logits = jnp.concatenate([ax_logits, txt_logits], axis=-1)  # [b,h,f,f,f+t]
+    # graftlint: ok f32-accum: both concatenated branches are f32 via preferred_element_type on their einsums
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     p_ax, p_txt = probs[..., :f], probs[..., f:]
     out_ax = jnp.einsum("bhxij,bhxjd->bhxid", p_ax, vg)
@@ -270,6 +271,7 @@ def conv_like_attention(
             key_pad_mask[:, None, None, :t], txt_logits, NEG_INF
         )
     logits = jnp.concatenate([win_logits, txt_logits], axis=-1)
+    # graftlint: ok f32-accum: both concatenated branches are f32 via preferred_element_type on their einsums
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     p_win, p_txt = probs[..., : kw.shape[3]], probs[..., kw.shape[3] :]
     out_i = jnp.einsum("bhiw,bhiwd->bhid", p_win, vw) + jnp.einsum(

@@ -5,15 +5,18 @@ Two layers of keying:
 * :func:`model_fingerprint` — identifies *which function* the engine is
   serving: the checkpoint (path + step, i.e. the weights) plus the
   compute-policy-stripped model config.  ``DALLEConfig.to_dict()`` is
-  the policy stripper: it already pops ``dtype``/``stream_dtype``/
-  ``use_flash``/``fused_ff``/``fused_decode``/``tp_overlap``/
-  ``fsdp_prefetch`` because those pick an *execution path*, never the
-  function the params parameterize — ``--fused_decode`` is pinned
-  bitwise against the baseline engine, so codes cached under one policy
-  are exactly what the other policy would produce.  Output-CHANGING
-  knobs (``kv_int8``, ``quant_int8`` — quantization changes logits, so
-  codes differ) survive ``to_dict`` and therefore fingerprint apart, as
-  they must.
+  the policy stripper: it pops exactly the knobs declared in
+  ``models/dalle.py:COMPUTE_POLICY_FIELDS`` (mirrored literally below
+  as :data:`STRIPPED_POLICY_FIELDS` and cross-checked by graftlint's
+  policy-sync rule plus a runtime guard) because those pick an
+  *execution path*, never the function the params parameterize —
+  ``--fused_decode`` is pinned bitwise against the baseline engine, so
+  codes cached under one policy are exactly what the other policy would
+  produce.  Output-CHANGING knobs (``kv_int8``, ``quant_int8`` —
+  quantization changes logits, so codes differ) survive ``to_dict`` and
+  therefore fingerprint apart, as they must.  (An earlier revision of
+  this docstring hand-listed seven knobs and silently missed
+  ``decode_comm`` — the drift class the declared tuple now prevents.)
 
 * :func:`request_key` — identifies *which request* against that
   function: fingerprint + text tokens + seed + the full sampling tuple
@@ -37,6 +40,24 @@ from typing import Optional
 
 import numpy as np
 
+#: The compute-policy fields this module RELIES on ``to_dict`` having
+#: stripped.  Must equal ``models/dalle.py:COMPUTE_POLICY_FIELDS``
+#: field-for-field — kept as a literal (not an import) so graftlint's
+#: policy-sync rule can diff the two by AST alone, and so a refactor of
+#: dalle.py cannot silently change what this cache keys on.  The
+#: runtime guard in :func:`model_fingerprint` enforces the same
+#: contract dynamically.
+STRIPPED_POLICY_FIELDS = (
+    "dtype",
+    "stream_dtype",
+    "use_flash",
+    "fused_ff",
+    "fused_decode",
+    "tp_overlap",
+    "decode_comm",
+    "fsdp_prefetch",
+)
+
 
 def model_fingerprint(cfg, *, checkpoint_path: Optional[str] = None,
                       step: Optional[int] = None) -> str:
@@ -47,8 +68,17 @@ def model_fingerprint(cfg, *, checkpoint_path: Optional[str] = None,
     them None for in-memory params (tests, ``--quick`` benches) — the
     config alone still keys correctly within one process.
     """
+    config = cfg.to_dict()
+    leaked = sorted(set(STRIPPED_POLICY_FIELDS) & set(config))
+    if leaked:
+        raise ValueError(
+            f"to_dict() leaked compute-policy fields {leaked} into the "
+            "model fingerprint — a policy flip would wrongly roll every "
+            "cache key; sync DALLEConfig.to_dict with "
+            "COMPUTE_POLICY_FIELDS (run tools/graftlint.py)"
+        )
     payload = {
-        "config": cfg.to_dict(),
+        "config": config,
         "checkpoint": checkpoint_path,
         "step": step,
     }

@@ -33,12 +33,12 @@ class ResultCache:
     def __init__(self, max_bytes: int):
         assert max_bytes > 0, f"max_bytes must be > 0, got {max_bytes}"
         self.max_bytes = int(max_bytes)
-        self._d: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._d: "OrderedDict[str, np.ndarray]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._bytes = 0
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self._bytes = 0  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
 
     # --- core ------------------------------------------------------------
     def get(self, key: str) -> Optional[np.ndarray]:

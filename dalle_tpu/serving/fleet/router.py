@@ -54,13 +54,15 @@ class Router:
         self.queue = queue
         self._lock = lock
         self.S = int(ticks_per_request)  # decode ticks one request costs
-        self._alive: Set[int] = set()
-        self._stash: Dict[int, deque] = {}
+        self._alive: Set[int] = set()  # guarded-by: _lock
+        self._stash: Dict[int, deque] = {}  # guarded-by: _lock
         # rid -> (busy_ticks, free_slots, tick_ewma_s) at its last poll
-        self._load: Dict[int, tuple] = {}
-        self.steered = 0  # hinted requests stashed for another replica
-        self.denied = 0  # poll grants withheld for a less-loaded replica
-        self._last_rebalance_log = 0.0
+        self._load: Dict[int, tuple] = {}  # guarded-by: _lock
+        # hinted requests stashed for another replica
+        self.steered = 0  # guarded-by: _lock
+        # poll grants withheld for a less-loaded replica
+        self.denied = 0  # guarded-by: _lock
+        self._last_rebalance_log = 0.0  # guarded-by: _lock
 
     def register(self, rid: int, num_slots: int) -> None:
         with self._lock:

@@ -192,14 +192,15 @@ class RequestQueue:
             f"max_pending must be >= 1 (or None for unbounded), "
             f"got {max_pending}"
         )
-        self._q: deque = deque()
+        self._q: deque = deque()  # guarded-by: _cv
         self._cv = threading.Condition()
-        self._closed = False
+        self._closed = False  # guarded-by: _cv
         self.max_pending = max_pending
         self.shed_policy = shed_policy
         self.on_shed = on_shed
-        self.shed: List[Request] = []
-        self.max_pending_seen = 0  # high-water mark of queue depth
+        self.shed: List[Request] = []  # guarded-by: _cv
+        # high-water mark of queue depth
+        self.max_pending_seen = 0  # guarded-by: _cv
         # MetricsRegistry (dalle_tpu/telemetry): the Scheduler ties the
         # queue to its own registry unless one was passed, so the
         # serve_submitted / serve_shed counters reconcile with stats()

@@ -1,99 +1,57 @@
 #!/usr/bin/env python
-"""Static event-schema check (docs/OBSERVABILITY.md).
+"""Static event-schema check — now a shim over graftlint's event-kinds
+rule (docs/OBSERVABILITY.md, docs/LINT.md).
 
-Walks every ``log_event(...)`` callsite in the tree (``dalle_tpu/``,
-``tools/``, the root scripts) with Python's ``ast`` — no imports, no
-side effects — and validates that the first argument is a string
-literal registered in :data:`dalle_tpu.telemetry.schema.EVENT_KINDS`.
-A kind that isn't in the table is exactly how an events.jsonl consumer
-(tools/telemetry_report.py, the chaos harnesses, operator dashboards)
-ends up silently blind to a new failure mode: the producer ships, the
-schema doesn't, and nothing greps for the gap.  This check is that
-grep, run as a tier-1 test (tests/test_check_events.py).
+Historically this file owned the AST walk; PR 12 folded it into the
+``dalle_tpu/analysis`` lint framework, where the same rule also detects
+DEAD kinds (registered in the schema, emitted nowhere).  This module
+keeps the old public surface — ``check_events(root) -> list[str]`` and
+``python tools/check_events.py`` — so tests/test_check_events.py and
+the docs keep working; prefer ``python tools/graftlint.py --rule
+event-kinds`` for new wiring.
 
-Rules:
+Rules (unchanged semantics, one addition):
 
-* first arg is a string literal  -> must be a known kind;
-* first arg is dynamic           -> only the :class:`Run.log_event`
-  forwarder in ``dalle_tpu/training/logging.py`` may do that (it
-  re-enters the module-level function, which its callers hit with
-  literals); anywhere else is an error — a computed kind defeats
-  static checking;
-* zero args                      -> error (malformed call).
-
-Run directly: ``python tools/check_events.py`` (non-zero exit on any
-problem), or import :func:`check_events` for the test.
+* literal first arg  -> must be a kind registered in
+  :data:`dalle_tpu.telemetry.schema.EVENT_KINDS`;
+* dynamic first arg  -> only the :class:`Run.log_event` forwarder in
+  ``dalle_tpu/training/logging.py`` may do that;
+* zero args          -> error (malformed call);
+* NEW: a registered kind no scanned callsite emits is reported dead.
 """
 
-import ast
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: the one callsite allowed a non-literal kind (the Run method forwards
-#: its argument into the module-level function)
-FORWARDER = os.path.join("dalle_tpu", "training", "logging.py")
+from dalle_tpu.analysis.rules.event_kinds import (  # noqa: E402
+    EventKindsRule, FORWARDER_PATH,
+)
+from dalle_tpu.analysis.walker import (  # noqa: E402
+    LintContext, apply_suppressions, collect_modules, framework_findings,
+)
 
-SCAN_DIRS = ("dalle_tpu", "tools")
-
-
-def _py_files(root):
-    for d in SCAN_DIRS:
-        base = os.path.join(root, d)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
-    for fn in sorted(os.listdir(root)):
-        if fn.endswith(".py"):
-            yield os.path.join(root, fn)
-
-
-def _is_log_event_call(node):
-    f = node.func
-    return (isinstance(f, ast.Name) and f.id == "log_event") or (
-        isinstance(f, ast.Attribute) and f.attr == "log_event"
-    )
+#: kept for import compatibility: the one callsite allowed a non-literal
+#: kind (the Run method forwards its argument into the module function)
+FORWARDER = os.path.join(*FORWARDER_PATH.split("/"))
 
 
 def check_events(root) -> list:
     """All schema violations in the tree as ``"path:line: message"``
     strings (empty list == clean)."""
-    from dalle_tpu.telemetry.schema import EVENT_KINDS
-
-    problems = []
-    for path in _py_files(root):
-        rel = os.path.relpath(path, root)
-        try:
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=rel)
-        except SyntaxError as e:
-            problems.append(f"{rel}:{e.lineno}: unparseable: {e.msg}")
-            continue
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and _is_log_event_call(node)):
-                continue
-            loc = f"{rel}:{node.lineno}"
-            if not node.args:
-                problems.append(f"{loc}: log_event() with no kind")
-                continue
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(
-                    first.value, str):
-                if first.value not in EVENT_KINDS:
-                    problems.append(
-                        f"{loc}: unknown event kind "
-                        f"{first.value!r} — register it in "
-                        "dalle_tpu/telemetry/schema.py"
-                    )
-            elif rel != FORWARDER:
-                problems.append(
-                    f"{loc}: non-literal event kind — only the "
-                    f"forwarder in {FORWARDER} may do that"
-                )
-    return problems
+    root = os.path.abspath(root)
+    modules = collect_modules(root)
+    ctx = LintContext(root=root, modules=modules)
+    findings = [
+        f for f in framework_findings(ctx) if f.rule == "parse"
+    ]
+    findings.extend(EventKindsRule().run(ctx))
+    findings, _ = apply_suppressions(modules, findings)
+    return [
+        f"{f.path}:{f.line}: {f.message}"
+        for f in sorted(findings, key=lambda f: (f.path, f.line))
+    ]
 
 
 def main(argv=None):

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""graftlint — the repo's AST invariant linter (docs/LINT.md).
+
+Thin executable wrapper: the implementation lives in
+``dalle_tpu/analysis/`` (pure stdlib — importing it never pulls jax, so
+this stays a sub-second pass suitable for pre-commit and tier-1).
+
+Common invocations::
+
+    python tools/graftlint.py                  # whole tree
+    python tools/graftlint.py --changed        # files touched vs HEAD
+    python tools/graftlint.py --rule policy-sync --format json
+    python tools/graftlint.py --list-rules
+
+Exit codes: 0 clean, 1 findings, 2 config error (unknown rule /
+malformed tools/lint_baseline.json).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dalle_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
