@@ -146,6 +146,14 @@ PHASES = [
     # interleaved best-of; ON tokens/s must stay within 2% of OFF, and
     # the disabled run must record ZERO trace events.  Host-side
     ("telemetry_overhead", 600, False),
+    # observability-plane evidence (docs/OBSERVABILITY.md §4-7): the same
+    # saturated burst replayed with the FULL plane live — introspection
+    # server bound, SLO tracker on, flight recorder armed — vs all-off.
+    # Gates the whole plane at <= 2% tokens/s cost, /metrics scraped over
+    # HTTP agreeing EXACTLY with a registry snapshot, every under-load
+    # scrape parseable with /healthz ok, SLO attainment published, and a
+    # flight dump that round-trips through json.  Host-side
+    ("observability", 600, False),
     # serving-cache evidence (docs/SERVING.md §7): one Zipf(1.1) prompt
     # trace replayed cached vs uncached — >=30% fewer device-prefilled
     # requests, bitwise-identical codes for every request, and both
@@ -2020,6 +2028,177 @@ def _telemetry_overhead_bench():
     return res
 
 
+def _observability_bench():
+    """Observability-plane rung (docs/OBSERVABILITY.md §4-7, the ISSUE 13
+    pin).
+
+    Replays the saturated burst from the telemetry rung with the FULL
+    observability plane live — introspection server on an ephemeral
+    port, SLO tracker fed by per-request deadlines, flight recorder
+    armed — interleaved best-of-N against the all-off baseline.  Gates:
+
+      * plane-ON tokens/s >= 0.98x OFF (the live HTTP surface + SLO
+        accounting + crash ring ride inside the telemetry budget);
+      * every /metrics scrape taken WHILE the burst is in flight parses
+        (``parse_prometheus`` raises on any torn line) and /healthz
+        answers with a well-formed verdict under load;
+      * a quiescent /metrics scrape agrees EXACTLY — every series, every
+        value — with ``registry.exposition_snapshot()`` rendered and
+        parsed through the same oracle;
+      * the SLO gauges are published (attainment in [0, 1], every
+        deadlined request accounted);
+      * a forced flight dump lands on disk and round-trips through
+        ``json.load`` with the full document shape.
+    """
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from dalle_tpu import telemetry
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.serving import make_poisson_trace, replay_trace
+    from dalle_tpu.telemetry.exposition import (
+        parse_prometheus, render_prometheus,
+    )
+
+    cfg = DALLEConfig(
+        num_text_tokens=64, text_seq_len=16, num_image_tokens=128,
+        image_fmap_size=8, dim=32, depth=2, heads=2, dim_head=16,
+    )
+    key = jax.random.PRNGKey(0)
+    model = DALLE(cfg)
+    text = jax.random.randint(
+        key, (2, cfg.text_seq_len), 1, cfg.num_text_tokens
+    )
+    codes = jax.random.randint(
+        key, (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = model.init({"params": key}, text, codes)["params"]
+    # 8 interleaved repeats: the per-run host noise at this ~2s burst is
+    # comparable to the 2% budget, so best-of needs the extra draws
+    n_req, slots, repeats = 16, 8, 8
+    trace = make_poisson_trace(
+        n_req, 1e5, cfg.text_seq_len, cfg.num_text_tokens, seed=0
+    )
+    for it in trace:  # generous deadlines: all deadlined, none missed
+        it.deadline_s = 120.0
+
+    def run_once():
+        st = replay_trace(model, params, trace, policy="continuous",
+                          num_slots=slots, slo_objective=0.99)
+        return st["tokens_per_s"]
+
+    def scrape(base, path):
+        try:
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return r.read().decode()
+        except urllib.error.HTTPError as e:  # 503 is still a scrape
+            return e.read().decode()
+
+    t0 = time.time()
+    telemetry.shutdown()
+    run_once()  # XLA compile warmup, outside both measurements
+    run_dir = tempfile.mkdtemp(prefix="dalle_obs_bench_")
+    best = {"off": 0.0, "on": 0.0}
+    for _ in range(repeats):
+        telemetry.shutdown()
+        best["off"] = max(best["off"], run_once())
+        telemetry.configure(run_dir, metrics_interval_s=3600.0,
+                            http_port=0)
+        best["on"] = max(best["on"], run_once())
+
+    # under-load scrape evidence, OUTSIDE the timed comparison: one extra
+    # burst with a 50Hz scrape hammer racing it — the hammer costs host
+    # CPU, so it must not contaminate the overhead ratio above
+    base = telemetry.introspection().url
+    load_scrapes, load_parse_errors, healthz_under_load = 0, [], 0
+    stop = threading.Event()
+
+    def hammer():
+        nonlocal load_scrapes, healthz_under_load
+        while not stop.is_set():
+            try:
+                parse_prometheus(scrape(base, "/metrics"))
+                load_scrapes += 1
+                hz = _json.loads(scrape(base, "/healthz"))
+                if isinstance(hz.get("ok"), bool):
+                    healthz_under_load += 1
+            except Exception as e:  # noqa: BLE001 — gate evidence
+                load_parse_errors.append(f"{type(e).__name__}: {e}")
+            stop.wait(0.02)
+
+    th = threading.Thread(target=hammer, daemon=True)
+    th.start()
+    run_once()
+    stop.set()
+    th.join(timeout=5)
+
+    # quiescent exactness: HTTP scrape vs a direct registry snapshot,
+    # both through the same parse oracle — no traffic, so byte-for-value
+    # agreement is the contract, not an approximation
+    scraped = parse_prometheus(scrape(base, "/metrics"))
+    snap = telemetry.registry().exposition_snapshot()
+    direct = parse_prometheus(render_prometheus(snap))
+    metrics_exact = scraped == direct
+    slo_att = scraped.get("slo_attainment_fast")
+    slo_ok = slo_att is not None and 0.0 <= slo_att <= 1.0
+
+    rec = telemetry.flight_recorder()
+    dump_path = rec.dump("bench_observability")
+    with open(dump_path) as f:
+        doc = _json.load(f)
+    flight_ok = (
+        {"reason", "time", "ring", "spans", "metrics"} <= set(doc)
+        and doc["reason"] == "bench_observability"
+    )
+    telemetry.shutdown()
+    ratio = best["on"] / max(best["off"], 1e-9)
+    _hb(
+        f"observability: off={best['off']:.1f} on={best['on']:.1f} tok/s "
+        f"ratio={ratio:.4f} load_scrapes={load_scrapes} "
+        f"exact={metrics_exact} slo={slo_att} flight={flight_ok}"
+    )
+    res = {
+        "n_requests": n_req,
+        "num_slots": slots,
+        "repeats": repeats,
+        "tokens_per_s_off": round(best["off"], 2),
+        "tokens_per_s_on": round(best["on"], 2),
+        "on_over_off": round(ratio, 4),
+        "overhead_gate": 0.98,
+        "scrapes_under_load": load_scrapes,
+        "healthz_under_load": healthz_under_load,
+        "scrape_errors": load_parse_errors[:5],
+        "metrics_series": len(scraped),
+        "metrics_exact": metrics_exact,
+        "slo_attainment_fast": slo_att,
+        "flight_dump": os.path.basename(dump_path),
+        "flight_ok": flight_ok,
+        "telemetry_dir": run_dir,
+    }
+    res["wall_s"] = round(time.time() - t0, 1)
+    fails = []
+    if ratio < 0.98:
+        fails.append(f"plane on/off {ratio:.4f}x (gate 0.98x)")
+    if load_parse_errors:
+        fails.append(f"{len(load_parse_errors)} scrape errors under load")
+    if load_scrapes == 0 or healthz_under_load == 0:
+        fails.append("no successful under-load scrapes")
+    if not metrics_exact:
+        fails.append("/metrics != registry snapshot")
+    if not slo_ok:
+        fails.append(f"slo_attainment_fast {slo_att!r} unpublished")
+    if not flight_ok:
+        fails.append("flight dump failed json round-trip")
+    if fails:
+        res["rung_failed"] = "; ".join(fails)
+    return res
+
+
 def _serving_cache_bench():
     """Serving cache rung (docs/SERVING.md §7, the ISSUE 8 pin).
 
@@ -2321,6 +2500,7 @@ PHASE_FNS = {
     "resilience": _resilience_bench,
     "serving_resilience": _serving_resilience_bench,
     "telemetry_overhead": _telemetry_overhead_bench,
+    "observability": _observability_bench,
     "serving_cache": _serving_cache_bench,
     "serving_fleet": _serving_fleet_bench,
 }

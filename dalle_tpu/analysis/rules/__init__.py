@@ -12,6 +12,7 @@ from dalle_tpu.analysis.rules.donation_after_use import DonationAfterUseRule
 from dalle_tpu.analysis.rules.event_kinds import EventKindsRule
 from dalle_tpu.analysis.rules.f32_accum import F32AccumRule
 from dalle_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from dalle_tpu.analysis.rules.metric_names import MetricNamesRule
 from dalle_tpu.analysis.rules.policy_sync import PolicySyncRule
 from dalle_tpu.analysis.rules.recompile_hazard import RecompileHazardRule
 from dalle_tpu.analysis.walker import Rule
@@ -21,6 +22,7 @@ ALL_RULES: Dict[str, Rule] = {
     for r in (
         PolicySyncRule(),
         EventKindsRule(),
+        MetricNamesRule(),
         RecompileHazardRule(),
         DonationAfterUseRule(),
         F32AccumRule(),

@@ -1,0 +1,189 @@
+"""metric-names: every registry instrument name is declared, none dead.
+
+The observability plane (docs/OBSERVABILITY.md) hangs dashboards,
+/metrics scrapes and the flight recorder off instrument *names* — a
+typo'd ``telemetry.inc("serve_admited")`` silently creates a parallel
+counter nothing reads, and a renamed-but-undeclared metric breaks every
+consumer without a test failing.  So the name set lives in ONE table
+(``METRIC_NAMES`` in ``dalle_tpu/telemetry/schema.py``) and this rule
+AST-verifies the callsites against it, mirroring ``event-kinds``:
+
+* ``registry.counter/gauge/histogram("<literal>")`` getters and
+  ``telemetry.inc/set_gauge/observe("<literal>", ...)`` forwarders must
+  name a declared metric (exact, or prefix of a declared ``*`` family);
+* f-string names must carry a literal prefix matching a ``*`` family
+  (``f"events_{kind}"`` -> ``events_*``);
+* a non-literal getter arg is flagged — only the session forwarder in
+  ``dalle_tpu/telemetry/__init__.py`` routes dynamic names.  The
+  ``inc/set_gauge/observe`` spellings are only validated when the first
+  arg IS a (f-)string literal: ``hist.observe(dt)`` / ``c.inc(1)`` are
+  instrument methods, not forwarders, and must not collide;
+* a declared name no scanned callsite ever uses is schema rot
+  (whole-tree runs only, like dead event kinds).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dalle_tpu.analysis.walker import (
+    Finding, LintContext, Module, Rule,
+)
+
+SCHEMA_PATH = "dalle_tpu/telemetry/schema.py"
+FORWARDER_PATH = "dalle_tpu/telemetry/__init__.py"
+TABLE_NAME = "METRIC_NAMES"
+
+#: getter spellings: an Attribute call returning an instrument
+GETTERS = ("counter", "gauge", "histogram")
+#: forwarder spellings: validated only on (f-)string-literal first args
+FORWARDERS = ("inc", "set_gauge", "observe")
+#: receivers whose same-named methods are NOT registry getters
+#: (``np.histogram(values, bins=...)``)
+_FOREIGN_RECEIVERS = frozenset({"np", "numpy", "jnp", "jax", "scipy"})
+
+_PACKAGED_SCHEMA = os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..", "..",
+                 "telemetry", "schema.py")
+)
+
+
+def parse_metric_names(tree: ast.Module) -> Dict[str, int]:
+    """{name: lineno} from the METRIC_NAMES dict literal, {} if absent."""
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == TABLE_NAME \
+                    and isinstance(value, ast.Dict):
+                return {
+                    k.value: k.lineno
+                    for k in value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+    return {}
+
+
+def load_metric_names(
+    ctx: LintContext,
+) -> Tuple[Dict[str, int], Optional[Module]]:
+    """(names table, in-tree schema Module or None)."""
+    schema = ctx.module(SCHEMA_PATH)
+    if schema is not None and schema.tree is not None:
+        return parse_metric_names(schema.tree), schema
+    try:
+        with open(_PACKAGED_SCHEMA, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=_PACKAGED_SCHEMA)
+    except (OSError, SyntaxError):
+        return {}, None
+    return parse_metric_names(tree), None
+
+
+def _literal_prefix(node: ast.JoinedStr) -> str:
+    """The leading constant text of an f-string (may be '')."""
+    out = []
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            out.append(part.value)
+        else:
+            break
+    return "".join(out)
+
+
+def _match(name: str, names: Dict[str, int]) -> bool:
+    """is_known_metric semantics: exact, or member of a ``*`` family."""
+    if name in names:
+        return True
+    return any(
+        pat.endswith("*") and name.startswith(pat[:-1]) for pat in names
+    )
+
+
+def _family_of_prefix(prefix: str, names: Dict[str, int]) -> Optional[str]:
+    """The ``*`` family a dynamic name with this literal prefix lands in
+    (the prefix must reach at least the family's own prefix)."""
+    for pat in names:
+        if pat.endswith("*") and prefix.startswith(pat[:-1]):
+            return pat
+    return None
+
+
+class MetricNamesRule(Rule):
+    name = "metric-names"
+    summary = (
+        "registry instrument names are declared in telemetry/schema.py "
+        "METRIC_NAMES; declared names are actually used somewhere"
+    )
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        names, schema = load_metric_names(ctx)
+        if not names:
+            return  # no table anywhere: nothing to validate against
+        used = set()
+        for m in ctx.modules:  # full tree: dead-name needs every callsite
+            if m.tree is None or m.rel == SCHEMA_PATH:
+                continue
+            in_selection = ctx.selected is None or m.rel in ctx.selected
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                is_getter = (
+                    isinstance(f, ast.Attribute) and f.attr in GETTERS
+                    and not (isinstance(f.value, ast.Name)
+                             and f.value.id in _FOREIGN_RECEIVERS)
+                )
+                is_fwd = (
+                    isinstance(f, ast.Attribute) and f.attr in FORWARDERS
+                ) or (isinstance(f, ast.Name) and f.id in FORWARDERS)
+                if not (is_getter or is_fwd) or not node.args:
+                    continue
+                first = node.args[0]
+                if isinstance(first, ast.Constant) \
+                        and isinstance(first.value, str):
+                    used.add(first.value)
+                    if not _match(first.value, names) and in_selection:
+                        yield self.finding(
+                            m, node.lineno,
+                            f"unknown metric name {first.value!r} — "
+                            "declare it in METRIC_NAMES "
+                            "(dalle_tpu/telemetry/schema.py)",
+                        )
+                elif isinstance(first, ast.JoinedStr):
+                    prefix = _literal_prefix(first)
+                    fam = _family_of_prefix(prefix, names)
+                    if fam is not None:
+                        used.add(fam)
+                    elif in_selection:
+                        yield self.finding(
+                            m, node.lineno,
+                            f"dynamic metric name (literal prefix "
+                            f"{prefix!r}) matches no declared '*' "
+                            "family in METRIC_NAMES",
+                        )
+                elif is_getter and m.rel != FORWARDER_PATH \
+                        and in_selection:
+                    yield self.finding(
+                        m, node.lineno,
+                        "non-literal metric name — only the telemetry "
+                        f"forwarder in {FORWARDER_PATH} may route "
+                        "dynamic names",
+                    )
+        # dead names: whole-tree runs with the schema in the scanned set
+        if schema is not None and ctx.whole_tree:
+            for name, line in sorted(names.items()):
+                if name not in used:
+                    yield self.finding(
+                        schema, line,
+                        f"dead metric name {name!r}: declared in "
+                        "METRIC_NAMES but no scanned callsite ever uses "
+                        "it — instrument it or drop the row",
+                    )

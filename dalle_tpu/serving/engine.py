@@ -451,6 +451,23 @@ class DecodeEngine:
             return None
         return max(0, self._slot_done[slot] - self.tick_count)
 
+    def status(self) -> dict:
+        """Host-side engine snapshot for /statusz — pure bookkeeping
+        reads, never a device sync."""
+        return {
+            "tick_count": self.tick_count,
+            "num_slots": self.num_slots,
+            "active": self.num_active,
+            "busy_ticks": sum(
+                self.remaining_ticks(b) or 0 for b in range(self.num_slots)
+            ),
+            "prefill_requests": self.prefill_requests,
+            "prefix_reuses": self.prefix_reuses,
+            "in_flight": [
+                r.request_id for r in self.slot_req if r is not None
+            ],
+        }
+
     def evict(self, slot: int) -> Optional[Request]:
         """Free ``slot`` mid-flight: deactivate the lane on device and
         drop the host bookkeeping.  The evicted request's codes are

@@ -40,8 +40,14 @@ from dalle_tpu.serving.engine import DecodeEngine
 from dalle_tpu.serving.fleet.router import ReplicaView, Router
 from dalle_tpu.serving.fleet.worker import ReplicaWorker
 from dalle_tpu.serving.queue import Request, RequestQueue
-from dalle_tpu.serving.scheduler import TraceItem, request_stats
+from dalle_tpu.serving.scheduler import (
+    TraceItem,
+    latency_percentiles,
+    request_stats,
+)
 from dalle_tpu.telemetry import MetricsRegistry
+from dalle_tpu.telemetry import exposition
+from dalle_tpu.telemetry.slo import SloTracker
 from dalle_tpu.training.logging import log_event
 
 
@@ -145,6 +151,7 @@ class ReplicaSupervisor:
                 for r in unfinished:
                     r._fail(reason)
                     worker._c_failed.inc()
+                    worker._slo_account(r)
                     worker.completed.append(r)
                 self.failed += len(unfinished)
 
@@ -172,6 +179,7 @@ class Fleet:
         fingerprint: Optional[str] = None,
         metrics: Optional[MetricsRegistry] = None,
         queue: Optional[RequestQueue] = None,
+        slo_objective: Optional[float] = None,
         **scheduler_kwargs,
     ):
         assert replicas >= 1, f"need at least one replica, got {replicas}"
@@ -234,6 +242,13 @@ class Fleet:
         )
         if result_cache is not None and fingerprint is None:
             fingerprint = model_fingerprint(model.cfg)
+        # ONE fleet-wide SLO tracker: the objective is over the fleet's
+        # deadlined traffic, not per replica — every worker accounts
+        # into the same sliding windows
+        self.slo = (
+            SloTracker(objective=slo_objective, registry=metrics)
+            if slo_objective is not None else None
+        )
         self.workers: List[ReplicaWorker] = []
         for rid in range(replicas):
             engine = DecodeEngine(
@@ -246,7 +261,7 @@ class Fleet:
             worker = ReplicaWorker(
                 engine, view, supervisor=self.supervisor, replica_id=rid,
                 policy=policy, metrics=metrics, result_cache=result_cache,
-                fingerprint=fingerprint, **scheduler_kwargs,
+                fingerprint=fingerprint, slo=self.slo, **scheduler_kwargs,
             )
             view.worker = worker
             self.router.register(rid, num_slots)
@@ -289,10 +304,18 @@ class Fleet:
                              name=f"replica{w.replica_id}")
             for w in self.workers
         ]
+        # fleet-level introspection: /healthz per-replica readiness from
+        # supervisor+router state (the contract the future HTTP gateway
+        # polls — ROADMAP item 1), /statusz router load snapshots
+        exposition.register_provider(
+            "fleet", status=self.status_snapshot,
+            health=self.health_snapshot,
+        )
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        exposition.unregister_provider("fleet")
         # every replica has exited — nothing can serve what's left, and
         # nothing more may be accepted (submit now raises)
         self.queue.close()
@@ -314,6 +337,59 @@ class Fleet:
         )
         return stats
 
+    # --- live introspection ----------------------------------------------
+    def health_snapshot(self) -> dict:
+        """/healthz: per-replica readiness from supervisor/router state.
+        A killed replica's row flips ``alive: false`` the moment the
+        supervisor retires it; the fleet stays ``ok`` while at least one
+        replica can still serve (drained work replays on survivors)."""
+        alive = set(self.router.alive())
+        replicas = {}
+        for w in self.workers:
+            rid = w.replica_id
+            replicas[str(rid)] = {
+                "ok": rid in alive and not w.killed and w._fatal is None,
+                "alive": rid in alive,
+                "killed": w.killed,
+                "fatal": w._fatal,
+                "restarts": w._restarts,
+            }
+        return {
+            "ok": len(alive) > 0,
+            "alive": sorted(alive),
+            "replicas": replicas,
+            "crashes": self.supervisor.crashes,
+            "drained": self.supervisor.drained,
+            "drain_failed": self.supervisor.failed,
+        }
+
+    def status_snapshot(self) -> dict:
+        """/statusz: router load snapshots + fleet-wide cache hit rates
+        and engine restart counts (registry reads only)."""
+        m = self.metrics
+        hits = m.counter("serve_cache_hits").value
+        misses = m.counter("serve_cache_misses").value
+        out = {
+            "replicas": len(self.workers),
+            "pending": self.queue.pending(),
+            "queue_closed": self.queue.closed,
+            "router": self.router.load_snapshot(),
+            "router_steered": self.router.steered,
+            "router_denied": self.router.denied,
+            "engine_restarts": m.counter("serve_engine_restarts").value,
+            "replica_crashes": self.supervisor.crashes,
+            "cache": {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": (
+                    hits / (hits + misses) if (hits + misses) else None
+                ),
+            },
+        }
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+        return out
+
     # --- stats -----------------------------------------------------------
     def stats(self) -> dict:
         """Fleet-level stats: :func:`request_stats` over the union of all
@@ -324,10 +400,6 @@ class Fleet:
         for w in self.workers:
             all_completed.extend(w.completed)
         m = self.metrics
-
-        def c(name):
-            return m.counter(name).value
-
         out = {
             "replicas": len(self.workers),
             "policy": "continuous",
@@ -336,12 +408,12 @@ class Fleet:
             **request_stats(all_completed, self.S),
         }
         out.update(
-            admitted=c("serve_admitted"),
-            failed=c("serve_failed"),
+            admitted=m.counter("serve_admitted").value,
+            failed=m.counter("serve_failed").value,
             shed=len(self.queue.shed),
-            cache_hits=c("serve_cache_hits"),
-            cache_misses=c("serve_cache_misses"),
-            prefix_reuses=c("serve_prefix_reuses"),
+            cache_hits=m.counter("serve_cache_hits").value,
+            cache_misses=m.counter("serve_cache_misses").value,
+            prefix_reuses=m.counter("serve_prefix_reuses").value,
             prefill_requests=sum(
                 w.engine.prefill_requests for w in self.workers
             ),
@@ -349,8 +421,8 @@ class Fleet:
                 w.engine.prefill_admits for w in self.workers
             ),
             pool_admits=sum(w.engine.pool_admits for w in self.workers),
-            engine_restarts=c("serve_engine_restarts"),
-            replays=c("serve_replays"),
+            engine_restarts=m.counter("serve_engine_restarts").value,
+            replays=m.counter("serve_replays").value,
             max_pending_seen=self.queue.max_pending_seen,
             replica_crashes=self.supervisor.crashes,
             drained_requests=self.supervisor.drained,
@@ -359,6 +431,9 @@ class Fleet:
             router_denied=self.router.denied,
             per_replica=[w.replica_stats() for w in self.workers],
         )
+        out["latency"] = latency_percentiles(m)
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         return out
 
 

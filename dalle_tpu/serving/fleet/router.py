@@ -36,6 +36,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Set
 
+from dalle_tpu import telemetry
 from dalle_tpu.serving.queue import Request, RequestQueue
 from dalle_tpu.training.logging import log_event
 
@@ -175,12 +176,35 @@ class Router:
                 grant -= 1
         if kicked:
             self.queue.kick()  # end the hinted replica's idle wait now
+        tr = telemetry.tracer()
+        if tr.enabled and out:
+            # timeline seam (outside the lock): one grant marker per
+            # request, so --request <id> shows queue -> grant -> admit
+            for r in out:
+                tr.instant("router_grant", track="router",
+                           request_id=r.request_id, replica=rid)
         return out
 
     # --- view support ----------------------------------------------------
     def pending_for(self, rid: int) -> int:
         with self._lock:
             return self.queue.pending() + len(self._stash.get(rid, ()))
+
+    # --- live introspection ----------------------------------------------
+    def load_snapshot(self) -> dict:
+        """Per-replica last-poll load for /statusz — the same numbers
+        the placement policy steers on."""
+        with self._lock:
+            return {
+                str(rid): {
+                    "alive": rid in self._alive,
+                    "busy_ticks": load[0],
+                    "free_slots": load[1],
+                    "tick_ewma_s": load[2],
+                    "stashed": len(self._stash.get(rid, ())),
+                }
+                for rid, load in self._load.items()
+            }
 
 
 class ReplicaView:

@@ -62,6 +62,14 @@ class ReplicaWorker(Scheduler):
     def _confirm_drained(self) -> bool:
         return self.supervisor.confirm_exit(self.replica_id)
 
+    def health_snapshot(self) -> dict:
+        """/healthz row: a killed replica reads not-ok the instant the
+        kill is requested, before the next tick observes it."""
+        out = super().health_snapshot()
+        out["killed"] = self.killed
+        out["ok"] = out["ok"] and not self.killed
+        return out
+
     def _fail_unfinished(self) -> None:
         self.supervisor.on_replica_exit(self)
 

@@ -53,6 +53,8 @@ from dalle_tpu.serving.cache import (
 from dalle_tpu.serving.engine import DecodeEngine
 from dalle_tpu.serving.queue import Request, RequestQueue
 from dalle_tpu.telemetry import MetricsRegistry
+from dalle_tpu.telemetry import exposition
+from dalle_tpu.telemetry.slo import SloTracker
 from dalle_tpu.training import faults
 from dalle_tpu.training.logging import log_event
 
@@ -168,6 +170,8 @@ class Scheduler:
         result_cache: Optional[ResultCache] = None,
         fingerprint: Optional[str] = None,
         replica_id: Optional[int] = None,
+        slo: Optional[SloTracker] = None,
+        slo_objective: Optional[float] = None,
     ):
         assert policy in POLICIES, f"policy must be one of {POLICIES}"
         self.engine = engine
@@ -237,6 +241,14 @@ class Scheduler:
         self._h_decode = metrics.histogram("serve_decode_s")
         self._h_detok = metrics.histogram("serve_detok_s")
         self._h_ttlt = metrics.histogram("serve_ttlt_s")
+        # SLO engine (docs/OBSERVABILITY.md): deadline-attainment windows
+        # + burn-rate alerting.  In a fleet the tracker is shared (built
+        # once by the Fleet, passed in) so the windows see fleet-wide
+        # traffic; ``slo_objective`` builds a private one for standalone
+        # schedulers.
+        if slo is None and slo_objective is not None:
+            slo = SloTracker(objective=slo_objective, registry=metrics)
+        self._slo = slo
         try:  # live gauge backed by the analytic decode byte model
             from dalle_tpu.training.profiler import decode_tick_attn_bytes
 
@@ -330,6 +342,14 @@ class Scheduler:
             finally:
                 req._mark_done()  # releases waiters + variations fan-in
 
+    def _slo_account(self, req: Request) -> None:
+        """Deadline-attainment accounting: called exactly once per
+        terminal request state (completion, drop, eviction, crash-fail,
+        exit-fail).  ``ttlt`` is None for anything that never sampled
+        its last token — a miss whenever a deadline was declared."""
+        if self._slo is not None:
+            self._slo.observe_request(req.ttlt, req.deadline_s)
+
     # --- admission -------------------------------------------------------
     def _want(self, n_free: int) -> int:
         B = self.engine.num_slots
@@ -358,6 +378,7 @@ class Scheduler:
             ):
                 r._fail("dropped: deadline expired before admission")
                 self._c_failed.inc()
+                self._slo_account(r)
                 self.completed.append(r)
             else:
                 keep.append(r)
@@ -409,6 +430,7 @@ class Scheduler:
         self._c_completed.inc()
         if req.ttlt is not None:
             self._h_ttlt.observe(req.ttlt)
+        self._slo_account(req)
         log_event("serve_cache_hit", request_id=req.request_id,
                   key=req.cache_key[:16])
         self.completed.append(req)
@@ -532,6 +554,7 @@ class Scheduler:
                 self.completed.append(req)
                 self._c_evicted.inc()
                 self._c_failed.inc()
+                self._slo_account(req)
                 if req.admit_time is not None:
                     self.tracer.complete(
                         "decode(evicted)", req.admit_time, time.monotonic(),
@@ -578,6 +601,7 @@ class Scheduler:
                 )
                 self._requeue_followers(r)
                 self._c_failed.inc()
+                self._slo_account(r)
                 self.completed.append(r)
                 failed.append(r.request_id)
             else:
@@ -635,6 +659,7 @@ class Scheduler:
         for req in self._collect_unfinished():
             req._fail(reason)
             self._c_failed.inc()
+            self._slo_account(req)
             self.completed.append(req)
 
     # --- main loop -------------------------------------------------------
@@ -668,6 +693,13 @@ class Scheduler:
                         track=self._tp + "queue", request_id=r.request_id,
                         slot=r.slot,
                     )
+                    # timeline seam: one admit marker per request so
+                    # --request <id> sees queue -> [grant ->] admit ->
+                    # decode -> detok end to end
+                    self.tracer.instant(
+                        "admit", track=self._tp + "scheduler",
+                        request_id=r.request_id, slot=r.slot,
+                    )
         drained = False
         if eng.num_active:
             t0 = time.monotonic()
@@ -694,6 +726,7 @@ class Scheduler:
                 self._h_decode.observe(req.finish_time - req.admit_time)
                 if req.ttlt is not None:
                     self._h_ttlt.observe(req.ttlt)
+                self._slo_account(req)
                 self.completed.append(req)
                 self._detok_q.put(req)
                 self._resolve_cache(req)
@@ -709,16 +742,24 @@ class Scheduler:
             self.queue.wait(timeout=self.idle_wait)
         backlog = self._detok_q.qsize()
         self.detok_backlog_peak = max(self.detok_backlog_peak, backlog)
-        g = self.metrics.gauge
-        g("serve_pending").set(self.queue.pending())
-        g("serve_detok_backlog").set(backlog)
-        g("serve_occupancy").set(eng.num_active)
+        self.metrics.gauge("serve_pending").set(self.queue.pending())
+        self.metrics.gauge("serve_detok_backlog").set(backlog)
+        self.metrics.gauge("serve_occupancy").set(eng.num_active)
         if self.result_cache is not None:
-            g("serve_cache_bytes").set(self.result_cache.bytes)
+            self.metrics.gauge("serve_cache_bytes").set(
+                self.result_cache.bytes
+            )
         if self._tick_ewma is not None:
-            g("serve_tick_ewma_s").set(self._tick_ewma)
+            self.metrics.gauge("serve_tick_ewma_s").set(self._tick_ewma)
         if self._degrade is not None:
-            self._degrade.update(self.queue.pending() + backlog)
+            pressure = self.queue.pending() + backlog
+            if self._slo is not None:
+                # a firing burn-rate alert is load the queue depth can't
+                # see (e.g. deadlines too tight for the tick rate):
+                # scaled by the slot count it clears the default degrade
+                # threshold (high = 2B) on its own
+                pressure += self._slo.pressure() * eng.num_slots
+            self._degrade.update(pressure)
         return drained
 
     def run(self) -> dict:
@@ -728,6 +769,16 @@ class Scheduler:
         ``result()`` waiters, with ``error`` set on the unfinished."""
         worker = threading.Thread(target=self._detok_loop, daemon=True)
         worker.start()
+        # live introspection: /statusz and /healthz read this loop while
+        # it serves (fleet replicas each register their own row)
+        provider = (
+            f"replica{self.replica_id}" if self.replica_id is not None
+            else "scheduler"
+        )
+        exposition.register_provider(
+            provider, status=self.status_snapshot,
+            health=self.health_snapshot,
+        )
         try:
             while True:
                 try:
@@ -740,6 +791,44 @@ class Scheduler:
             self._detok_q.put(None)
             worker.join()
             self._fail_unfinished()
+            exposition.unregister_provider(provider)
+
+    # --- live introspection ----------------------------------------------
+    def status_snapshot(self) -> dict:
+        """The /statusz row for this scheduler: cheap reads only — this
+        runs on the introspection server's thread, racing the loop."""
+        eng = self.engine
+        out = {
+            "replica_id": self.replica_id,
+            "policy": self.policy,
+            "pending": self.queue.pending(),
+            "occupancy": eng.num_active,
+            "num_slots": eng.num_slots,
+            "tick_count": eng.tick_count,
+            "tick_ewma_s": self._tick_ewma,
+            "detok_backlog": self._detok_q.qsize(),
+            "engine_restarts": self._restarts,
+            "completed": len(self.completed),
+            "cache_bytes": (
+                self.result_cache.bytes
+                if self.result_cache is not None else 0
+            ),
+            "degrade_tier": (
+                self._degrade.tier if self._degrade is not None else 0
+            ),
+            "engine": eng.status(),
+        }
+        if self._slo is not None:
+            out["slo"] = self._slo.snapshot()
+        return out
+
+    def health_snapshot(self) -> dict:
+        """The /healthz row: ready = still able to admit work."""
+        return {
+            "ok": self._fatal is None,
+            "fatal": self._fatal,
+            "restarts": self._restarts,
+        }
 
     # --- metrics ---------------------------------------------------------
     @property
@@ -790,7 +879,31 @@ class Scheduler:
                 self._degrade.transitions if self._degrade is not None else 0
             ),
         )
+        out["latency"] = latency_percentiles(self.metrics)
+        if self._slo is not None:
+            out["slo"] = self._slo.snapshot()
         return out
+
+
+def latency_percentiles(metrics: MetricsRegistry) -> dict:
+    """p50/p95/p99 for the serving latency histograms, read straight
+    from the registry — the ``serve_summary`` event and the printed
+    stats JSON carry these so chaos/bench runs stop re-deriving
+    percentiles by hand.  In a fleet the registry is shared, so these
+    are fleet-wide."""
+    out = {}
+    for key, h in (
+        ("ttlt_s", metrics.histogram("serve_ttlt_s")),
+        ("queue_wait_s", metrics.histogram("serve_queue_wait_s")),
+        ("tick_s", metrics.histogram("serve_tick_s")),
+    ):
+        out[key] = {
+            "count": h.count,
+            "p50": h.percentile(50),
+            "p95": h.percentile(95),
+            "p99": h.percentile(99),
+        }
+    return out
 
 
 # --- arrival traces (bench rung + tools/serving_bench.py) -----------------

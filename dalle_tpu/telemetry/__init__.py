@@ -49,33 +49,55 @@ class TelemetrySession:
     """Everything one telemetry run owns; built by :func:`configure`."""
 
     def __init__(self, *, run_dir: Optional[str], metrics_interval_s: float,
-                 trace_capacity: int):
+                 trace_capacity: int, http_port: Optional[int] = None):
+        from dalle_tpu.telemetry.recorder import FlightRecorder
+
         self.run_dir = str(run_dir) if run_dir is not None else None
         self.registry = MetricsRegistry(enabled=True)
         self.tracer = Tracer(capacity=trace_capacity, enabled=True)
         self.writer: Optional[SnapshotWriter] = None
+        self.recorder: Optional[FlightRecorder] = None
+        self.server = None  # IntrospectionServer when http_port is set
         if self.run_dir is not None:
             import os
 
             os.makedirs(self.run_dir, exist_ok=True)
+            self.recorder = FlightRecorder(
+                self.run_dir, registry=self.registry, tracer=self.tracer,
+            )
             self.writer = SnapshotWriter(
                 self.registry, os.path.join(self.run_dir, "metrics.jsonl"),
                 interval_s=metrics_interval_s,
+                on_snapshot=self.recorder.note_metrics,
             )
             self.writer.start()
+        if http_port is not None:
+            from dalle_tpu.telemetry.exposition import IntrospectionServer
+
+            self.server = IntrospectionServer(
+                http_port,
+                registry_fn=lambda: self.registry,
+                tracer_fn=lambda: self.tracer,
+            ).start()
 
     def _on_event(self, rec: dict) -> None:
-        """log_event hook: count the kind + drop an instant marker."""
+        """log_event hook: count the kind + drop an instant marker (+
+        feed the flight recorder, which dumps on crash kinds)."""
         kind = rec.get("kind", "unknown")
         self.registry.counter(f"events_{kind}").inc()
         args = {k: v for k, v in rec.items()
                 if k not in ("_time", "kind")
                 and isinstance(v, (bool, int, float, str))}
         self.tracer.instant(kind, track="events", **args)
+        if self.recorder is not None:
+            self.recorder.on_event(rec)
 
     def close(self) -> Optional[str]:
-        """Stop the snapshot thread (final snapshot) and export the
-        trace.  Returns the trace path (None when no run dir)."""
+        """Stop the server + snapshot thread (final snapshot) and export
+        the trace.  Returns the trace path (None when no run dir)."""
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
         if self.writer is not None:
             self.writer.stop(final=True)
         if self.run_dir is not None:
@@ -94,9 +116,13 @@ class TelemetrySession:
 
 def configure(run_dir: Optional[str] = None, *,
               metrics_interval_s: float = 10.0,
-              trace_capacity: int = 65536) -> TelemetrySession:
+              trace_capacity: int = 65536,
+              http_port: Optional[int] = None) -> TelemetrySession:
     """Enable telemetry for this process (idempotent per call site: a
-    second configure replaces the session after closing the first)."""
+    second configure replaces the session after closing the first).
+    ``http_port`` additionally binds the live introspection server
+    (``/metrics``, ``/healthz``, ``/statusz``, ``/debug/trace``); port 0
+    picks an ephemeral port, read back from ``session().server.port``."""
     global _SESSION
     from dalle_tpu.training import logging as tlog
 
@@ -105,7 +131,7 @@ def configure(run_dir: Optional[str] = None, *,
             _shutdown_locked()
         sess = TelemetrySession(
             run_dir=run_dir, metrics_interval_s=metrics_interval_s,
-            trace_capacity=trace_capacity,
+            trace_capacity=trace_capacity, http_port=http_port,
         )
         tlog.add_event_hook(sess._on_event)
         _SESSION = sess
@@ -152,6 +178,21 @@ def tracer() -> Tracer:
     """The live tracer (a no-op tracer when off)."""
     s = _SESSION
     return s.tracer if s is not None else NOOP_TRACER
+
+
+def flight_recorder():
+    """The session's flight recorder (None when telemetry is off or the
+    session has no run dir).  Not named ``recorder()`` — that attribute
+    is the ``dalle_tpu.telemetry.recorder`` submodule."""
+    s = _SESSION
+    return s.recorder if s is not None else None
+
+
+def introspection():
+    """The session's live introspection server (None unless configured
+    with an ``http_port``)."""
+    s = _SESSION
+    return s.server if s is not None else None
 
 
 # --- cheap instrumentation helpers (no-op when disabled) --------------------
@@ -205,6 +246,12 @@ def add_telemetry_args(parser) -> None:
         help="seconds between metrics.jsonl snapshots (with --telemetry)",
     )
     g.add_argument(
+        "--telemetry_port", type=int, default=None, metavar="PORT",
+        help="bind the live introspection server on 127.0.0.1:PORT "
+             "(/metrics Prometheus exposition, /healthz, /statusz, "
+             "/debug/trace); implies --telemetry; 0 picks a free port",
+    )
+    g.add_argument(
         "--xla_profile_steps", type=str, default=None, metavar="A-B",
         help="capture a jax.profiler trace over steps A..B inclusive "
              "(e.g. 20-25); written under the run dir's xla_profile/",
@@ -212,12 +259,16 @@ def add_telemetry_args(parser) -> None:
 
 
 def configure_from_args(args, run_dir: Optional[str]) -> Optional[TelemetrySession]:
-    """Honor the ``add_telemetry_args`` flags; None when --telemetry is off."""
-    if not getattr(args, "telemetry", False):
+    """Honor the ``add_telemetry_args`` flags; None when the session is
+    off.  ``--telemetry_port`` implies ``--telemetry`` — a live scrape
+    endpoint without a registry behind it would be an empty page."""
+    port = getattr(args, "telemetry_port", None)
+    if not getattr(args, "telemetry", False) and port is None:
         return None
     return configure(
         run_dir=run_dir,
         metrics_interval_s=getattr(args, "metrics_interval_s", 10.0),
+        http_port=port,
     )
 
 

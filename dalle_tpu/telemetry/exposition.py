@@ -1,0 +1,276 @@
+"""Live introspection: the observability plane's HTTP surface.
+
+A tiny stdlib ``http.server`` on a daemon thread (``--telemetry_port``;
+port 0 binds an ephemeral port — tests and the bench rung use that), four
+endpoints:
+
+* ``/metrics``   — Prometheus text exposition rendered from a registry
+  snapshot.  Counters and gauges map 1:1; a histogram's fixed log-spaced
+  edges map directly to cumulative ``le`` buckets (plus ``+Inf``,
+  ``_sum`` and ``_count``).  Everything is one consistent
+  ``exposition_snapshot()`` — a scrape never sees a histogram's count
+  disagree with its buckets.
+* ``/healthz``   — process liveness + whatever health providers are
+  registered (the fleet registers per-replica readiness from supervisor
+  state).  200 when every provider says ok, 503 otherwise.  This is the
+  exact per-replica contract the future HTTP gateway polls (ROADMAP
+  item 1).
+* ``/statusz``   — JSON: registry snapshot + every registered status
+  provider (``Scheduler.stats()``, Router load snapshots, cache hit
+  rates, engine restart counts).
+* ``/debug/trace?track=T&n=N`` — the most recent spans/instants from the
+  tracer ring, optionally filtered by track.
+
+Status/health providers are process-global (one introspection surface
+per process, like the telemetry session itself): ``register_provider``
+from a serving loop, ``unregister_provider`` on its way out.
+
+See docs/OBSERVABILITY.md for the endpoint catalog and sample scrapes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from dalle_tpu.training.logging import log_event
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
+
+_PROVIDERS_LOCK = threading.Lock()
+_STATUS_PROVIDERS: Dict[str, Callable[[], dict]] = {}
+_HEALTH_PROVIDERS: Dict[str, Callable[[], dict]] = {}
+
+
+def register_provider(name: str, *, status: Optional[Callable] = None,
+                      health: Optional[Callable] = None) -> None:
+    """Attach ``status()``/``health()`` dict callables under ``name``.
+    Re-registering a name replaces it (latest serving loop wins)."""
+    with _PROVIDERS_LOCK:
+        if status is not None:
+            _STATUS_PROVIDERS[name] = status
+        if health is not None:
+            _HEALTH_PROVIDERS[name] = health
+
+
+def unregister_provider(name: str) -> None:
+    with _PROVIDERS_LOCK:
+        _STATUS_PROVIDERS.pop(name, None)
+        _HEALTH_PROVIDERS.pop(name, None)
+
+
+def _collect(providers: Dict[str, Callable]) -> dict:
+    with _PROVIDERS_LOCK:
+        items = list(providers.items())
+    out = {}
+    for name, fn in items:
+        try:
+            out[name] = fn()
+        except Exception as e:  # a sick provider must not kill the scrape
+            out[name] = {"ok": False,
+                         "error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+# --- Prometheus text rendering ----------------------------------------------
+
+
+def _metric_name(name: str) -> str:
+    """Prometheus metric names are ``[a-zA-Z_:][a-zA-Z0-9_:]*``; our
+    only off-grammar character is the ``:``-separated dynamic-family
+    label, which is already legal — everything else maps to ``_``."""
+    if _NAME_OK.match(name):
+        return name
+    name = _NAME_FIX.sub("_", name)
+    if not re.match(r"[a-zA-Z_:]", name):
+        name = "_" + name
+    return name
+
+
+def _fmt(v) -> str:
+    """Prometheus sample values: integers stay exact, floats use repr
+    (shortest round-trip), None renders as NaN."""
+    if v is None:
+        return "NaN"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def render_prometheus(snap: dict) -> str:
+    """Prometheus text exposition (format version 0.0.4) from a
+    ``MetricsRegistry.exposition_snapshot()``."""
+    lines: List[str] = []
+    for name in sorted(snap.get("counters", {})):
+        n = _metric_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(snap['counters'][name])}")
+    for name in sorted(snap.get("gauges", {})):
+        n = _metric_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(snap['gauges'][name])}")
+    for name in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][name]
+        n = _metric_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for edge, c in zip(h["edges"], h["counts"]):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{_fmt(edge)}"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{n}_sum {_fmt(h['sum'])}")
+        lines.append(f"{n}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal exposition parser (the scrape tests' oracle): returns
+    ``{metric_or_series: float}`` with bucket series keyed as
+    ``name_bucket{le="..."}``.  Raises ``ValueError`` on any line that
+    is neither a comment nor a well-formed sample."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(
+            r'([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{le="[^"]*"\})?)\s+(\S+)\Z',
+            line,
+        )
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        out[m.group(1)] = float(m.group(2))
+    return out
+
+
+# --- the server itself ------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one introspection request must never stall serving: no reverse
+    # DNS, no request logging, short socket timeouts
+    timeout = 10.0
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib spam
+        pass
+
+    def _reply(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_json(self, code: int, obj) -> None:
+        self._reply(code, json.dumps(obj, default=str) + "\n",
+                    "application/json")
+
+    def do_GET(self):  # noqa: N802 — stdlib handler contract
+        srv: "IntrospectionServer" = self.server.owner  # type: ignore
+        try:
+            url = urlparse(self.path)
+            if url.path == "/metrics":
+                text = render_prometheus(
+                    srv.registry_fn().exposition_snapshot()
+                )
+                self._reply(200, text,
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/healthz":
+                health = _collect(_HEALTH_PROVIDERS)
+                ok = all(h.get("ok", True) for h in health.values())
+                self._reply_json(200 if ok else 503, {
+                    "ok": ok,
+                    "uptime_s": round(time.monotonic() - srv.t0, 3),
+                    "providers": health,
+                })
+            elif url.path == "/statusz":
+                self._reply_json(200, {
+                    "time": time.time(),
+                    "uptime_s": round(time.monotonic() - srv.t0, 3),
+                    "metrics": srv.registry_fn().snapshot(),
+                    "status": _collect(_STATUS_PROVIDERS),
+                })
+            elif url.path == "/debug/trace":
+                q = parse_qs(url.query)
+                track = q.get("track", [None])[0]
+                n = int(q.get("n", ["256"])[0])
+                events = srv.tracer_fn().events()
+                if track is not None:
+                    events = [e for e in events if e["track"] == track]
+                self._reply_json(200, {"n": len(events[-n:]),
+                                       "events": events[-n:]})
+            else:
+                self._reply_json(404, {
+                    "error": f"no such endpoint: {url.path}",
+                    "endpoints": ["/metrics", "/healthz", "/statusz",
+                                  "/debug/trace"],
+                })
+        except BrokenPipeError:
+            pass  # scraper went away mid-reply
+        except Exception as e:
+            try:
+                self._reply_json(500, {
+                    "error": f"{type(e).__name__}: {e}",
+                })
+            except Exception:
+                pass
+
+
+class IntrospectionServer:
+    """The live observability endpoint, owned by the telemetry session.
+
+    ``registry_fn``/``tracer_fn`` are callables (not objects) so the
+    server always reads whatever the session currently owns; ``port=0``
+    binds an ephemeral port, read back from :attr:`port` after
+    construction.
+    """
+
+    def __init__(self, port: int, *, host: str = "127.0.0.1",
+                 registry_fn: Callable = None, tracer_fn: Callable = None):
+        if registry_fn is None or tracer_fn is None:
+            from dalle_tpu import telemetry
+
+            registry_fn = registry_fn or telemetry.registry
+            tracer_fn = tracer_fn or telemetry.tracer
+        self.registry_fn = registry_fn
+        self.tracer_fn = tracer_fn
+        self.t0 = time.monotonic()
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "IntrospectionServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.25},
+                name="telemetry-introspection", daemon=True,
+            )
+            self._thread.start()
+            log_event("introspection_started", host=self.host,
+                      port=self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._httpd.server_close()

@@ -1,0 +1,154 @@
+"""Flight recorder: crash forensics from a bounded in-memory ring.
+
+The recorder rides the telemetry session: every structured event and
+every periodic metrics snapshot (as a counter *delta*, not the full
+registry) lands in a bounded ring.  On a trigger — ``engine_crash``,
+``replica_crash`` (which also covers supervisor exhaustion: the fatal
+crash past the restart budget fires the same kinds), SIGTERM, or an
+explicit :meth:`dump` — the ring plus the most recent tracer spans and a
+full registry snapshot are written *atomically* (tmp file + ``rename``)
+to ``flight_<unix_ts>_<seq>.json`` in the run dir.  A dump is therefore
+always parseable: a reader never observes a half-written file, and a
+crash while dumping leaves the previous dump intact.
+
+Dump shape (docs/OBSERVABILITY.md §flight recorder)::
+
+    {
+      "reason": "engine_crash",
+      "time": 1699999999.5,
+      "ring": [ {"t": ..., "type": "event"|"metrics_delta", ...}, ... ],
+      "spans": [ ...last N tracer ring records... ],
+      "metrics": { ...full registry snapshot... },
+    }
+
+Dumps are rate-limited per *trigger kind* only by the monotonically
+increasing sequence number — every crash gets its own file, and chaos
+scenarios assert one exists and parses after every run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from dalle_tpu.training.logging import log_event
+
+# event kinds that dump the ring the moment they are observed
+TRIGGER_KINDS = ("engine_crash", "replica_crash")
+# kinds the ring records but must never re-trigger on (the dump itself
+# logs flight_dump, which the hook sees)
+_NO_RETRIGGER = ("flight_dump",)
+
+
+class FlightRecorder:
+    """Bounded ring of events + metric deltas, dumped atomically."""
+
+    def __init__(self, run_dir: str, *, registry=None, tracer=None,
+                 capacity: int = 4096, span_tail: int = 1024,
+                 triggers=TRIGGER_KINDS):
+        self.run_dir = str(run_dir)
+        self.registry = registry
+        self.tracer = tracer
+        self.span_tail = int(span_tail)
+        self.triggers = tuple(triggers)
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._last_counters: dict = {}
+        self._seq = 0
+        self.dumps: List[str] = []
+        self._prev_sigterm = None
+
+    # --- feeds -----------------------------------------------------------
+    def on_event(self, rec: dict) -> None:
+        """log_event hook (wired by the telemetry session): record the
+        event, dump if it is a trigger kind."""
+        kind = rec.get("kind")
+        with self._lock:
+            self._ring.append({"t": rec.get("_time", time.time()),
+                               "type": "event", "event": dict(rec)})
+        if kind in self.triggers and kind not in _NO_RETRIGGER:
+            self.dump(reason=kind)
+
+    def note_metrics(self, snapshot_rec: dict) -> None:
+        """SnapshotWriter callback: keep the ring light by recording
+        only counters that *moved* since the previous snapshot."""
+        counters = dict(snapshot_rec.get("counters", {}))
+        with self._lock:
+            delta = {
+                k: v - self._last_counters.get(k, 0)
+                for k, v in counters.items()
+                if v != self._last_counters.get(k, 0)
+            }
+            self._last_counters = counters
+            if delta:
+                self._ring.append({
+                    "t": snapshot_rec.get("_time", time.time()),
+                    "type": "metrics_delta", "counters": delta,
+                })
+
+    # --- the dump --------------------------------------------------------
+    def dump(self, reason: str = "forced") -> Optional[str]:
+        """Write the ring to ``flight_<ts>_<seq>.json``; returns the
+        path (None if the run dir is unwritable — forensics must never
+        take the process down with it)."""
+        with self._lock:
+            ring = list(self._ring)
+            self._seq += 1
+            seq = self._seq
+        spans = []
+        if self.tracer is not None:
+            spans = self.tracer.events()[-self.span_tail:]
+        metrics = self.registry.snapshot() if self.registry is not None \
+            else {}
+        doc = {
+            "reason": reason,
+            "time": time.time(),
+            "ring": ring,
+            "spans": spans,
+            "metrics": metrics,
+        }
+        name = f"flight_{int(doc['time'])}_{seq}.json"
+        path = os.path.join(self.run_dir, name)
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(self.run_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)  # atomic: readers see whole files only
+        except OSError:
+            return None
+        with self._lock:
+            self.dumps.append(path)
+        if self.registry is not None:
+            self.registry.counter("flight_dumps").inc()
+        log_event("flight_dump", reason=reason, path=path,
+                  ring_entries=len(ring), spans=len(spans))
+        return path
+
+    # --- SIGTERM ---------------------------------------------------------
+    def install_sigterm(self) -> bool:
+        """Dump on SIGTERM, then chain to the previous handler (the
+        resilience preemption path, or the default).  Main thread only —
+        returns False (and stays uninstalled) anywhere else."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _on_term(signum, frame):
+            self.dump(reason="sigterm")
+            prev = self._prev_sigterm
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            return False
+        return True

@@ -162,6 +162,17 @@ class Histogram:
             out[f"p{p}"] = self.percentile(p)
         return out
 
+    def exposition(self) -> dict:
+        """Raw per-bucket view for Prometheus rendering: ``counts`` has
+        one entry per edge plus the overflow bucket (``le="+Inf"``)."""
+        with self._lock:
+            return {
+                "edges": list(self.edges),
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": self._sum,
+            }
+
 
 class _NoopCounter:
     __slots__ = ()
@@ -260,6 +271,24 @@ class MetricsRegistry:
             "histograms": {h.name: h.snapshot() for h in hists},
         }
 
+    def exposition_snapshot(self) -> dict:
+        """Like :meth:`snapshot` but histograms carry their raw bucket
+        counts — what the Prometheus ``le`` rendering needs (the
+        percentile summary in :meth:`snapshot` cannot reconstruct
+        cumulative buckets)."""
+        if not self.enabled:
+            return {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._histograms.values())
+        return {
+            "counters": {c.name: c.value for c in counters},
+            "gauges": {g.name: g.value for g in gauges
+                       if g.value is not None},
+            "histograms": {h.name: h.exposition() for h in hists},
+        }
+
 
 class SnapshotWriter:
     """Background thread appending registry snapshots to metrics.jsonl.
@@ -271,10 +300,11 @@ class SnapshotWriter:
     """
 
     def __init__(self, registry: MetricsRegistry, path: str,
-                 interval_s: float = 10.0):
+                 interval_s: float = 10.0, on_snapshot=None):
         self.registry = registry
         self.path = str(path)
         self.interval_s = float(interval_s)
+        self.on_snapshot = on_snapshot  # e.g. the flight recorder's deltas
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -288,6 +318,11 @@ class SnapshotWriter:
                     f.write(json.dumps(rec) + "\n")
             except OSError:
                 pass  # snapshots are best-effort; never kill the run
+        if self.on_snapshot is not None:
+            try:
+                self.on_snapshot(rec)
+            except Exception:
+                pass  # observers are best-effort too
         return rec
 
     def _loop(self):

@@ -75,8 +75,97 @@ EVENT_KINDS: Dict[str, str] = {
                          "snapshot interval)",
     "xla_profile_start": "jax.profiler trace capture window opened",
     "xla_profile_stop": "jax.profiler trace capture window closed",
+    # --- observability plane (dalle_tpu/telemetry/{exposition,slo,recorder})
+    "introspection_started": "live introspection HTTP server bound "
+                             "(/metrics, /healthz, /statusz, /debug/trace)",
+    "slo_burn_alert": "deadline-attainment error budget burning too fast "
+                      "in BOTH the fast and slow windows",
+    "slo_burn_clear": "burn-rate alert condition cleared (both windows "
+                      "back under the alerting threshold)",
+    "flight_dump": "flight recorder dumped its ring to flight_<ts>.json "
+                   "(crash trigger, SIGTERM, or forced)",
 }
 
 
 def is_known_kind(kind: str) -> bool:
     return kind in EVENT_KINDS
+
+
+# --- metric names -----------------------------------------------------------
+#
+# Every registry instrument name used by ``telemetry.inc / set_gauge /
+# observe`` or a ``registry.counter / gauge / histogram`` getter must be
+# declared here — graftlint's ``metric-names`` rule AST-verifies the
+# callsites (and that no declared name is dead), and the Prometheus
+# exposition endpoint (telemetry/exposition.py) relies on the name set
+# being stable.  Names ending in ``*`` declare a dynamic family: the
+# callsite is an f-string whose literal prefix must match (e.g.
+# ``data_wait_s:{label}``).  The value is "kind: description".
+
+METRIC_NAMES: Dict[str, str] = {
+    # --- serving (dalle_tpu/serving/) ------------------------------------
+    "serve_submitted": "counter: requests accepted into the queue",
+    "serve_shed": "counter: requests shed by bounded admission",
+    "serve_admitted": "counter: requests admitted into engine slots",
+    "serve_completed": "counter: requests whose decode finished",
+    "serve_failed": "counter: requests failed (drop/evict/crash/exit)",
+    "serve_evicted": "counter: mid-flight deadline evictions",
+    "serve_replays": "counter: crash-replayed requests",
+    "serve_engine_restarts": "counter: engine rebuilds after a crash",
+    "serve_cache_hits": "counter: result-cache completions",
+    "serve_cache_misses": "counter: result-cache misses",
+    "serve_prefix_reuses": "counter: pooled text-KV prefill reuses",
+    "serve_tick_s": "histogram: one engine step wall time",
+    "serve_queue_wait_s": "histogram: enqueue -> EDF admission wait",
+    "serve_decode_s": "histogram: admission -> last token sampled",
+    "serve_detok_s": "histogram: finish -> detok/CLIP done",
+    "serve_ttlt_s": "histogram: submit -> last token (TTLT)",
+    "serve_pending": "gauge: shared-queue depth",
+    "serve_detok_backlog": "gauge: detok worker queue depth",
+    "serve_occupancy": "gauge: engine slots in flight",
+    "serve_tick_ewma_s": "gauge: per-tick seconds EWMA",
+    "serve_cache_bytes": "gauge: result-cache resident bytes",
+    # --- serving fleet (dalle_tpu/serving/fleet/) ------------------------
+    "fleet_replica_crashes": "counter: replica deaths (fault or kill)",
+    "fleet_drained_requests": "counter: requests drained onto survivors",
+    # --- SLO engine (dalle_tpu/telemetry/slo.py) -------------------------
+    "slo_deadline_total": "counter: deadlined requests accounted",
+    "slo_deadline_missed": "counter: deadlined requests that missed",
+    "slo_attainment_fast": "gauge: fast-window deadline attainment [0,1]",
+    "slo_attainment_slow": "gauge: slow-window deadline attainment [0,1]",
+    "slo_burn_rate_fast": "gauge: fast-window error-budget burn rate",
+    "slo_burn_rate_slow": "gauge: slow-window error-budget burn rate",
+    # --- flight recorder (dalle_tpu/telemetry/recorder.py) ---------------
+    "flight_dumps": "counter: flight-recorder dumps written",
+    # --- training (train_*.py, dalle_tpu/training/) ----------------------
+    "train_step_s": "histogram: synced training step wall time",
+    "train_mfu": "gauge: model FLOPs utilization",
+    "train_tokens_per_s": "gauge: training tokens/s",
+    "train_samples_per_s": "gauge: training samples/s",
+    "train_anomaly_skips": "counter: anomalous steps skipped in-step",
+    "train_anomaly_rollbacks": "counter: checkpoint rollbacks",
+    "train_modeled_wire_gb_per_step": "gauge: analytic comm GB/step",
+    "train_modeled_exposed_comm_s": "gauge: analytic exposed comm s/step",
+    "train_modeled_step_s": "gauge: analytic step seconds",
+    "decode_modeled_attn_bytes_per_tick": "gauge: analytic decode "
+                                          "attention bytes per tick",
+    # --- checkpointing (dalle_tpu/training/checkpoint.py) ----------------
+    "ckpt_saves_started": "counter: checkpoint writes begun",
+    "ckpt_saves_done": "counter: checkpoint writes completed",
+    "ckpt_write_s": "histogram: checkpoint write wall time",
+    "ckpt_writer_depth": "gauge: async checkpoint writer queue depth",
+    # --- dynamic families (f-string callsites; prefix-matched) -----------
+    "events_*": "counter family: one per structured-event kind",
+    "data_wait_s:*": "histogram family: prefetch get wait, per loader "
+                     "label",
+}
+
+
+def is_known_metric(name: str) -> bool:
+    """Exact names, or membership in a declared ``*`` family."""
+    if name in METRIC_NAMES:
+        return True
+    return any(
+        pat.endswith("*") and name.startswith(pat[:-1])
+        for pat in METRIC_NAMES
+    )

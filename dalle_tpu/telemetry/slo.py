@@ -1,0 +1,213 @@
+"""SLO engine: windowed deadline-attainment accounting + burn-rate alerts.
+
+The objective is over *deadlined* requests: "``objective`` of requests
+that declared a ``deadline_s`` finish their last token (TTLT) within
+it".  Requests without deadlines are best-effort and never touch the
+error budget.
+
+Accounting is two sliding windows (fast + slow) of good/total counts,
+each a ring of rotating time buckets layered over the registry — O(1)
+per request, bounded memory, and the window edge moves smoothly instead
+of resetting.  From each window:
+
+* **attainment** — ``good / total``;
+* **burn rate**  — ``miss_fraction / (1 - objective)``: 1.0 means the
+  error budget is being consumed exactly at the sustainable rate; 2.0
+  means twice as fast.
+
+The alert is the classic multi-window test: fire ``slo_burn_alert``
+only when BOTH windows burn above ``alert_burn`` (the fast window makes
+the alert responsive, the slow window keeps one bad burst from paging),
+and ``slo_burn_clear`` once both drop back under.  While alerting,
+:meth:`pressure` returns the fast burn rate so the scheduler's
+:class:`~dalle_tpu.serving.scheduler.DegradeController` sees SLO
+violation as queue-pressure-equivalent load and sheds service tiers
+(docs/OBSERVABILITY.md §SLO).
+
+Every reading is surfaced as gauges (``slo_attainment_fast/slow``,
+``slo_burn_rate_fast/slow``) and counters (``slo_deadline_total``,
+``slo_deadline_missed``) so ``/metrics`` scrapes and the flight
+recorder see the same numbers the alert fires on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from dalle_tpu.training.logging import log_event
+
+
+class SlidingWindow:
+    """Good/total counts over the trailing ``window_s`` seconds, kept in
+    ``n_buckets`` rotating time buckets (a read is at most one bucket
+    width stale at the trailing edge)."""
+
+    def __init__(self, window_s: float, n_buckets: int = 12):
+        assert window_s > 0 and n_buckets >= 1
+        self.window_s = float(window_s)
+        self.n_buckets = int(n_buckets)
+        self.bucket_s = self.window_s / self.n_buckets
+        # (bucket_index, good, total), oldest first
+        self._buckets: deque = deque()
+
+    def _expire(self, idx: int) -> None:
+        while self._buckets and self._buckets[0][0] <= idx - self.n_buckets:
+            self._buckets.popleft()
+
+    def record(self, good: bool, now: float) -> None:
+        idx = int(now // self.bucket_s)
+        if not self._buckets or self._buckets[-1][0] != idx:
+            self._buckets.append([idx, 0, 0])
+        self._buckets[-1][1] += int(good)
+        self._buckets[-1][2] += 1
+        self._expire(idx)
+
+    def totals(self, now: float) -> tuple:
+        """``(good, total)`` inside the window ending at ``now``."""
+        self._expire(int(now // self.bucket_s))
+        good = sum(b[1] for b in self._buckets)
+        total = sum(b[2] for b in self._buckets)
+        return good, total
+
+
+class SloTracker:
+    """Deadline-attainment SLO over fast + slow sliding windows.
+
+    ``registry`` defaults to the live telemetry registry (a no-op one
+    when telemetry is off — the tracker still alerts and pressures the
+    degrade controller, it just doesn't publish gauges).  ``clock`` is
+    injectable so tests can march time deterministically.
+    """
+
+    def __init__(self, *, objective: float = 0.99,
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 600.0,
+                 alert_burn: float = 2.0,
+                 min_count: int = 10,
+                 registry=None,
+                 clock=time.monotonic):
+        assert 0.0 < objective < 1.0, (
+            f"objective is a fraction in (0, 1), got {objective}"
+        )
+        assert slow_window_s >= fast_window_s > 0
+        self.objective = float(objective)
+        self.error_budget = 1.0 - self.objective
+        self.alert_burn = float(alert_burn)
+        self.min_count = int(min_count)
+        self.fast = SlidingWindow(fast_window_s)
+        self.slow = SlidingWindow(slow_window_s)
+        self.alerting = False
+        self.alerts = 0
+        self._clock = clock
+        self._lock = threading.Lock()
+        if registry is None:
+            from dalle_tpu import telemetry
+
+            registry = telemetry.registry()
+        self.metrics = registry
+        self._c_total = registry.counter("slo_deadline_total")
+        self._c_missed = registry.counter("slo_deadline_missed")
+
+    # --- accounting ------------------------------------------------------
+    def observe_request(self, ttlt_s: Optional[float],
+                        deadline_s: Optional[float]) -> None:
+        """Account one finished (or failed) request.  ``ttlt_s=None``
+        means the request never produced its last token — a failure or
+        shed — which is a miss whenever a deadline was declared."""
+        if deadline_s is None:
+            return
+        met = ttlt_s is not None and ttlt_s <= deadline_s
+        self.record(met=met)
+
+    def record(self, *, met: bool) -> None:
+        now = self._clock()
+        with self._lock:
+            self._c_total.inc()
+            if not met:
+                self._c_missed.inc()
+            self.fast.record(met, now)
+            self.slow.record(met, now)
+            self._publish(now)
+
+    # --- readout ---------------------------------------------------------
+    @staticmethod
+    def _attainment(good: int, total: int) -> Optional[float]:
+        return (good / total) if total else None
+
+    def _burn(self, good: int, total: int) -> float:
+        if not total:
+            return 0.0
+        return ((total - good) / total) / self.error_budget
+
+    def _publish(self, now: float) -> None:
+        # guarded-by: _lock
+        gf, tf = self.fast.totals(now)
+        gs, ts = self.slow.totals(now)
+        m = self.metrics
+        if tf:
+            m.gauge("slo_attainment_fast").set(gf / tf)
+        if ts:
+            m.gauge("slo_attainment_slow").set(gs / ts)
+        burn_f = self._burn(gf, tf)
+        burn_s = self._burn(gs, ts)
+        m.gauge("slo_burn_rate_fast").set(burn_f)
+        m.gauge("slo_burn_rate_slow").set(burn_s)
+        firing = (
+            ts >= self.min_count
+            and burn_f > self.alert_burn
+            and burn_s > self.alert_burn
+        )
+        if firing and not self.alerting:
+            self.alerting = True
+            self.alerts += 1
+            log_event(
+                "slo_burn_alert", objective=self.objective,
+                burn_fast=round(burn_f, 3), burn_slow=round(burn_s, 3),
+                attainment_fast=round(gf / tf, 4) if tf else None,
+                window_total=ts,
+            )
+        elif self.alerting and not firing:
+            self.alerting = False
+            log_event(
+                "slo_burn_clear", objective=self.objective,
+                burn_fast=round(burn_f, 3), burn_slow=round(burn_s, 3),
+            )
+
+    def pressure(self) -> float:
+        """Degrade-pressure contribution: 0 while healthy, the fast-
+        window burn rate (≥ ``alert_burn``) while the alert fires.  The
+        scheduler scales this by its slot count so an SLO alert alone
+        clears the degrade threshold (docs/SERVING.md §5)."""
+        with self._lock:
+            if not self.alerting:
+                return 0.0
+            gf, tf = self.fast.totals(self._clock())
+            return max(self.alert_burn, self._burn(gf, tf))
+
+    def snapshot(self) -> dict:
+        """One JSON view for ``/statusz``, ``stats()`` and the flight
+        recorder."""
+        with self._lock:
+            now = self._clock()
+            gf, tf = self.fast.totals(now)
+            gs, ts = self.slow.totals(now)
+            return {
+                "objective": self.objective,
+                "alerting": self.alerting,
+                "alerts": self.alerts,
+                "deadlined_total": self._c_total.value,
+                "deadlined_missed": self._c_missed.value,
+                "fast": {
+                    "window_s": self.fast.window_s, "total": tf,
+                    "attainment": self._attainment(gf, tf),
+                    "burn_rate": self._burn(gf, tf),
+                },
+                "slow": {
+                    "window_s": self.slow.window_s, "total": ts,
+                    "attainment": self._attainment(gs, ts),
+                    "burn_rate": self._burn(gs, ts),
+                },
+            }

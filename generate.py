@@ -240,6 +240,14 @@ def parse_args(argv=None):
                              "then skip VAE detok — codes only) with "
                              "hysteresis; serve_degraded/serve_restored "
                              "events record every transition")
+    parser.add_argument("--slo_objective", type=float, default=None,
+                        help="deadline-attainment objective in (0, 1), "
+                             "e.g. 0.99: track TTLT-vs-deadline attainment "
+                             "over fast/slow windows and fire "
+                             "slo_burn_alert when the error budget burns "
+                             "too fast (docs/OBSERVABILITY.md §SLO); with "
+                             "--degrade, an active alert adds scheduler "
+                             "pressure")
     # shared observability surface (docs/OBSERVABILITY.md): --telemetry
     # writes metrics.jsonl + a Perfetto-loadable trace.json under
     # <outputs_dir>/serve/telemetry/
@@ -335,7 +343,7 @@ def parse_args(argv=None):
                              "bf16/int8 = deterministic bucket-scale "
                              "quantized all-reduce on the attention-out and "
                              "FF projections (int8 cuts modeled per-tick "
-                             "ICI bytes >= 40%).  Compute policy: no param "
+                             "ICI bytes >= 40%%).  Compute policy: no param "
                              "change, any checkpoint works")
     # sharded inference (beyond-reference: the reference generates on one
     # GPU only, generate.py:93-95): shard params over a device mesh and run
@@ -641,6 +649,15 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
     from dalle_tpu import telemetry
 
     tel = telemetry.configure_from_args(args, str(outdir / "telemetry"))
+    rec = telemetry.flight_recorder()
+    if rec is not None:
+        # a SIGTERM'd serve run leaves a flight dump next to its
+        # telemetry before the process dies (docs/OBSERVABILITY.md §4)
+        rec.install_sigterm()
+    srv = telemetry.introspection()
+    if srv is not None:
+        print(f"introspection: {srv.url} "
+              "(/metrics /healthz /statusz /debug/trace)")
 
     from PIL import Image
 
@@ -716,6 +733,7 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                 vae=vae, vae_params=vae_params, clip=clip,
                 clip_params=clip_params, on_result=on_result,
                 degrade=args.degrade, mesh_tp=tp, mesh_sp=sp,
+                slo_objective=args.slo_objective,
             )
             server.warmup()
         else:
@@ -731,6 +749,7 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
                 clip_params=clip_params, on_result=on_result,
                 degrade=args.degrade, result_cache=result_cache,
                 fingerprint=fingerprint,
+                slo_objective=args.slo_objective,
             )
         print(f"serving: {args.replicas} replica(s) x "
               f"{args.serve_slots} slots, policy "

@@ -80,7 +80,7 @@ def test_repo_lint_is_fast_and_jax_free():
 
 def test_every_rule_registered_and_described():
     assert set(ALL_RULES) == {
-        "policy-sync", "event-kinds", "recompile-hazard",
+        "policy-sync", "event-kinds", "metric-names", "recompile-hazard",
         "donation-after-use", "f32-accum", "lock-discipline",
     }
     for name, rule in ALL_RULES.items():
@@ -301,6 +301,117 @@ def test_event_kinds_changed_mode_skips_dead_detection(tmp_path):
     })
     res = run_lint(
         root, rules=["event-kinds"], selected={"mod.py"},
+        baseline_path=None,
+    )
+    assert res.findings == []
+
+
+# --- metric-names ----------------------------------------------------------
+
+_METRIC_SCHEMA = """
+    METRIC_NAMES = {
+        "serve_ticks": "counter: doc",
+        "serve_depth": "gauge: doc",
+        "events_*": "counter family: doc",
+    }
+"""
+
+
+def test_metric_names_unknown_literal_fires(tmp_path):
+    root = _tree(tmp_path, {
+        "dalle_tpu/telemetry/schema.py": _METRIC_SCHEMA,
+        "mod.py": """
+            registry.counter("serve_ticks").inc()
+            registry.gauge("serve_depht").set(1)
+        """,
+    })
+    msgs = [f.message for f in _lint(root, "metric-names")]
+    assert any("unknown metric name 'serve_depht'" in m for m in msgs)
+
+
+def test_metric_names_family_prefix(tmp_path):
+    """An f-string name must carry a literal prefix landing in a
+    declared '*' family; an unmatched prefix fires."""
+    root = _tree(tmp_path, {
+        "dalle_tpu/telemetry/schema.py": _METRIC_SCHEMA,
+        "mod.py": """
+            inc(f"events_{kind}")
+            inc(f"mystery_{kind}")
+            registry.counter("serve_ticks")
+            registry.gauge("serve_depth")
+        """,
+    })
+    msgs = [f.message for f in _lint(root, "metric-names")]
+    assert len(msgs) == 1, msgs
+    assert "matches no declared '*' family" in msgs[0]
+
+
+def test_metric_names_non_literal_getter_fires(tmp_path):
+    root = _tree(tmp_path, {
+        "dalle_tpu/telemetry/schema.py": _METRIC_SCHEMA,
+        "mod.py": """
+            registry.counter("serve_ticks")
+            registry.gauge("serve_depth")
+            inc(f"events_{k}")
+            name = "serve_ticks"
+            registry.counter(name)
+        """,
+    })
+    msgs = [f.message for f in _lint(root, "metric-names")]
+    assert len(msgs) == 1, msgs
+    assert "non-literal metric name" in msgs[0]
+
+
+def test_metric_names_instrument_methods_not_confused(tmp_path):
+    """``hist.observe(dt)`` / ``c.inc(1)`` are instrument methods whose
+    first arg is a VALUE — never flagged; ``np.histogram`` is foreign."""
+    root = _tree(tmp_path, {
+        "dalle_tpu/telemetry/schema.py": _METRIC_SCHEMA,
+        "mod.py": """
+            registry.counter("serve_ticks")
+            registry.gauge("serve_depth")
+            inc(f"events_{k}")
+            h.observe(dt)
+            c.inc(1)
+            g.set_gauge(x)
+            np.histogram(values, bins=20)
+        """,
+    })
+    assert _lint(root, "metric-names") == []
+
+
+def test_metric_names_dead_name_detected(tmp_path):
+    root = _tree(tmp_path, {
+        "dalle_tpu/telemetry/schema.py": """
+            METRIC_NAMES = {
+                "serve_ticks": "counter: used below",
+                "serve_ghost": "counter: used nowhere",
+            }
+        """,
+        "mod.py": 'telemetry.inc("serve_ticks")\n',
+    })
+    findings = _lint(root, "metric-names")
+    assert len(findings) == 1
+    assert "dead metric name 'serve_ghost'" in findings[0].message
+    assert findings[0].path == "dalle_tpu/telemetry/schema.py"
+
+
+def test_metric_names_forwarder_exempt_and_changed_mode(tmp_path):
+    """The telemetry session forwarder routes dynamic names by design;
+    --changed selections skip dead-name detection."""
+    root = _tree(tmp_path, {
+        "dalle_tpu/telemetry/schema.py": """
+            METRIC_NAMES = {"serve_ghost": "counter: doc"}
+        """,
+        "dalle_tpu/telemetry/__init__.py": """
+            def inc(name, n=1):
+                registry.counter(name).inc(n)
+        """,
+        "mod.py": "x = 1\n",
+    })
+    assert _lint(root, "metric-names") != []  # dead name, whole tree
+    res = run_lint(
+        root, rules=["metric-names"], selected={"mod.py"},
         baseline_path=None,
     )
     assert res.findings == []

@@ -1,0 +1,426 @@
+"""Observability-plane pins (docs/OBSERVABILITY.md §4-7, ISSUE 13).
+
+What these tests nail down:
+
+* the Prometheus exposition round-trips: ``render_prometheus`` of a
+  registry snapshot parses back through ``parse_prometheus`` with every
+  counter/gauge value and histogram series intact, and the parser
+  REJECTS torn lines (it is the scrape tests' oracle, so it must be
+  strict);
+* the live endpoints serve what the session owns: /metrics agrees
+  exactly with a direct registry snapshot, /healthz aggregates provider
+  verdicts (one sick provider → 503, never an exception), /statusz
+  carries provider status rows, /debug/trace filters by track;
+* scraping WHILE a writer hammers the registry never yields a torn
+  exposition and counters are monotonic across scrapes;
+* the SLO tracker's window/burn/alert math under an injected clock:
+  attainment and burn rates from the window totals, the multi-window
+  alert (fast AND slow over threshold, min_count gated), the clear on
+  recovery, and ``pressure()`` as the degrade-controller input;
+* the flight recorder dumps on trigger kinds, on demand, and on
+  SIGTERM (chaining the previous handler), every dump a parseable
+  whole-file JSON with the documented shape;
+* per-request timelines: ``render_timeline`` stitches every span and
+  instant carrying a ``request_id`` into one time-ordered view, and
+  ``report_json`` is the machine-readable rollup.
+"""
+
+import json
+import os
+import signal
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dalle_tpu import telemetry
+from dalle_tpu.telemetry.exposition import (
+    parse_prometheus,
+    register_provider,
+    render_prometheus,
+    unregister_provider,
+)
+from dalle_tpu.telemetry.recorder import FlightRecorder
+from dalle_tpu.telemetry.registry import MetricsRegistry
+from dalle_tpu.telemetry.slo import SlidingWindow, SloTracker
+from dalle_tpu.telemetry.tracing import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _no_session_leak():
+    telemetry.shutdown()
+    yield
+    telemetry.shutdown()
+
+
+def _scrape(base, path):
+    """GET returning (status, body) — a 503 health verdict is a valid
+    scrape, not an exception."""
+    try:
+        with urllib.request.urlopen(base + path, timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# --- exposition format ---------------------------------------------------
+
+
+def test_render_parse_roundtrip_exact():
+    reg = MetricsRegistry()
+    reg.counter("serve_ticks").inc(7)
+    reg.gauge("queue_depth").set(2.5)
+    h = reg.histogram("serve_ttlt_s")
+    for v in (0.01, 0.2, 3.0):
+        h.observe(v)
+    out = parse_prometheus(render_prometheus(reg.exposition_snapshot()))
+    assert out["serve_ticks"] == 7
+    assert out["queue_depth"] == 2.5
+    assert out["serve_ttlt_s_count"] == 3
+    assert out["serve_ttlt_s_sum"] == pytest.approx(3.21)
+    assert out['serve_ttlt_s_bucket{le="+Inf"}'] == 3
+    # cumulative buckets never decrease across ascending edges
+    buckets = [v for k, v in out.items()
+               if k.startswith("serve_ttlt_s_bucket")]
+    assert buckets == sorted(buckets)
+
+
+def test_parse_prometheus_rejects_torn_lines():
+    assert parse_prometheus("# comment\n\nx 1\n") == {"x": 1.0}
+    with pytest.raises(ValueError):
+        parse_prometheus("serve_ticks 7 extra\n")
+    with pytest.raises(ValueError):
+        parse_prometheus("serve_tic")  # truncated mid-line: no value
+
+
+# --- live endpoints ------------------------------------------------------
+
+
+def test_endpoints_serve_session_state(tmp_path):
+    telemetry.configure(str(tmp_path), metrics_interval_s=3600.0,
+                        http_port=0)
+    base = telemetry.introspection().url
+    telemetry.registry().counter("serve_ticks").inc(3)
+    telemetry.tracer().instant("admit", track="r0", request_id="job-1")
+    telemetry.tracer().instant("tick", track="other")
+    health = {"ok": True}
+    register_provider("testprov", status=lambda: {"slots": 4},
+                      health=lambda: dict(health))
+    try:
+        st, body = _scrape(base, "/metrics")
+        assert st == 200
+        scraped = parse_prometheus(body)
+        direct = parse_prometheus(render_prometheus(
+            telemetry.registry().exposition_snapshot()
+        ))
+        assert scraped == direct  # the HTTP view IS the registry
+        assert scraped["serve_ticks"] == 3
+
+        st, body = _scrape(base, "/healthz")
+        hz = json.loads(body)
+        assert st == 200 and hz["ok"] is True
+        assert hz["providers"]["testprov"]["ok"] is True
+
+        health["ok"] = False  # one sick provider flips the verdict
+        st, body = _scrape(base, "/healthz")
+        hz = json.loads(body)
+        assert st == 503 and hz["ok"] is False
+
+        st, body = _scrape(base, "/statusz")
+        sz = json.loads(body)
+        assert st == 200 and sz["status"]["testprov"]["slots"] == 4
+        assert "counters" in sz["metrics"]
+
+        st, body = _scrape(base, "/debug/trace?track=r0")
+        tr = json.loads(body)
+        assert st == 200 and tr["n"] == 1
+        assert tr["events"][0]["name"] == "admit"
+
+        st, body = _scrape(base, "/nope")
+        assert st == 404 and "/metrics" in body
+    finally:
+        unregister_provider("testprov")
+
+
+def test_sick_provider_never_kills_the_scrape(tmp_path):
+    telemetry.configure(str(tmp_path), metrics_interval_s=3600.0,
+                        http_port=0)
+    base = telemetry.introspection().url
+
+    def boom():
+        raise RuntimeError("provider died")
+
+    register_provider("sick", status=boom, health=boom)
+    try:
+        st, body = _scrape(base, "/healthz")
+        hz = json.loads(body)
+        assert st == 503 and hz["ok"] is False
+        assert "RuntimeError" in hz["providers"]["sick"]["error"]
+        st, body = _scrape(base, "/statusz")
+        assert st == 200  # status row carries the error, scrape lives
+        assert "RuntimeError" in json.loads(body)["status"]["sick"]["error"]
+    finally:
+        unregister_provider("sick")
+
+
+def test_scrape_under_load_parses_and_counters_monotonic(tmp_path):
+    """A writer hammering the registry races the scraper: every scrape
+    must parse whole (the oracle raises on torn lines) and every counter
+    must be non-decreasing scrape over scrape."""
+    telemetry.configure(str(tmp_path), metrics_interval_s=3600.0,
+                        http_port=0)
+    base = telemetry.introspection().url
+    reg = telemetry.registry()
+    stop = threading.Event()
+
+    def mutate():
+        c = reg.counter("serve_ticks")
+        h = reg.histogram("serve_tick_s")
+        g = reg.gauge("queue_depth")
+        i = 0
+        while not stop.is_set():
+            c.inc()
+            h.observe(0.001 * (i % 50))
+            g.set(i % 9)
+            reg.counter(f"events_kind{i % 7}").inc()
+            i += 1
+
+    th = threading.Thread(target=mutate, daemon=True)
+    th.start()
+    try:
+        prev = {}
+        for _ in range(40):
+            st, body = _scrape(base, "/metrics")
+            assert st == 200
+            cur = parse_prometheus(body)  # raises on any torn line
+            for k, v in prev.items():
+                if k.endswith("_bucket{le=\"+Inf\"}") or (
+                    "bucket" not in k and (
+                        k.endswith(("_count", "_sum"))
+                        or k.startswith(("serve_ticks", "events_"))
+                    )
+                ):
+                    assert cur.get(k, 0) >= v, k
+            prev = cur
+        assert prev["serve_ticks"] > 0
+    finally:
+        stop.set()
+        th.join(timeout=5)
+
+
+# --- SLO engine ----------------------------------------------------------
+
+
+def test_sliding_window_expires_old_buckets():
+    w = SlidingWindow(60.0, n_buckets=12)
+    w.record(True, now=0.0)
+    w.record(False, now=1.0)
+    assert w.totals(now=2.0) == (1, 2)
+    assert w.totals(now=30.0) == (1, 2)     # still inside the window
+    assert w.totals(now=120.0) == (0, 0)    # fully rotated out
+
+
+def test_slo_math_and_multiwindow_alert():
+    clock = [0.0]
+    reg = MetricsRegistry()
+    t = SloTracker(objective=0.9, fast_window_s=60.0, slow_window_s=600.0,
+                   alert_burn=2.0, min_count=10, registry=reg,
+                   clock=lambda: clock[0])
+    for _ in range(9):
+        t.record(met=True)
+        clock[0] += 1.0
+    t.record(met=False)
+    clock[0] += 1.0
+    snap = t.snapshot()
+    assert snap["fast"]["attainment"] == pytest.approx(0.9)
+    # 10% missing of a 10% budget = burning exactly at sustainable rate
+    assert snap["fast"]["burn_rate"] == pytest.approx(1.0)
+    assert not t.alerting and t.pressure() == 0.0
+
+    # a miss storm: both windows burn over 2x -> ONE alert fires
+    for _ in range(10):
+        t.record(met=False)
+        clock[0] += 1.0
+    assert t.alerting and t.alerts == 1
+    assert reg.gauge("slo_burn_rate_fast").value > 2.0
+    assert t.pressure() >= 2.0  # degrade-controller input while firing
+    snap = t.snapshot()
+    assert snap["alerting"] is True
+    assert snap["deadlined_total"] == 20
+    assert snap["deadlined_missed"] == 11
+
+    # recovery: goods wash the fast window back under threshold -> clear
+    for _ in range(60):
+        t.record(met=True)
+        clock[0] += 1.0
+    assert not t.alerting and t.alerts == 1
+    assert t.pressure() == 0.0
+
+
+def test_slo_min_count_gates_the_alert():
+    clock = [0.0]
+    t = SloTracker(objective=0.99, min_count=10, registry=MetricsRegistry(),
+                   clock=lambda: clock[0])
+    for _ in range(5):  # 5 misses burn hard but are under min_count
+        t.record(met=False)
+        clock[0] += 0.1
+    assert not t.alerting
+
+
+def test_observe_request_deadline_semantics():
+    clock = [0.0]
+    t = SloTracker(objective=0.5, min_count=1, registry=MetricsRegistry(),
+                   clock=lambda: clock[0])
+    t.observe_request(ttlt_s=1.0, deadline_s=None)   # best-effort: ignored
+    t.observe_request(ttlt_s=1.0, deadline_s=2.0)    # met
+    t.observe_request(ttlt_s=3.0, deadline_s=2.0)    # missed
+    t.observe_request(ttlt_s=None, deadline_s=2.0)   # never finished: missed
+    snap = t.snapshot()
+    assert snap["deadlined_total"] == 3
+    assert snap["deadlined_missed"] == 2
+
+
+# --- flight recorder -----------------------------------------------------
+
+
+def _flight_doc(path):
+    with open(path) as f:
+        doc = json.load(f)
+    assert {"reason", "time", "ring", "spans", "metrics"} <= set(doc)
+    return doc
+
+
+def test_flight_recorder_dumps_on_trigger_kind(tmp_path):
+    reg = MetricsRegistry()
+    tr = Tracer()
+    tr.instant("tick", track="r0")
+    rec = FlightRecorder(str(tmp_path), registry=reg, tracer=tr)
+    rec.on_event({"kind": "serve_tick", "_time": 1.0})  # recorded only
+    assert rec.dumps == []
+    rec.on_event({"kind": "engine_crash", "_time": 2.0, "error": "boom"})
+    (path,) = rec.dumps
+    doc = _flight_doc(path)
+    assert doc["reason"] == "engine_crash"
+    kinds = [r["event"]["kind"] for r in doc["ring"] if r["type"] == "event"]
+    assert kinds == ["serve_tick", "engine_crash"]
+    assert doc["spans"][0]["name"] == "tick"
+    assert reg.counter("flight_dumps").value == 1
+
+
+def test_flight_recorder_forced_dump_and_metric_deltas(tmp_path):
+    reg = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path), registry=reg)
+    rec.note_metrics({"_time": 1.0, "counters": {"serve_ticks": 5}})
+    rec.note_metrics({"_time": 2.0, "counters": {"serve_ticks": 5}})  # flat
+    rec.note_metrics({"_time": 3.0, "counters": {"serve_ticks": 9}})
+    p1 = rec.dump("because")
+    p2 = rec.dump("because")
+    assert p1 != p2  # every dump its own file, monotone sequence
+    doc = _flight_doc(p1)
+    deltas = [r for r in doc["ring"] if r["type"] == "metrics_delta"]
+    assert [d["counters"]["serve_ticks"] for d in deltas] == [5, 4]
+
+
+def test_flight_recorder_ring_is_bounded(tmp_path):
+    rec = FlightRecorder(str(tmp_path), capacity=8)
+    for i in range(100):
+        rec.on_event({"kind": "serve_tick", "_time": float(i)})
+    doc = _flight_doc(rec.dump("cap"))
+    assert len(doc["ring"]) == 8
+    assert doc["ring"][-1]["t"] == 99.0  # most recent kept
+
+
+def test_flight_recorder_sigterm_dumps_and_chains(tmp_path):
+    orig = signal.getsignal(signal.SIGTERM)
+    chained = []
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+        rec = FlightRecorder(str(tmp_path))
+        assert rec.install_sigterm()
+        signal.raise_signal(signal.SIGTERM)
+        assert chained == [signal.SIGTERM]  # previous handler still ran
+        (path,) = rec.dumps
+        assert _flight_doc(path)["reason"] == "sigterm"
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_session_wires_crash_events_to_the_recorder(tmp_path):
+    from dalle_tpu.training.logging import log_event
+
+    telemetry.configure(str(tmp_path), metrics_interval_s=3600.0)
+    rec = telemetry.flight_recorder()
+    assert rec is not None
+    log_event("engine_crash", error="tick 3 exploded", restarts=1)
+    (path,) = rec.dumps
+    doc = _flight_doc(path)
+    assert doc["reason"] == "engine_crash"
+    assert telemetry.registry().counter("events_engine_crash").value == 1
+    # the dump itself logs flight_dump without re-triggering a dump
+    assert telemetry.registry().counter("events_flight_dump").value == 1
+    assert len(rec.dumps) == 1
+
+
+# --- request timelines + machine-readable report -------------------------
+
+
+def _synth_run(tmp_path):
+    """A run dir with one request's full span chain + a foreign track.
+    Instants self-stamp ``time.monotonic()``, so the spans anchor
+    around it (the export clamps the pre-construction start to 0)."""
+    import time
+
+    tr = Tracer()
+    t0 = time.monotonic()
+    tr.complete("queue_wait", t0 - 1.0, t0 - 0.5, track="r0",
+                request_id="job-1")
+    tr.instant("router_grant", track="router", request_id="job-1")
+    tr.instant("admit", track="r0", request_id="job-1", slot=2)
+    tr.complete("decode", t0 + 0.1, t0 + 1.1, track="r0slot2",
+                request_id="job-1", ticks=16)
+    tr.complete("detok", t0 + 1.1, t0 + 1.2, track="detok",
+                request_id="job-1")
+    tr.complete("decode", t0 - 1.0, t0 + 1.0, track="r0slot0",
+                request_id="job-2")
+    tr.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(tmp_path / "events.jsonl", "w") as f:
+        f.write(json.dumps({"_time": 3.0, "kind": "serve_cache_hit",
+                            "request_id": "job-1"}) + "\n")
+    return str(tmp_path)
+
+
+def test_render_timeline_one_request_end_to_end(tmp_path):
+    from tools.telemetry_report import render_timeline
+
+    out = render_timeline(_synth_run(tmp_path), "job-1")
+    names = [l.replace("+", "").split()[3] for l in out.splitlines()
+             if l.strip().startswith("+")]
+    assert names == ["queue_wait", "router_grant", "admit", "decode",
+                     "detok"]  # time-ordered, job-2's decode excluded
+    assert "ticks=16" in out
+    assert "serve_cache_hit" in out  # events.jsonl records ride along
+    assert "job-2" not in out
+
+
+def test_render_timeline_unknown_request_is_graceful(tmp_path):
+    from tools.telemetry_report import render_timeline
+
+    out = render_timeline(_synth_run(tmp_path), "nope")
+    assert "no trace events" in out
+
+
+def test_report_json_shape_and_flight_dumps(tmp_path):
+    from tools.telemetry_report import report_json
+
+    run_dir = _synth_run(tmp_path)
+    FlightRecorder(run_dir).dump("forced")
+    rep = report_json(run_dir)
+    assert rep["events"] == {"serve_cache_hit": 1}
+    assert rep["spans"]["r0slot2/decode"]["count"] == 1
+    assert rep["spans"]["r0slot2/decode"]["total_s"] == pytest.approx(1.0)
+    assert rep["instants"] == 2
+    # plain r<N> tracks roll up into the per-replica view
+    assert rep["per_replica"]["r0"]["busy_s"] == pytest.approx(0.5)
+    (dump,) = rep["flight_dumps"]
+    assert dump.startswith("flight_") and dump.endswith(".json")

@@ -300,10 +300,13 @@ def test_replay_counters_reconcile_with_stats(rng):
         6, rate_hz=1000.0, text_seq_len=cfg.text_seq_len,
         num_text_tokens=cfg.num_text_tokens, seed=3,
     )
+    for it in trace:  # deadlined traffic so stats() carries an SLO block
+        it.deadline_s = 300.0
     reg = MetricsRegistry()
     stats = replay_trace(
         model, params, trace, num_slots=2, filter_thres=0.0,
         max_pending=1, shed_policy="reject", metrics=reg,
+        slo_objective=0.95,
     )
     c = reg.snapshot()["counters"]
     assert c["serve_completed"] == stats["served"]
@@ -318,6 +321,20 @@ def test_replay_counters_reconcile_with_stats(rng):
     h = reg.snapshot()["histograms"]
     assert h["serve_decode_s"]["count"] == stats["served"]
     assert h["serve_queue_wait_s"]["count"] == stats["admitted"]
+    # the printed stats carry percentiles + SLO attainment (satellite:
+    # serve_summary's operator view, docs/OBSERVABILITY.md §5)
+    lat = stats["latency"]["ttlt_s"]
+    assert lat["count"] == stats["served"]
+    assert lat["p50"] <= lat["p95"] <= lat["p99"]
+    slo = stats["slo"]
+    assert slo["objective"] == 0.95
+    # every request that reached the scheduler is accounted (sheds are
+    # rejected at submit and never enter); completions met the generous
+    # deadline, failures never sampled a last token — misses
+    assert slo["deadlined_total"] == stats["served"] + stats["dropped"]
+    assert slo["deadlined_missed"] == stats["dropped"]
+    assert reg.snapshot()["counters"]["slo_deadline_total"] \
+        == slo["deadlined_total"]
 
 
 # --- pre-Run event buffering (satellite) ---------------------------------

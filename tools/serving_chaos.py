@@ -41,6 +41,7 @@ Run directly (``python tools/serving_chaos.py``), as the
 """
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -50,6 +51,49 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 GREEDY = dict(temperature=1e-8, filter_thres=0.0)
+
+
+def _parse_flight_dumps(paths):
+    """Every chaos scenario must leave a parseable flight dump
+    (docs/OBSERVABILITY.md §4) — load each and summarize, raising on a
+    torn/unparseable file."""
+    out = []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        assert {"reason", "time", "ring", "spans", "metrics"} <= set(doc), (
+            p, sorted(doc))
+        out.append({
+            "path": p,
+            "reason": doc["reason"],
+            "ring_events": len(doc["ring"]),
+        })
+    return out
+
+
+@contextlib.contextmanager
+def _flight_checked(name, run_dir, *, http_port=None):
+    """Run one scenario under a live telemetry session rooted at
+    ``run_dir``.  Crash scenarios dump via the engine_crash /
+    replica_crash triggers; a scenario that ends dump-less gets a forced
+    ``scenario_<name>`` dump — either way the exit path proves every
+    dump parses.  Results land in the yielded dict
+    (``flight_dumps`` / ``flight_ok``)."""
+    from dalle_tpu import telemetry
+
+    telemetry.configure(run_dir, metrics_interval_s=60.0,
+                        http_port=http_port)
+    info = {"run_dir": run_dir}
+    try:
+        yield info
+    finally:
+        rec = telemetry.flight_recorder()
+        if rec is not None and not rec.dumps:
+            rec.dump(f"scenario_{name}")
+        dumps = list(rec.dumps) if rec is not None else []
+        telemetry.shutdown()
+        info["flight_dumps"] = _parse_flight_dumps(dumps)
+        info["flight_ok"] = bool(info["flight_dumps"])
 
 
 def _quick_model(seed=0):
@@ -392,7 +436,14 @@ def scenario_telemetry(model, params, *, slots=3, n_req=10, max_pending=2,
         sched = Scheduler(engine, q, policy="continuous")
         stats = sched.run()
     finally:
+        # no crash in this scenario: force the flight dump the harness
+        # contract demands (every scenario leaves a parseable dump)
+        rec = telemetry.flight_recorder()
+        if rec is not None and not rec.dumps:
+            rec.dump("scenario_telemetry")
+        dumps = list(rec.dumps) if rec is not None else []
         trace_path = telemetry.shutdown()
+    flight_dumps = _parse_flight_dumps(dumps)
 
     # trace validity: parses as Chrome-trace JSON, every event has a
     # phase, and the serve lifecycle spans made it in
@@ -424,7 +475,7 @@ def scenario_telemetry(model, params, *, slots=3, n_req=10, max_pending=2,
         k: {"counter": counters.get(k, 0), "stats": want}
         for k, want in pairs.items() if counters.get(k, 0) != want
     }
-    ok = (trace_ok and not mismatches
+    ok = (trace_ok and not mismatches and bool(flight_dumps)
           and stats["shed"] > 0 and stats["served"] > 0)
     return {
         "ok": ok,
@@ -433,11 +484,31 @@ def scenario_telemetry(model, params, *, slots=3, n_req=10, max_pending=2,
         "trace_ok": trace_ok,
         "trace_events": len(events),
         "counter_mismatches": mismatches,
+        "flight_dumps": flight_dumps,
         "served": stats["served"],
         "shed": stats["shed"],
         "admitted": stats["admitted"],
         "failed": stats["failed"],
     }
+
+
+def _is_monotonic_series(name: str) -> bool:
+    """True for exposition series that may never decrease between two
+    scrapes: declared counters, histogram bucket/count/sum series (every
+    observed value is a nonnegative duration)."""
+    from dalle_tpu.telemetry.schema import METRIC_NAMES
+
+    base = name.split("{")[0]
+    for suffix in ("_bucket", "_count", "_sum"):
+        if base.endswith(suffix):
+            return True
+    desc = METRIC_NAMES.get(base, "")
+    if not desc:
+        for pat, d in METRIC_NAMES.items():
+            if pat.endswith("*") and base.startswith(pat[:-1]):
+                desc = d
+                break
+    return desc.startswith("counter")
 
 
 def scenario_replica_kill(model, params, *, slots=3, replicas=2) -> dict:
@@ -488,6 +559,49 @@ def scenario_replica_kill(model, params, *, slots=3, replicas=2) -> dict:
     w1, w2 = mk(wave1, "w1"), mk(wave2, "w2")
     killed = {"in_flight": 0}
 
+    # live introspection probes (docs/OBSERVABILITY.md §1): when the
+    # ambient telemetry session bound an HTTP server, scrape /healthz at
+    # the kill (the victim's row must flip not-ok) and again after the
+    # drain (the fleet must still be ok on the survivor), and prove
+    # /metrics always parses with monotonic counters while serving races
+    from dalle_tpu import telemetry
+    from dalle_tpu.telemetry.exposition import parse_prometheus
+
+    srv = telemetry.introspection()
+    probes = {}
+
+    def scrape(path):
+        # /healthz replies 503 while ANY provider row is unhealthy —
+        # e.g. the victim's own row during its dying tick.  That's a
+        # well-formed reply, not a probe failure
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(srv.url + path,
+                                        timeout=10) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.read().decode()
+
+    def probe(tag):
+        # never let a probe failure strand the chaos thread (the fleet
+        # would wait forever on an unclosed queue) — record and move on
+        if srv is None:
+            return
+        try:
+            hz = json.loads(scrape("/healthz"))
+            fl = hz.get("providers", {}).get("fleet", {})
+            probes[tag] = {
+                "fleet_ok": fl.get("ok"),
+                "alive": fl.get("alive"),
+                "replica0_ok": fl.get("replicas", {})
+                                 .get("0", {}).get("ok"),
+                "metrics": parse_prometheus(scrape("/metrics")),
+            }
+        except Exception as e:  # noqa: BLE001 — probe must not kill chaos
+            probes[tag] = {"error": f"{type(e).__name__}: {e}"}
+
     def chaos():
         for r in w1:
             fleet.submit(r)
@@ -499,11 +613,13 @@ def scenario_replica_kill(model, params, *, slots=3, replicas=2) -> dict:
             time.sleep(0.001)
         killed["in_flight"] = victim.engine.num_active
         fleet.kill(0)
+        probe("at_kill")
         # wave 1 fully served (drained work replayed on the survivor)
         # before wave 2's exact repeats arrive — so the repeats MUST be
         # result-cache hits if the cache survived the kill coherently
         for r in w1:
             r._done.wait(timeout=60.0)
+        probe("after_drain")
         for r in w2:
             fleet.submit(r)
         fleet.close()
@@ -512,6 +628,24 @@ def scenario_replica_kill(model, params, *, slots=3, replicas=2) -> dict:
     th.start()
     stats = fleet.run()
     th.join()
+
+    if srv is not None:
+        at_kill = probes.get("at_kill", {})
+        after = probes.get("after_drain", {})
+        m1, m2 = at_kill.pop("metrics", {}), after.pop("metrics", {})
+        regressed = {
+            k: (v, m2[k]) for k, v in m1.items()
+            if k in m2 and _is_monotonic_series(k) and m2[k] < v
+        }
+        probes["counters_monotonic"] = bool(m1) and not regressed
+        probes["regressed"] = {k: v for k, v in list(regressed.items())[:8]}
+        probes["ok"] = (
+            at_kill.get("replica0_ok") is False   # row flips at the kill
+            and after.get("replica0_ok") is False  # dead replicas stay dead
+            and after.get("fleet_ok") is True      # survivor keeps serving
+            and after.get("alive") == [1]
+            and probes["counters_monotonic"]
+        )
 
     allr = w1 + w2
     hangs = [r.request_id for r in allr if not r._done.is_set()]
@@ -529,9 +663,11 @@ def scenario_replica_kill(model, params, *, slots=3, replicas=2) -> dict:
         and stats["drain_failed"] == 0
         and stats["cache_hits"] >= len(wave2) - 4
         and stats["prefix_reuses"] > 0
+        and (srv is None or probes.get("ok", False))
     )
     return {
         "ok": ok,
+        "healthz_probes": probes,
         "replicas": replicas,
         "victim_in_flight_at_kill": killed["in_flight"],
         "hangs": hangs,
@@ -551,25 +687,46 @@ def scenario_replica_kill(model, params, *, slots=3, replicas=2) -> dict:
 
 def run_serving_chaos(*, slots=3, n_req=6, p99_gate=2.0,
                       telemetry_dir=None) -> dict:
-    """All six scenarios; ``ok`` iff every gate holds."""
+    """All six scenarios; ``ok`` iff every gate holds.
+
+    Every scenario runs under its own telemetry session (a subdir of
+    ``telemetry_dir`` / a fresh tempdir) and must leave a parseable
+    flight dump — the crash scenarios via the engine_crash /
+    replica_crash triggers, the rest via a forced end-of-scenario dump.
+    ``replica_kill`` additionally binds a live introspection server and
+    asserts the /healthz flip + /metrics monotonicity (its
+    ``healthz_probes``)."""
+    import tempfile
+
+    base = telemetry_dir or tempfile.mkdtemp(prefix="dalle_chaos_")
     model, params = _quick_model()
-    crash = scenario_crash_replay(model, params, slots=slots, n_req=n_req)
-    fail_fast = scenario_fail_fast(model, params, slots=slots)
-    cache_crash = scenario_cache_crash(model, params, slots=slots)
-    flood = scenario_flood(model, params, p99_gate=p99_gate)
-    tel = scenario_telemetry(model, params, slots=slots,
-                             run_dir=telemetry_dir)
-    replica_kill = scenario_replica_kill(model, params, slots=slots)
-    return {
-        "ok": (crash["ok"] and fail_fast["ok"] and cache_crash["ok"]
-               and flood["ok"] and tel["ok"] and replica_kill["ok"]),
-        "crash_replay": crash,
-        "fail_fast": fail_fast,
-        "cache_crash": cache_crash,
-        "flood": flood,
-        "telemetry": tel,
-        "replica_kill": replica_kill,
-    }
+    out = {}
+
+    def under_session(name, fn, *, http_port=None, **kw):
+        with _flight_checked(name, os.path.join(base, name),
+                             http_port=http_port) as fl:
+            res = fn(model, params, **kw)
+        res["flight_dumps"] = fl["flight_dumps"]
+        res["ok"] = res["ok"] and fl["flight_ok"]
+        out[name] = res
+        return res
+
+    under_session("crash_replay", scenario_crash_replay, slots=slots,
+                  n_req=n_req)
+    under_session("fail_fast", scenario_fail_fast, slots=slots)
+    under_session("cache_crash", scenario_cache_crash, slots=slots)
+    under_session("flood", scenario_flood, p99_gate=p99_gate)
+    # scenario_telemetry owns its session (it validates the session's
+    # own export); port 0 binds an ephemeral introspection server for
+    # the healthz/metrics probes inside replica_kill
+    out["telemetry"] = scenario_telemetry(
+        model, params, slots=slots,
+        run_dir=os.path.join(base, "telemetry"),
+    )
+    under_session("replica_kill", scenario_replica_kill, slots=slots,
+                  http_port=0)
+    out["ok"] = all(s["ok"] for s in out.values())
+    return out
 
 
 def main(argv=None):

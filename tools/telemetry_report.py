@@ -17,11 +17,18 @@ a serve run has the reverse — missing files render as a one-line note,
 never an error.  Pure stdlib so it runs anywhere the run dir lands
 (dev box, TPU VM, CI artifact store).
 
-Usage: ``python tools/telemetry_report.py <run_dir>``;
-library entry point: :func:`render_report` (pinned by
-tests/test_telemetry.py).
+Usage::
+
+    python tools/telemetry_report.py <run_dir>                  # text report
+    python tools/telemetry_report.py <run_dir> --format json    # machine-readable
+    python tools/telemetry_report.py <run_dir> --request job-17 # one request's
+                                                               # end-to-end timeline
+
+Library entry points: :func:`render_report`, :func:`report_json`,
+:func:`render_timeline` (pinned by tests/test_telemetry.py).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -133,21 +140,30 @@ def _events_lines(run_dir):
     return lines
 
 
-def _trace_lines(run_dir):
+def _load_trace(run_dir):
+    """(traceEvents, tid -> track name) from trace.json; ([], {}) when
+    the file is absent or torn."""
     path = os.path.join(run_dir, "trace.json")
-    lines = _section("Trace")
     try:
         with open(path) as f:
             trace = json.load(f)
     except (OSError, ValueError):
-        lines.append("  (no trace.json)")
-        return lines
+        return [], {}
     events = trace.get("traceEvents", [])
     threads = {
         e["tid"]: e.get("args", {}).get("name", str(e["tid"]))
         for e in events
         if e.get("ph") == "M" and e.get("name") == "thread_name"
     }
+    return events, threads
+
+
+def _trace_lines(run_dir):
+    lines = _section("Trace")
+    events, threads = _load_trace(run_dir)
+    if not events:
+        lines.append("  (no trace.json)")
+        return lines
     # aggregate complete spans per (track, name): count + total duration
     agg = {}
     n_instants = 0
@@ -198,15 +214,139 @@ def render_report(run_dir) -> str:
     return "\n".join(lines) + "\n"
 
 
+def report_json(run_dir) -> dict:
+    """Machine-readable counterpart of :func:`render_report` — the same
+    inputs, structured: last registry snapshot, event-kind counts,
+    per-(track, span) aggregates, per-replica rollup, flight dumps."""
+    recs = _read_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    snaps = [r for r in recs if r.get("kind") == "telemetry"]
+    evs = _read_jsonl(os.path.join(run_dir, "events.jsonl"))
+    counts = {}
+    for e in evs:
+        k = e.get("kind", "?")
+        counts[k] = counts.get(k, 0) + 1
+    events, threads = _load_trace(run_dir)
+    spans = {}
+    n_instants = 0
+    for e in events:
+        if e.get("ph") == "i":
+            n_instants += 1
+        if e.get("ph") != "X":
+            continue
+        key = f"{threads.get(e.get('tid'), '?')}/{e.get('name', '?')}"
+        agg = spans.setdefault(key, {"count": 0, "total_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += e.get("dur", 0.0) / 1e6
+    per_replica = {}
+    for key, agg in spans.items():
+        head, sep, _ = key.partition("/")
+        if sep and head.startswith("r") and head[1:].isdigit():
+            rep = per_replica.setdefault(head, {"spans": 0, "busy_s": 0.0})
+            rep["spans"] += agg["count"]
+            rep["busy_s"] += agg["total_s"]
+    dumps = sorted(
+        f for f in _listdir(run_dir)
+        if f.startswith("flight_") and f.endswith(".json")
+    )
+    return {
+        "run_dir": str(run_dir),
+        "snapshots": len(snaps),
+        "registry": snaps[-1] if snaps else None,
+        "events": counts,
+        "spans": spans,
+        "instants": n_instants,
+        "per_replica": per_replica,
+        "flight_dumps": dumps,
+    }
+
+
+def _listdir(run_dir):
+    try:
+        return os.listdir(run_dir)
+    except OSError:
+        return []
+
+
+def _match_request(args_d, rid):
+    return args_d.get("request_id") == rid or args_d.get("id") == rid
+
+
+def render_timeline(run_dir, request_id) -> str:
+    """One request's life, end to end: every trace span and instant
+    carrying ``request_id=<id>`` (queue_wait -> router_grant -> admit ->
+    decode -> detok/clip_rerank), time-ordered and offset from the
+    first, plus any events.jsonl records naming the request."""
+    events, threads = _load_trace(run_dir)
+    hits = [
+        e for e in events
+        if e.get("ph") in ("X", "i")
+        and _match_request(e.get("args", {}), request_id)
+    ]
+    title = f"request timeline: {request_id}"
+    lines = [title, "=" * len(title)]
+    if not hits:
+        lines.append(
+            "  no trace events for this request "
+            "(run without --telemetry, id never admitted, or trace "
+            "ring overflowed)"
+        )
+    hits.sort(key=lambda e: e.get("ts", 0.0))
+    t0 = hits[0]["ts"] if hits else 0.0
+    for e in hits:
+        off = (e.get("ts", 0.0) - t0) / 1e6
+        track = threads.get(e.get("tid"), "?")
+        extra = " ".join(
+            f"{k}={_fmt(v)}"
+            for k, v in sorted(e.get("args", {}).items())
+            if k not in ("request_id", "id")
+        )
+        if e["ph"] == "X":
+            dur = f"{e.get('dur', 0.0) / 1e6:.4f}s"
+        else:
+            dur = "instant"
+        lines.append(
+            f"  +{off:8.4f}s  {dur:<9}  {track:<12} "
+            f"{e.get('name', '?'):<16} {extra}".rstrip()
+        )
+    ev_hits = [
+        e for e in _read_jsonl(os.path.join(run_dir, "events.jsonl"))
+        if _match_request(e, request_id)
+    ]
+    if ev_hits:
+        lines.append("  events:")
+        for e in ev_hits:
+            kind = e.get("kind", "?")
+            rest = {k: v for k, v in e.items()
+                    if k not in ("kind", "time", "request_id", "id")}
+            lines.append(f"    {kind}: {rest}")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None):
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1:
-        print("usage: telemetry_report.py <run_dir>", file=sys.stderr)
+    p = argparse.ArgumentParser(
+        prog="telemetry_report.py",
+        description="Render a telemetry run directory "
+                    "(docs/OBSERVABILITY.md).",
+    )
+    p.add_argument("run_dir", help="directory holding metrics.jsonl / "
+                                   "events.jsonl / trace.json")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json emits the report_json() document")
+    p.add_argument("--request", default=None, metavar="ID",
+                   help="render one request's end-to-end timeline "
+                        "instead of the aggregate report")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"not a directory: {args.run_dir}", file=sys.stderr)
         return 2
-    if not os.path.isdir(argv[0]):
-        print(f"not a directory: {argv[0]}", file=sys.stderr)
-        return 2
-    sys.stdout.write(render_report(argv[0]))
+    if args.request is not None:
+        sys.stdout.write(render_timeline(args.run_dir, args.request))
+    elif args.format == "json":
+        json.dump(report_json(args.run_dir), sys.stdout, indent=2,
+                  sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_report(args.run_dir))
     return 0
 
 
