@@ -121,6 +121,17 @@ PHASES = [
     # cut at the flagship sp=2 shape (profiler.decode_tick_attn_bytes)
     # with the combine's ICI triples reported alongside
     ("decode_sp", 900, True),
+    # structured-decode evidence: per-attn-type cache index maps (ops/
+    # structured.py + ops/flash.py structured_decode_attention) — axial/
+    # conv_like/sparse layers read only their attended cache tiles per
+    # tick.  Off-chip gates bitwise greedy parity vs the dense-masked
+    # baseline for all four structured types (fp and kv_int8), the three
+    # jitted engine seams compiling exactly once on a mixed-type config,
+    # and the analytic >= 60% per-tick attention byte cut on the
+    # axial-heavy f=64 config (profiler.decode_tick_attn_bytes
+    # structured=True); the on-TPU tokens/s gate is reserved alongside
+    # the existing three decode rungs
+    ("decode_axial", 900, True),
     # extra-credit final rung: real LEARNING on the bench device — the
     # reference's rainbow-notebook workflow (synthetic shapes -> VAE ->
     # DALLE -> generated-token accuracy, SURVEY.md §4.2) trained for real
@@ -1691,6 +1702,200 @@ def _decode_sp_bench():
     return res
 
 
+def _decode_axial_bench():
+    """Structured-decode evidence: per-attn-type cache index maps
+    (ops/structured.py + ops/flash.py structured_decode_attention) — the
+    decode tick reads only the cache tiles each layer's static mask
+    attends at a slot's position, so non-full layers stop paying the
+    dense n-row stream.
+
+    Gates:
+      * off-chip: greedy codes BITWISE vs the dense-masked baseline for
+        every structured type (axial_row/axial_col/conv_like/sparse), fp
+        and kv_int8 (the off-kernel structured path is the analytic
+        thin-mask dense read — the exactness contract); the mixed-type
+        engine's three jitted seams (tick, admit, pooled admit) compile
+        exactly once with the flag on; the analytic per-tick attention
+        byte cut on the axial-heavy f=64 config >= 60%
+        (profiler.decode_tick_attn_bytes structured=True), with the
+        f=32 table recorded alongside;
+      * on TPU: tokens/s structured-vs-dense is recorded; the speedup
+        gate is RESERVED (alongside the other three decode rungs'
+        reserved gates) until real-hardware numbers land.
+    """
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from dalle_tpu.models.dalle import DALLE, DALLEConfig
+    from dalle_tpu.models.quantize import (
+        kv_int8_model,
+        structured_decode_model,
+    )
+    from dalle_tpu.serving import make_poisson_trace, replay_trace
+    from dalle_tpu.training.profiler import (
+        decode_tick_attn_bytes,
+        structured_decode_rows,
+    )
+
+    smoke = _smoke()
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = DALLEConfig(
+        num_text_tokens=64,
+        text_seq_len=16,
+        num_image_tokens=128,
+        image_fmap_size=8,
+        dim=32 if smoke else 128,
+        depth=5,  # one layer of each type in the mixed cycle
+        heads=2 if smoke else 4,
+        dim_head=16 if smoke else 32,
+        attn_types=("full", "axial_row", "axial_col", "conv_like",
+                    "sparse"),
+    )  # total_seq_len 80: sparse_block 16 divides
+    key = jax.random.PRNGKey(0)
+    base = DALLE(cfg)
+    text = jax.random.randint(
+        key, (2, cfg.text_seq_len), 1, cfg.num_text_tokens
+    )
+    codes = jax.random.randint(
+        key, (2, cfg.image_seq_len), 0, cfg.num_image_tokens
+    )
+    params = base.init({"params": key}, text, codes)["params"]
+    slots = 8
+
+    # analytic per-tick byte table at the axial-heavy big-canvas shapes
+    # (f=32 and f=64 grids; the PERF.md "Structured decode" table)
+    byte_table = {}
+    for f in (32, 64):
+        big = DALLEConfig(
+            num_text_tokens=16384, text_seq_len=64, num_image_tokens=8192,
+            image_fmap_size=f, dim=1024, depth=24, heads=16, dim_head=64,
+            attn_types=("full", "axial_row", "axial_col", "conv_like"),
+        )
+        dense = decode_tick_attn_bytes(big, slots, fused=False)
+        structured = decode_tick_attn_bytes(
+            big, slots, fused=False, structured=True
+        )
+        byte_table[f"f{f}"] = {
+            "dense": round(dense, 1),
+            "structured": round(structured, 1),
+            "cut": round(1.0 - structured / dense, 4),
+            "rows_axial": structured_decode_rows(big, "axial_row"),
+            "rows_conv": structured_decode_rows(big, "conv_like"),
+            "n": big.total_seq_len,
+        }
+    byte_cut = byte_table["f64"]["cut"]
+
+    res = {
+        "smoke": smoke,
+        "on_tpu": on_tpu,
+        "num_slots": slots,
+        "tick_attn_bytes": byte_table,
+        "attn_byte_reduction_f64": byte_cut,
+        "byte_gate": 0.60,
+        "speed_gate": None,  # reserved for real hardware
+    }
+
+    if on_tpu:
+        n_req = 16 if smoke else 32
+        trace = make_poisson_trace(
+            n_req, 1e5, cfg.text_seq_len, cfg.num_text_tokens, seed=0
+        )
+        st_dense = replay_trace(base, params, trace, policy="continuous",
+                                num_slots=slots)
+        st_struct = replay_trace(
+            structured_decode_model(base), params, trace,
+            policy="continuous", num_slots=slots,
+        )
+        _hb(f"decode_axial[dense]: {st_dense['tokens_per_s']:.1f} tok/s")
+        _hb(f"decode_axial[structured]: "
+            f"{st_struct['tokens_per_s']:.1f} tok/s")
+        res["tokens_per_s"] = {
+            "dense": round(st_dense["tokens_per_s"], 2),
+            "structured": round(st_struct["tokens_per_s"], 2),
+        }
+        res["structured_vs_dense"] = round(
+            st_struct["tokens_per_s"]
+            / max(st_dense["tokens_per_s"], 1e-9), 3,
+        )
+        if byte_cut < 0.60:
+            res["rung_failed"] = (
+                f"attn_byte_reduction_f64={byte_cut:.3f} (gate 0.60)"
+            )
+        return res
+
+    # off-chip: bitwise engine parity stands in for speed (the structured
+    # path off-kernel is the analytic thin-mask dense read — exactness is
+    # the contract; tokens/s gate reserved for real hardware)
+    from dalle_tpu.serving import PrefixPool
+    from dalle_tpu.serving.engine import DecodeEngine, Request
+
+    def greedy(model, prm, pool=False):
+        eng = DecodeEngine(
+            model, prm, num_slots=2, filter_thres=0.0,
+            prefix_pool=PrefixPool(1 << 22) if pool else None,
+        )
+        eng.warmup()
+        reqs = [Request(text_tokens=np.asarray(text[i % 2]), seed=i,
+                        temperature=1e-8, request_id=f"r{i}")
+                for i in range(4 if pool else 2)]
+        pend = list(reqs)
+        eng.admit([pend.pop(0), pend.pop(0)])
+        while pend or eng.num_active:
+            done = eng.step()
+            if done and pend:
+                eng.admit([pend.pop(0)])
+        seams = (
+            eng._tick_fn._cache_size(),
+            eng._admit_fn._cache_size(),
+            eng._admit_cached_fn._cache_size() if pool else None,
+        )
+        return [r.codes for r in reqs], seams
+
+    # bitwise greedy parity per structured type, fp and kv_int8
+    per_type = {}
+    for t in ("axial_row", "axial_col", "conv_like", "sparse"):
+        tcfg = dataclasses.replace(cfg, attn_types=(t,), depth=2)
+        tmodel = DALLE(tcfg)
+        tparams = tmodel.init({"params": key}, text, codes)["params"]
+        for quant in (False, True):
+            m = kv_int8_model(tmodel) if quant else tmodel
+            want, _ = greedy(m, tparams)
+            got, _ = greedy(structured_decode_model(m), tparams)
+            name = f"{t}_int8" if quant else t
+            per_type[name] = all(
+                np.array_equal(a, b) for a, b in zip(want, got)
+            )
+            _hb(f"decode_axial[{name}]: bitwise={per_type[name]}")
+
+    # mixed-type config: parity + the three-seam compile-once pin
+    want, _ = greedy(base, params)
+    got, seams = greedy(structured_decode_model(base), params)
+    mixed_equal = all(np.array_equal(a, b) for a, b in zip(want, got))
+    want_p, _ = greedy(base, params, pool=True)
+    got_p, seams_p = greedy(structured_decode_model(base), params,
+                            pool=True)
+    pool_equal = all(np.array_equal(a, b) for a, b in zip(want_p, got_p))
+    seams_once = seams == (1, 1, None) and seams_p == (1, 1, 1)
+
+    res["type_bitwise"] = {k: bool(v) for k, v in per_type.items()}
+    res["mixed_greedy_equal"] = bool(mixed_equal)
+    res["mixed_pool_greedy_equal"] = bool(pool_equal)
+    res["seams_compile_once"] = bool(seams_once)
+    ok = (
+        all(per_type.values()) and mixed_equal and pool_equal
+        and seams_once and byte_cut >= 0.60
+    )
+    if not ok:
+        res["rung_failed"] = (
+            f"type_bitwise={per_type}, mixed={mixed_equal}, "
+            f"pool={pool_equal}, seams_once={seams_once}, "
+            f"attn_byte_reduction_f64={byte_cut:.3f} (gate 0.60)"
+        )
+    return res
+
+
 def _bytes_budget_bench():
     """Per-policy step HBM-byte budget (ISSUE: bf16 activation streaming +
     fused GEGLU FF + selective remat).  Two bodies of evidence:
@@ -2496,6 +2701,7 @@ PHASE_FNS = {
     "decode_speed": _decode_speed_bench,
     "decode_shard": _decode_shard_bench,
     "decode_sp": _decode_sp_bench,
+    "decode_axial": _decode_axial_bench,
     "rainbow": _rainbow_bench,
     "resilience": _resilience_bench,
     "serving_resilience": _serving_resilience_bench,

@@ -54,6 +54,7 @@ COMPUTE_POLICY_FIELDS = (
     "use_flash",
     "fused_ff",
     "fused_decode",
+    "structured_decode",
     "tp_overlap",
     "decode_comm",
     "fsdp_prefetch",
@@ -148,6 +149,11 @@ class DALLEConfig:
     # full-type layers' decode_step reads the (optionally int8) KV cache
     # natively in one kernel per layer — compute policy like fused_ff
     fused_decode: bool = False
+    # structured Pallas decode tick (ops/flash.py
+    # structured_decode_attention): axial/conv_like/sparse layers'
+    # decode_step reads only their attended cache tiles through per-type
+    # index maps — compute policy like fused_decode
+    structured_decode: bool = False
     # decomposed tp collective-matmul rings (parallel/overlap.py) — compute
     # policy; needs tp>1 in the mesh and no sp, falls back silently else
     tp_overlap: bool = False
@@ -227,6 +233,7 @@ class DALLEConfig:
             kv_int8=self.kv_int8,
             fused_ff=self.fused_ff,
             fused_decode=self.fused_decode,
+            structured_decode=self.structured_decode,
             tp_overlap=self.tp_overlap,
             decode_comm=self.decode_comm,
             fsdp_prefetch=self.fsdp_prefetch,
@@ -248,6 +255,7 @@ class DALLEConfig:
         d.pop("use_flash")
         d.pop("fused_ff")
         d.pop("fused_decode")
+        d.pop("structured_decode")
         d.pop("tp_overlap")
         d.pop("decode_comm")
         d.pop("fsdp_prefetch")
@@ -269,6 +277,7 @@ class DALLEConfig:
         d.pop("use_flash", None)
         d.pop("fused_ff", None)
         d.pop("fused_decode", None)
+        d.pop("structured_decode", None)
         d.pop("tp_overlap", None)
         d.pop("decode_comm", None)
         d.pop("fsdp_prefetch", None)

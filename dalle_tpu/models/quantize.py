@@ -91,6 +91,20 @@ def fused_decode_model(model):
     return DALLE(dataclasses.replace(model.cfg, fused_decode=True))
 
 
+def structured_decode_model(model):
+    """Rebuild a DALLE with the structured decode tick on (transformer.py
+    structured_decode): axial/conv_like/sparse layers read only their
+    attended cache tiles per tick.  No param change — it is a compute
+    policy.  The shared idiom behind generate.py --structured_decode and
+    the bench decode_axial rung; composes with :func:`kv_int8_model` (the
+    kernel reads int8 rows + scales through the gather),
+    :func:`fused_decode_model` (which covers the full-type layers), and
+    :func:`quantize_for_decode`."""
+    from dalle_tpu.models.dalle import DALLE
+
+    return DALLE(dataclasses.replace(model.cfg, structured_decode=True))
+
+
 def decode_comm_model(model, mode: str = "f32"):
     """Rebuild a DALLE with the sharded-decode TP collective mode set
     (transformer.py decode_comm).  No param change — it is a compute

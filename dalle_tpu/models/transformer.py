@@ -39,10 +39,28 @@ import numpy as np
 
 from dalle_tpu.ops import attention as attn_ops
 from dalle_tpu.ops import flash as flash_ops
-from dalle_tpu.ops import masks as mask_lib
+from dalle_tpu.ops import structured as structured_lib
 from dalle_tpu.ops.rotary import apply_rotary, dalle_rotary_angles
 
 Cache = Any  # nested dict pytree of jnp arrays
+
+_WARNED_ONCE: set = set()
+
+
+def _warn_once(key: str, msg: str, stacklevel: int = 2) -> None:
+    """Emit ``warnings.warn(msg)`` at most once per process per ``key``.
+
+    The "runs DENSE" degradation warnings fire from inside traced layer
+    bodies — once per layer per trace, so a depth-64 serve re-traces them
+    into hundreds of identical lines across the engine's three jitted
+    seams.  The condition is trace-time static (mesh shape vs config), so
+    one line carries all the signal."""
+    if key in _WARNED_ONCE:
+        return
+    _WARNED_ONCE.add(key)
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,6 +180,19 @@ class TransformerConfig:
     # fallback is bitwise-identical to the unfused path.  Compute policy
     # like use_flash/fused_ff — never an hparam, popped in to_dict.
     fused_decode: bool = False
+    # structured decode tick (ops/flash.py structured_decode_attention):
+    # non-full structured layers (axial_row/axial_col/conv_like/sparse)
+    # decode through per-type cache index maps — only the tiles their
+    # static mask actually attends at the slot's position are read (text
+    # prefix + grid row / column gather / causal window / block-row
+    # layout; ops/structured.py), instead of streaming all n rows per
+    # tick.  Composes with kv_int8 (int8 rows + scales read through the
+    # gather) and tp (head-local shard_map, exact); under sp>1 the
+    # analytic thin-mask dense read routes through the cyclic storage
+    # tables instead.  Off-kernel environments take the dense fallback
+    # over the same analytic rows — bitwise the flag-off path.  Compute
+    # policy like fused_decode — never an hparam, popped in to_dict.
+    structured_decode: bool = False
     # decomposed tp collective-matmul (parallel/overlap.py): shard_map
     # ppermute rings overlap the per-chunk projection dots with the tp
     # all-gather / reduce-scatter hops, with the residual stream
@@ -386,26 +417,16 @@ def _layer_scale_init(layer_ind: int) -> float:
 
 
 def _static_mask(cfg: TransformerConfig, attn_type: str) -> np.ndarray:
-    n = cfg.seq_len
-    if not cfg.causal:
-        return np.ones((n, n), dtype=bool)
-    if attn_type == "sparse":
-        pad = (-n) % cfg.sparse_block
-        m = mask_lib.block_sparse_mask(
-            n + pad,
-            cfg.text_seq_len,
-            block=cfg.sparse_block,
-            num_local_blocks=cfg.sparse_local_blocks,
-            num_random_blocks=cfg.sparse_random_blocks,
-        )
-        return m[:n, :n]
-    return mask_lib.mask_for_attn_type(
+    return structured_lib.static_decode_mask(
         attn_type,
         cfg.text_seq_len,
         cfg.fmap_size,
+        causal=cfg.causal,
         kernel_size=cfg.kernel_size,
         dilation=cfg.dilation,
         sparse_block=cfg.sparse_block,
+        sparse_local_blocks=cfg.sparse_local_blocks,
+        sparse_random_blocks=cfg.sparse_random_blocks,
     )
 
 
@@ -748,6 +769,111 @@ def _sharded_flash_decode(c, qg, cache, pos_vec, mask):
     return fn(qg, cache["k"], cache["v"], pos_vec, mask)
 
 
+def _sparse_layout(c) -> np.ndarray:
+    """The padded [nb, nb] block layout for this config's 'sparse' type
+    (the small table the analytic decode mask rows gather)."""
+    return structured_lib.padded_sparse_layout(
+        c.seq_len,
+        c.text_seq_len,
+        block=c.sparse_block,
+        num_local_blocks=c.sparse_local_blocks,
+        num_random_blocks=c.sparse_random_blocks,
+    )
+
+
+def _decode_mask_rows(c, attn_type, idx, sp):
+    """The decode tick's analytic mask rows [*, 1, 1, n]: the per-position
+    predicate over global key positions (ops/structured.decode_mask_rows)
+    — the [n, n] ``_static_mask`` table never enters the decode graph.
+    Under an sp>1 cyclic cache layout the columns are the ``g_of_s``
+    storage table (each storage column's global position), which is the
+    dense-read route through ``partition.seq_storage_layout``."""
+    if sp > 1:
+        cols = jnp.asarray(_sp_storage_tables(c, sp)[1])
+    else:
+        cols = jnp.arange(c.seq_len, dtype=jnp.int32)
+    rows = structured_lib.decode_mask_rows(
+        attn_type,
+        idx,
+        cols,
+        text_seq_len=c.text_seq_len,
+        fmap_size=c.fmap_size,
+        causal=c.causal,
+        kernel_size=c.kernel_size,
+        dilation=c.dilation,
+        sparse_layout=_sparse_layout(c) if attn_type == "sparse" else None,
+        sparse_block=c.sparse_block,
+    )
+    if jnp.ndim(idx) == 1:
+        return rows[:, None, None, :]  # [b, 1, 1, n] per-lane rows
+    return rows[None, None, None, :]  # scalar idx: one broadcast row
+
+
+def _structured_flash_decode(c, attn_type, qg, cache, pos_vec, mask):
+    """The structured decode read (sp == 1): gather the slot's attended
+    cache-tile list from the static per-type table and run the
+    index-mapped Pallas kernel over just those tiles.  Under an ambient
+    tp>1 mesh the call shard_maps over the kv-head axis exactly like
+    :func:`_sharded_flash_decode` (the read is per-head independent, so
+    head-local is exact); ``mask`` is the analytic row set — consumed
+    only by the kernel's dense fallback arm (the bitwise oracle)."""
+    bk = flash_ops.structured_block_k(c.seq_len, attn_type, c.sparse_block)
+    tbl = structured_lib.decode_row_blocks(
+        attn_type,
+        bk,
+        c.text_seq_len,
+        c.fmap_size,
+        c.causal,
+        c.kernel_size,
+        c.dilation,
+        c.sparse_block,
+        c.sparse_local_blocks,
+        c.sparse_random_blocks,
+    )
+    blocks = jnp.asarray(tbl)[pos_vec]  # [b, NB] per-slot attended tiles
+    kwargs = dict(
+        attn_type=attn_type, text_seq_len=c.text_seq_len,
+        fmap_size=c.fmap_size, kernel_size=c.kernel_size,
+        dilation=c.dilation, block_k=bk,
+    )
+    from dalle_tpu.parallel.mesh import get_ambient_mesh
+
+    mesh = get_ambient_mesh()
+    tp = _decode_mesh_axes(c)[0]
+    if mesh is None or tp <= 1:
+        return flash_ops.structured_decode_attention(
+            qg, cache["k"], cache["v"], pos_vec, blocks,
+            k_scale=cache.get("k_scale"), v_scale=cache.get("v_scale"),
+            mask=mask, **kwargs,
+        )
+    from dalle_tpu.parallel.mesh import shard_map as _smap
+    from jax.sharding import PartitionSpec as _P
+
+    hs = _P(None, "tp", None, None)
+    pm = (_P(None), _P(None, None), _P(None, None, None, None))
+    if "k_scale" in cache:
+        fn = _smap(
+            lambda q, k, v, ks, vs, p, blk, m:
+            flash_ops.structured_decode_attention(
+                q, k, v, p, blk, k_scale=ks, v_scale=vs, mask=m, **kwargs
+            ),
+            mesh=mesh, in_specs=(hs, hs, hs, hs, hs) + pm, out_specs=hs,
+            check_vma=False,
+        )
+        return fn(
+            qg, cache["k"], cache["v"], cache["k_scale"], cache["v_scale"],
+            pos_vec, blocks, mask,
+        )
+    fn = _smap(
+        lambda q, k, v, p, blk, m: flash_ops.structured_decode_attention(
+            q, k, v, p, blk, mask=m, **kwargs
+        ),
+        mesh=mesh, in_specs=(hs, hs, hs) + pm, out_specs=hs,
+        check_vma=False,
+    )
+    return fn(qg, cache["k"], cache["v"], pos_vec, blocks, mask)
+
+
 class JointAttention(nn.Module):
     """One attention layer over the joint sequence; dispatches by type.
 
@@ -880,9 +1006,8 @@ class JointAttention(nn.Module):
             mesh = self._sp_mesh(f)
             halo = (c.kernel_size - 1) // 2 * c.dilation
             if mesh is not None and halo > f // mesh.shape[c.sp_axis]:
-                import warnings
-
-                warnings.warn(
+                _warn_once(
+                    f"conv_halo:{halo}:{f}:{mesh.shape[c.sp_axis]}",
                     f"conv_like halo {halo} exceeds the {f // mesh.shape[c.sp_axis]}"
                     f"-row local shard (sp={mesh.shape[c.sp_axis]}) — this "
                     "layer runs DENSE",
@@ -919,9 +1044,8 @@ class JointAttention(nn.Module):
             return None
         if f % mesh.shape[c.sp_axis] == 0:
             return mesh
-        import warnings
-
-        warnings.warn(
+        _warn_once(
+            f"sp_fmap:{c.sp_axis}:{f}:{mesh.shape[c.sp_axis]}:{self.attn_type}",
             f"sp_axis={c.sp_axis!r} requested but fmap_size {f} does not "
             f"divide by sp={mesh.shape[c.sp_axis]} — this "
             f"{self.attn_type!r} layer runs DENSE",
@@ -991,9 +1115,8 @@ class JointAttention(nn.Module):
                     # flash-chunk ring (parallel/ring.py use_flash)
                     use_flash=use_flash,
                 )
-            import warnings
-
-            warnings.warn(
+            _warn_once(
+                f"sp_sparse:{c.sp_axis}",
                 f"sequence parallelism requested (sp_axis={c.sp_axis!r}) but "
                 f"this 'sparse' layer runs DENSE (axial/conv layers have "
                 "their own sequence-sharded path)",
@@ -1158,25 +1281,24 @@ class JointAttention(nn.Module):
                 v = apply_rotary(v, ang)
         new_cache = self._cache_store(cache, k, v, idx)
         sp = _decode_sp(c)
-        mask_table = jnp.asarray(_static_mask(c, self.attn_type))
-        if sp > 1:
-            # cache rows live in cyclic storage order: permute the mask
-            # COLUMNS to match (static gather of a constant table).  The
-            # sp flash path below ignores the mask (it rebuilds key<=pos
-            # from shard-local positions); this covers the dense branch
-            # for non-"full" attention types.
-            mask_table = mask_table[:, jnp.asarray(_sp_storage_tables(c, sp)[1])]
-        if per_slot:
-            mask = mask_table[idx][:, None, None, :]  # [b,1,1,n] per-lane rows
-        else:
-            row = jax.lax.dynamic_slice_in_dim(mask_table, idx, 1, axis=0)  # [1, n]
-            mask = row[None, None]
+        # analytic mask rows: the per-position predicate replaces the
+        # device-resident [n, n] _static_mask table in EVERY decode branch
+        # (bit-for-bit the table row — ops/structured.decode_mask_rows —
+        # incl. the sp>1 storage-column permutation)
+        mask = _decode_mask_rows(c, self.attn_type, idx, sp)
         # grouped read — the GQA point: fold the head-group into the query
         # axis so the cache is read at its [b, kv, n, d] size (no repeat
         # materializes).  At kv == heads the fold is [b, h, 1, d] and this
         # is element-for-element the plain MHA read, same head-major layout.
         g = c.heads // c.num_kv_heads
         qg = q[:, :, 0].reshape(b, c.num_kv_heads, g, c.dim_head)
+        structured = (
+            c.structured_decode
+            and c.causal
+            and sp == 1
+            and self.attn_type in structured_lib.STRUCTURED_TYPES
+            and flash_ops.structured_kernel_active()
+        )
         if (c.fused_decode or sp > 1) and c.causal and self.attn_type == "full":
             # fused decode tick: one kernel reads the cache at its stored
             # width (int8 + scales under kv_int8) with each slot masked at
@@ -1186,6 +1308,16 @@ class JointAttention(nn.Module):
             # across scalar/vector call sites beyond the batch shape).
             pos_vec = idx if per_slot else jnp.full((b,), idx, jnp.int32)
             out = _sharded_flash_decode(c, qg, new_cache, pos_vec, mask)
+        elif structured:
+            # structured decode tick: gather only the tiles this type's
+            # mask attends at each slot's position (text prefix + row /
+            # column / window / block-row) — O(√n)-class cache reads for
+            # the structured zoo.  Every condition above is trace-time
+            # static, so the engine seams compile once either way.
+            pos_vec = idx if per_slot else jnp.full((b,), idx, jnp.int32)
+            out = _structured_flash_decode(
+                c, self.attn_type, qg, new_cache, pos_vec, mask
+            )
         else:
             ck, cv = self._cache_kv(new_cache)  # [b, kv, n, d]
             out = attn_ops._sdpa(qg, ck, cv, mask)  # [b,kv,g,d]

@@ -868,6 +868,227 @@ def flash_decode_attention(
     return out[:, :, :g]
 
 
+def structured_kernel_active() -> bool:
+    """Would :func:`structured_decode_attention` run its Pallas body here?
+    True on real TPU and under the shared ``DALLE_TPU_PALLAS_INTERPRET=1``
+    toggle — the decode dispatcher keys on this at trace time so the
+    off-kernel environments keep the bitwise dense-thin path."""
+    return jax.default_backend() == "tpu" or interpret_forced()
+
+
+def default_axial_block(which: str) -> int:
+    """Structured-decode-kernel tile defaults: ``DALLE_TPU_AXIAL_BLOCK_K``
+    is the kv-block length streamed per visited tile (built-in 128),
+    ``DALLE_TPU_AXIAL_BLOCK_H`` the kv heads tiled per grid step (built-in
+    1).  ``tools/flash_tune.py --kernel axial`` sweeps both and prints the
+    winning exports."""
+    assert which in ("k", "h"), which
+    return env_block_default(
+        f"DALLE_TPU_AXIAL_BLOCK_{which.upper()}", 128 if which == "k" else 1
+    )
+
+
+def structured_block_k(
+    n: int, attn_type: str, sparse_block: int = 16,
+    target: Optional[int] = None,
+) -> int:
+    """The tile length for one structured decode config: the largest
+    divisor of ``n`` at most the (env-tunable) target — additionally a
+    divisor of ``sparse_block`` for 'sparse', so every visited tile lies
+    inside one attended layout block and the in-kernel residual mask is
+    causality alone (ops/structured.kernel_row_predicate)."""
+    t = target if target is not None else default_axial_block("k")
+    if attn_type == "sparse":
+        return pick_block(int(np.gcd(n, sparse_block)), t)
+    return pick_block(n, t)
+
+
+def _structured_decode_kernel(
+    pos_ref, blk_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_scr, l_scr, acc_scr,
+    *, nwb, bk, gp, scale, quantized, attn_type, text_seq_len, fmap_size,
+    kernel_size, dilation,
+):
+    """Structured decode tick: like :func:`_decode_kernel` (one grouped
+    query row per slot, online softmax, int8 scales folded into the dots)
+    but the innermost grid walks the slot's PER-POSITION attended-tile
+    list instead of all ``n // bk`` cache tiles.  ``blk_ref`` [b, NB] is
+    the scalar-prefetched ``ops/structured.decode_row_blocks`` gather for
+    each slot's position (ascending tile indices, -1 padded): the k/v/
+    scale BlockSpec index maps DMA exactly the listed tiles, sentinel
+    steps skip compute, and the residual within-tile mask is the type's
+    analytic row predicate — the [n, n] mask table never rides along."""
+    bi, w = pl.program_id(0), pl.program_id(2)
+    pos = pos_ref[bi]  # this slot's write position (attend keys <= pos)
+    blk = blk_ref[bi, w]  # cache tile visited at this step (-1 = padding)
+
+    @pl.when(w == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(blk >= 0)
+    def _attend():
+        from dalle_tpu.ops.structured import kernel_row_predicate
+
+        bh = q_ref.shape[1]
+        q = q_ref[0].astype(jnp.float32) * scale  # [bh, gp, d]
+        k_blk = k_ref[0].astype(jnp.float32)  # [bh, bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [bh, gp, bk]
+        if quantized:
+            s = s * ks_ref[0][:, None, :]
+        ki = blk * bk + jax.lax.broadcasted_iota(jnp.int32, (bh, gp, bk), 2)
+        keep = kernel_row_predicate(
+            attn_type, pos, ki, text_seq_len=text_seq_len,
+            fmap_size=fmap_size, kernel_size=kernel_size, dilation=dilation,
+        )
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_scr[...]  # [bh, gp, LANES] (lane-replicated)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(s - m_new[..., :1])
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=2, keepdims=True)
+        if quantized:
+            p = p * vs_ref[0][:, None, :]
+        acc_scr[...] = acc_scr[...] * corr[..., :1] + jax.lax.dot_general(
+            p, v_blk, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(w == nwb - 1)
+    def _emit():
+        l_safe = jnp.maximum(l_scr[...][..., :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def _structured_refs_arg(kernel, has_scales):
+    """Adapter inserting ``None`` for the structured kernel's optional
+    scale refs when the cache is not quantized (mirrors
+    :func:`_decode_refs_arg`; scalar-prefetch refs arrive first, so the
+    gap sits after ``(pos, blk, q, k, v)``)."""
+    if has_scales:
+        return kernel
+
+    def adapted(*refs, **kw):
+        refs = list(refs)
+        refs[5:5] = [None, None]  # ks_ref, vs_ref
+        return kernel(*refs, **kw)
+
+    return adapted
+
+
+def structured_decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    pos: jnp.ndarray,
+    blocks: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    mask: Optional[jnp.ndarray] = None,
+    attn_type: str = "axial_row",
+    text_seq_len: int = 0,
+    fmap_size: int = 0,
+    kernel_size: int = 5,
+    dilation: int = 1,
+    block_k: Optional[int] = None,
+    block_kv_heads: Optional[int] = None,
+    force_kernel: bool = False,
+) -> jnp.ndarray:
+    """Index-mapped decode-tick attention for the structured zoo types:
+    ``q`` [b, kv, g, d] — ONE grouped query timestep per slot — against
+    the slot's KV cache ``k``/``v`` [b, kv, n, d], reading ONLY the cache
+    tiles its attention type actually attends at vector position ``pos``
+    [b].  ``blocks`` [b, NB] is the per-slot attended-tile gather
+    (``ops/structured.decode_row_blocks[pos]``) built at the SAME
+    ``block_k`` this call resolves (pass the :func:`structured_block_k`
+    result explicitly — the table and the grid must agree).  Returns
+    [b, kv, g, d] in ``q.dtype``.
+
+    With ``k_scale``/``v_scale`` ([b, kv, n, 1] f32) the cache is int8
+    and dequantization happens inside the dots, through the gather — the
+    structured read composes multiplicatively with kv_int8.
+
+    Dispatch mirrors :func:`flash_decode_attention`: the Pallas kernel on
+    TPU (or interpret under ``DALLE_TPU_PALLAS_INTERPRET=1`` /
+    ``force_kernel``); otherwise the checkpointed dense fallback over the
+    caller's analytic ``mask`` rows — the oracle arm, bitwise-identical
+    to the unstructured decode path."""
+    b, kv, g, d = q.shape
+    assert k.shape == v.shape == (b, kv, k.shape[2], d), (q.shape, k.shape)
+    n = k.shape[2]
+    quantized = k_scale is not None
+    if not (force_kernel or structured_kernel_active()):
+        return _decode_fallback(q, k, v, k_scale, v_scale, mask)
+    bk = block_k if block_k is not None else structured_block_k(n, attn_type)
+    assert n % bk == 0, (n, bk)
+    nwb = blocks.shape[1]
+    bh = (block_kv_heads if block_kv_heads is not None
+          else default_axial_block("h"))
+    if kv % bh:
+        bh = 1
+    gp = max(8, ((g + 7) // 8) * 8)  # pad grouped query rows to the f32 tile
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, gp - g), (0, 0))) if gp != g else q
+    pos = pos.astype(jnp.int32)
+    blocks = blocks.astype(jnp.int32)
+    ks = vs = None
+    if quantized:
+        ks = k_scale.reshape(b, kv, n).astype(jnp.float32)
+        vs = v_scale.reshape(b, kv, n).astype(jnp.float32)
+    kernel = functools.partial(
+        _structured_decode_kernel, nwb=nwb, bk=bk, gp=gp, scale=d ** -0.5,
+        quantized=quantized, attn_type=attn_type, text_seq_len=text_seq_len,
+        fmap_size=fmap_size, kernel_size=kernel_size, dilation=dilation,
+    )
+    kernel = _structured_refs_arg(kernel, quantized)
+    # index maps see the scalar-prefetch refs after the grid indices; a
+    # sentinel (-1) step pins its DMA to tile 0 (compute is predicated off)
+    kv_map = lambda bi, hi, w, pr, br: (bi, hi, jnp.maximum(br[bi, w], 0), 0)
+    scale_specs, scale_args = [], ()
+    if quantized:
+        scale_specs = [pl.BlockSpec(
+            (1, bh, bk),
+            lambda bi, hi, w, pr, br: (bi, hi, jnp.maximum(br[bi, w], 0)),
+            memory_space=pltpu.VMEM,
+        )] * 2
+        scale_args = (ks, vs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv // bh, nwb),
+        in_specs=[
+            pl.BlockSpec((1, bh, gp, d),
+                         lambda bi, hi, w, pr, br: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bh, bk, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bh, bk, d), kv_map, memory_space=pltpu.VMEM),
+        ] + scale_specs,
+        out_specs=pl.BlockSpec(
+            (1, bh, gp, d), lambda bi, hi, w, pr, br: (bi, hi, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bh, gp, _LANES), jnp.float32),
+            pltpu.VMEM((bh, gp, _LANES), jnp.float32),
+            pltpu.VMEM((bh, gp, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(pos, blocks, qp, k, v, *scale_args)
+    return out[:, :, :g]
+
+
 def flash_plan(mask: np.ndarray, prefer: Optional[int] = None):
     """Find the largest flash block size whose (layout ⊗ causal)
     reconstruction equals ``mask`` exactly.  Returns (layout, block) or None

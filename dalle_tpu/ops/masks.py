@@ -104,6 +104,38 @@ def conv_like_mask(
 
 
 @functools.lru_cache(maxsize=64)
+def sparse_block_layout(
+    seq_len: int,
+    text_seq_len: int,
+    block: int = 16,
+    num_local_blocks: int = 4,
+    num_random_blocks: int | None = None,
+    seed: int = 0,
+) -> np.ndarray:
+    """The [nb, nb] block layout under :func:`block_sparse_mask` — split
+    out so the structured-decode path (ops/structured.py) can evaluate
+    mask rows from the SMALL layout table (nb = seq/block) instead of the
+    materialized [seq, seq] mask; ``block_sparse_mask`` is exactly
+    ``kron(layout, ones) & causal`` over this table."""
+    assert seq_len % block == 0, "pad sequence to a block multiple"
+    nb = seq_len // block
+    if num_random_blocks is None:
+        num_random_blocks = max(nb // 4, 1)
+    layout = np.zeros((nb, nb), dtype=bool)
+    # global blocks cover the [bos | text] prefix (t+1 positions — the
+    # reference's text_len, attention.py:116)
+    n_text_blocks = max((text_seq_len + 1 + block - 1) // block, 1)
+    rng = np.random.RandomState(seed)
+    for qb in range(nb):
+        layout[qb, max(0, qb - num_local_blocks + 1) : qb + 1] = True
+        layout[qb, :n_text_blocks] = True  # global text blocks
+        if qb > 0:
+            ridx = rng.randint(0, qb + 1, size=num_random_blocks)
+            layout[qb, ridx] = True
+    return layout
+
+
+@functools.lru_cache(maxsize=64)
 def block_sparse_mask(
     seq_len: int,
     text_seq_len: int,
@@ -124,21 +156,10 @@ def block_sparse_mask(
     multiple by the caller (reference pads inputs, attention.py:355-361; we
     instead require seq_len % block == 0 after DALLE's static padding).
     """
-    assert seq_len % block == 0, "pad sequence to a block multiple"
-    nb = seq_len // block
-    if num_random_blocks is None:
-        num_random_blocks = max(nb // 4, 1)
-    layout = np.zeros((nb, nb), dtype=bool)
-    # global blocks cover the [bos | text] prefix (t+1 positions — the
-    # reference's text_len, attention.py:116)
-    n_text_blocks = max((text_seq_len + 1 + block - 1) // block, 1)
-    rng = np.random.RandomState(seed)
-    for qb in range(nb):
-        layout[qb, max(0, qb - num_local_blocks + 1) : qb + 1] = True
-        layout[qb, :n_text_blocks] = True  # global text blocks
-        if qb > 0:
-            ridx = rng.randint(0, qb + 1, size=num_random_blocks)
-            layout[qb, ridx] = True
+    layout = sparse_block_layout(
+        seq_len, text_seq_len, block, num_local_blocks, num_random_blocks,
+        seed,
+    )
     mask = np.kron(layout, np.ones((block, block), dtype=bool))
     return mask & causal_mask(seq_len)
 

@@ -1,0 +1,251 @@
+"""Structured decode: analytic mask rows + per-type cache index maps.
+
+The decode tick attends ONE query (a slot's current position) against the
+KV cache.  For every attention type in the zoo the attended set of cache
+rows is tiny and analytically known (ops/masks.py geometry):
+
+  * full / mlp:   keys 0..pos                                  (n rows)
+  * axial_row:    text prefix + the query's contiguous grid row (t+1+f)
+  * axial_col:    text prefix + a stride-f column gather        (t+1+f)
+  * conv_like:    text prefix + the bounded causal window       (t+1+k²)
+  * sparse:       the query's block-row layout                  (blocks)
+
+This module supplies the two pieces the decode path needs to exploit that
+WITHOUT ever materializing the [n, n] static mask table on device:
+
+  1. :func:`decode_mask_rows` — a vectorized jnp predicate producing the
+     per-position mask row(s) from ``pos`` directly.  Bit-for-bit equal to
+     indexing the numpy oracle (``static_decode_mask[pos]``, pinned by
+     tests/test_serving_axial.py), so the dense fallback that consumes it
+     stays bitwise-identical to the mask-table path it replaces.
+  2. :func:`decode_row_blocks` — a static [n, NB] int32 table listing, for
+     each query position, WHICH ``block_k``-sized cache tiles contain
+     attended rows (ascending, -1 padded).  The Pallas structured decode
+     kernel (ops/flash.py:structured_decode_attention) streams only those
+     tiles through its BlockSpec index maps, so per-tick cache reads scale
+     with the attention structure instead of ``n``.
+
+Both derive from the SAME numpy oracle (:func:`static_decode_mask`, the
+exact mask ``models/transformer._static_mask`` builds), which keeps the
+kernel/fallback/table views semantics-identical by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.ops import masks as mask_lib
+
+# attention types with a non-trivial structured decode read ("full"/"mlp"
+# stay on the fused/full decode paths — their row is all of 0..pos anyway)
+STRUCTURED_TYPES = ("axial_row", "axial_col", "conv_like", "sparse")
+
+
+def static_decode_mask(
+    attn_type: str,
+    text_seq_len: int,
+    fmap_size: int,
+    *,
+    causal: bool = True,
+    kernel_size: int = 5,
+    dilation: int = 1,
+    sparse_block: int = 16,
+    sparse_local_blocks: int = 4,
+    sparse_random_blocks: Optional[int] = None,
+) -> np.ndarray:
+    """The numpy [n, n] mask oracle for one layer type — exactly what
+    ``models/transformer._static_mask`` builds (sparse pads the sequence
+    to a block multiple and crops back), from plain ints so ops code and
+    tests can call it without a TransformerConfig."""
+    n = text_seq_len + fmap_size * fmap_size
+    if not causal:
+        return np.ones((n, n), dtype=bool)
+    if attn_type == "sparse":
+        pad = (-n) % sparse_block
+        m = mask_lib.block_sparse_mask(
+            n + pad,
+            text_seq_len,
+            block=sparse_block,
+            num_local_blocks=sparse_local_blocks,
+            num_random_blocks=sparse_random_blocks,
+        )
+        return m[:n, :n]
+    return mask_lib.mask_for_attn_type(
+        attn_type,
+        text_seq_len,
+        fmap_size,
+        kernel_size=kernel_size,
+        dilation=dilation,
+        sparse_block=sparse_block,
+    )
+
+
+def padded_sparse_layout(
+    n: int,
+    text_seq_len: int,
+    *,
+    block: int = 16,
+    num_local_blocks: int = 4,
+    num_random_blocks: Optional[int] = None,
+) -> np.ndarray:
+    """The [nb, nb] block layout over the block-padded sequence — the
+    small table :func:`decode_mask_rows` gathers for 'sparse' rows
+    (nb = ceil(n/block) entries instead of n² mask bools)."""
+    pad = (-n) % block
+    return mask_lib.sparse_block_layout(
+        n + pad, text_seq_len, block, num_local_blocks, num_random_blocks
+    )
+
+
+def decode_mask_rows(
+    attn_type: str,
+    pos,
+    cols,
+    *,
+    text_seq_len: int,
+    fmap_size: int,
+    causal: bool = True,
+    kernel_size: int = 5,
+    dilation: int = 1,
+    sparse_layout: Optional[np.ndarray] = None,
+    sparse_block: int = 16,
+):
+    """Mask row(s) of the static oracle, computed analytically from ``pos``.
+
+    ``pos`` is a traced scalar or [b] vector of query positions; ``cols``
+    holds the GLOBAL key position of each cache column (``arange(n)``
+    normally; the ``g_of_s`` storage table under an sp>1 cyclic cache
+    layout — which is how structured decode routes through
+    ``partition.seq_storage_layout``).  Returns a bool array of shape
+    ``pos.shape + cols.shape``, bit-for-bit equal to
+    ``static_decode_mask(...)[pos][..., cols]`` (pinned by
+    tests/test_serving_axial.py) — the [n, n] table itself never exists
+    in the traced graph.
+
+    Mirrors ops/masks.py geometry exactly: ``tl = text_seq_len + 1``
+    ([bos | text]), image grid cell ``g`` at sequence position ``tl + g``,
+    virtual final cell cropped (cols stop at n-1, so the crop is free).
+    For 'sparse' the predicate gathers the [nb, nb] ``sparse_layout``
+    (from :func:`padded_sparse_layout`) instead of the kron-expanded mask.
+    """
+    p = jnp.asarray(pos, jnp.int32)[..., None]
+    j = jnp.asarray(cols, jnp.int32)
+    caus = j <= p
+    if not causal:
+        return jnp.broadcast_to(jnp.bool_(True), caus.shape)
+    if attn_type in ("full", "mlp"):
+        return caus
+    tl = text_seq_len + 1
+    f = fmap_size
+    if attn_type == "sparse":
+        assert sparse_layout is not None, "sparse rows need the block layout"
+        lay = jnp.asarray(sparse_layout)
+        qb = (p[..., 0] // sparse_block)[..., None]
+        return lay[qb, j // sparse_block] & caus
+    jj, pp = j - tl, p - tl
+    if attn_type in ("axial_row", "axial_col"):
+        if attn_type == "axial_row":
+            same = (jj // f) == (pp // f)
+        else:
+            same = (jj % f) == (pp % f)
+        img_row = (j < tl) | ((j >= tl) & same & caus)
+    elif attn_type == "conv_like":
+        dr = pp // f - jj // f
+        dc = pp % f - jj % f
+        half = (kernel_size - 1) // 2 * dilation
+        in_window = (
+            (jnp.abs(dr) <= half)
+            & (dr % dilation == 0)
+            & (jnp.abs(dc) <= half)
+            & (dc % dilation == 0)
+        )
+        img_row = (j < tl) | ((j >= tl) & in_window & caus)
+    else:
+        raise ValueError(f"unknown attention type {attn_type!r}")
+    # text queries (p < tl) are plain causal-over-text; image queries see
+    # the whole text prefix plus their structured in-grid set
+    return jnp.where(p >= tl, img_row, caus)
+
+
+def kernel_row_predicate(
+    attn_type: str,
+    pos,
+    rows,
+    *,
+    text_seq_len: int,
+    fmap_size: int,
+    kernel_size: int = 5,
+    dilation: int = 1,
+):
+    """The in-kernel residual mask over a visited cache tile's rows.
+
+    Pure arithmetic on ``rows`` (an iota of global positions) — safe
+    inside a Pallas body.  For 'sparse' the block table only ever visits
+    tiles that lie INSIDE an attended layout block (the dispatcher picks
+    ``block_k`` dividing ``sparse_block``), so the residual predicate is
+    causality alone; every other type re-evaluates its full analytic row.
+    """
+    if attn_type == "sparse":
+        attn_type = "full"
+    return decode_mask_rows(
+        attn_type,
+        pos,
+        rows,
+        text_seq_len=text_seq_len,
+        fmap_size=fmap_size,
+        causal=True,
+        kernel_size=kernel_size,
+        dilation=dilation,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def decode_row_blocks(
+    attn_type: str,
+    block_k: int,
+    text_seq_len: int,
+    fmap_size: int,
+    causal: bool = True,
+    kernel_size: int = 5,
+    dilation: int = 1,
+    sparse_block: int = 16,
+    sparse_local_blocks: int = 4,
+    sparse_random_blocks: Optional[int] = None,
+) -> np.ndarray:
+    """Static [n, NB] int32 table: row ``p`` lists the ascending indices
+    of the ``block_k``-sized cache tiles containing at least one attended
+    key for a query at position ``p``, padded with -1.  NB is the maximum
+    over positions — the structured kernel's grid extent; sentinel steps
+    skip their DMA target (index map pins -1 to tile 0) and their compute.
+
+    Derived row-by-row from the numpy oracle mask, which makes the table
+    correct by construction for every type — including the text-region
+    rows, the virtual-final-cell crop, and sparse's seeded random blocks.
+    """
+    mask = static_decode_mask(
+        attn_type,
+        text_seq_len,
+        fmap_size,
+        causal=causal,
+        kernel_size=kernel_size,
+        dilation=dilation,
+        sparse_block=sparse_block,
+        sparse_local_blocks=sparse_local_blocks,
+        sparse_random_blocks=sparse_random_blocks,
+    )
+    n = mask.shape[0]
+    assert n % block_k == 0, (n, block_k)
+    if attn_type == "sparse":
+        # tile ⊆ one layout block ⇒ the in-kernel residual mask can be
+        # causality alone (kernel_row_predicate)
+        assert sparse_block % block_k == 0, (sparse_block, block_k)
+    per_row = [np.unique(np.nonzero(mask[p])[0] // block_k) for p in range(n)]
+    width = max(len(blks) for blks in per_row)
+    tbl = np.full((n, width), -1, np.int32)
+    for p, blks in enumerate(per_row):
+        tbl[p, : len(blks)] = blks
+    return tbl

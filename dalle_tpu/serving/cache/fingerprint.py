@@ -53,6 +53,7 @@ STRIPPED_POLICY_FIELDS = (
     "use_flash",
     "fused_ff",
     "fused_decode",
+    "structured_decode",
     "tp_overlap",
     "decode_comm",
     "fsdp_prefetch",

@@ -249,15 +249,21 @@ class Scheduler:
         if slo is None and slo_objective is not None:
             slo = SloTracker(objective=slo_objective, registry=metrics)
         self._slo = slo
-        try:  # live gauge backed by the analytic decode byte model
+        try:  # live gauges backed by the analytic decode byte model
             from dalle_tpu.training.profiler import decode_tick_attn_bytes
 
-            metrics.gauge("decode_modeled_attn_bytes_per_tick").set(
-                decode_tick_attn_bytes(
-                    engine.model.cfg, engine.num_slots,
-                    fused=bool(getattr(engine.model.cfg, "fused_decode",
-                                       False)),
-                )
+            mcfg = engine.model.cfg
+            fused = bool(getattr(mcfg, "fused_decode", False))
+            structured = bool(getattr(mcfg, "structured_decode", False))
+            modeled = decode_tick_attn_bytes(
+                mcfg, engine.num_slots, fused=fused, structured=structured,
+            )
+            metrics.gauge("decode_modeled_attn_bytes_per_tick").set(modeled)
+            dense = decode_tick_attn_bytes(
+                mcfg, engine.num_slots, fused=fused, structured=False,
+            )
+            metrics.gauge("decode_structured_byte_cut").set(
+                1.0 - modeled / dense if dense > 0 else 0.0
             )
         except Exception:
             pass  # smoke configs may predate some model fields

@@ -149,6 +149,9 @@ METRIC_NAMES: Dict[str, str] = {
     "train_modeled_step_s": "gauge: analytic step seconds",
     "decode_modeled_attn_bytes_per_tick": "gauge: analytic decode "
                                           "attention bytes per tick",
+    "decode_structured_byte_cut": "gauge: modeled fraction of per-tick "
+                                  "attention bytes cut by structured "
+                                  "decode (0.0 when off)",
     # --- checkpointing (dalle_tpu/training/checkpoint.py) ----------------
     "ckpt_saves_started": "counter: checkpoint writes begun",
     "ckpt_saves_done": "counter: checkpoint writes completed",

@@ -214,8 +214,41 @@ def dalle_step_wire_bytes(cfg, batch: int) -> dict:
     return out
 
 
+def structured_decode_rows(cfg, attn_type: str) -> int:
+    """Closed-form cache rows one structured-decode tick reads for a layer
+    of ``attn_type`` (worst case over query positions) — the per-type
+    terms behind the ``structured=True`` arm of
+    :func:`decode_tick_attn_bytes` and the ``decode_axial`` rung's byte
+    gate.  Mirrors the index maps in ops/structured.py:
+
+      * full / mlp:  n                       (every row, dense read)
+      * axial_*:     tl + f                  (text prefix + one grid line)
+      * conv_like:   tl + kernel_size²·dil²-ish window, counted as the
+                     full dilated window footprint (kernel_size² cells)
+      * sparse:      (local + text + random blocks) · block rows
+    """
+    n = cfg.total_seq_len
+    tl = cfg.text_seq_len + 1  # [bos | text]
+    f = cfg.image_fmap_size
+    if attn_type in ("axial_row", "axial_col"):
+        return min(n, tl + f)
+    if attn_type == "conv_like":
+        k = getattr(cfg, "kernel_size", 5)
+        return min(n, tl + k * k)
+    if attn_type == "sparse":
+        blk = getattr(cfg, "sparse_block", 16)
+        local = getattr(cfg, "sparse_local_blocks", 4)
+        rand = getattr(cfg, "sparse_random_blocks", None)
+        nb = -(-n // blk)  # padded block count
+        if rand is None:
+            rand = max(nb // 4, 1)
+        text_blocks = max(-(-tl // blk), 1)
+        return min(n, min(nb, local + text_blocks + rand) * blk)
+    return n
+
+
 def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool,
-                           sp: int = 1) -> float:
+                           sp: int = 1, structured: bool = False) -> float:
     """Analytic HBM attention bytes for ONE engine decode tick at full
     occupancy (the byte-side model behind bench.py's ``decode_speed``
     rung, same term-by-term discipline as :func:`dalle_step_wire_bytes`).
@@ -248,6 +281,14 @@ def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool,
     island-read), so their bytes don't divide.  With an all-"full"
     stack the sp=2 cut is ~50% — comfortably over the decode_sp rung's
     45% gate.
+
+    ``structured`` models the structured decode tick (transformer.py
+    structured_decode, sp == 1 only — under sp the structured layers run
+    the dense thin-mask read): each axial/conv_like/sparse layer streams
+    only its :func:`structured_decode_rows` attended cache rows (+ their
+    int8 scales) through the index-mapped kernel, with fused-kernel
+    semantics (no dequant copy, no score-row HBM round-trip).  "full"
+    layers are untouched — their lever is ``fused``.
     """
     import jax.numpy as jnp
 
@@ -262,8 +303,17 @@ def decode_tick_attn_bytes(cfg, slots: int, *, fused: bool,
     qo = 2 * h * dh * s_act  # one query row in, one attn-out row
 
     total = 0.0
+    structured_types = ("axial_row", "axial_col", "conv_like", "sparse")
     for i in range(cfg.depth):
         at = cfg.attn_types[i % len(cfg.attn_types)]
+        if structured and sp == 1 and at in structured_types:
+            # index-mapped kernel: only the attended rows stream, scores
+            # and softmax stats stay in VMEM (fused-kernel semantics)
+            rows = structured_decode_rows(cfg, at)
+            row_bytes = kv * rows * dh * (1 if quant else s_act)
+            srow_bytes = kv * rows * 4 if quant else 0
+            total += 2 * (row_bytes + srow_bytes) + qo  # K + V once
+            continue
         island = at == "full" and sp > 1  # sp-sharded, island-read
         div = sp if island else 1
         layer = 2 * (cache_row + scale_row) / div + qo  # K + V once

@@ -153,18 +153,39 @@ def validate_serve_flags(args) -> list:
         # meta.json only (cheap; params untouched), and let a missing /
         # torch-format checkpoint fall through to its own load-time error
         seq = None
+        hp = {}
         try:
             from dalle_tpu.training.checkpoint import load_meta
 
             hp = load_meta(args.dalle_path).get("hparams") or {}
             seq = int(hp["text_seq_len"]) + int(hp["image_fmap_size"]) ** 2
         except Exception:
-            pass
+            hp = {}
         if seq is not None and seq % sp:
             errors.append(
                 f"--mesh_sp {sp} must divide the decode cache seq length "
                 f"{seq} (text_seq_len + image_fmap_size**2 of the "
                 "checkpoint; docs/SERVING.md §10)"
+            )
+        # structured attention types shard by whole grid lines: the
+        # row-slice / column / window locality that makes their
+        # sequence-parallel paths (and structured decode's index maps)
+        # line up needs f % sp == 0
+        structured = sorted({
+            t for t in (hp.get("attn_types") or ())
+            if t in ("axial_row", "axial_col", "conv_like", "sparse")
+        })
+        try:
+            f_sz = int(hp["image_fmap_size"])
+        except Exception:
+            f_sz = None
+        if structured and f_sz is not None and f_sz % sp:
+            errors.append(
+                f"--mesh_sp {sp} must divide the image grid "
+                f"(image_fmap_size {f_sz}) for this checkpoint's "
+                f"structured attention types ({', '.join(structured)}) — "
+                "their row-slice locality shards by whole grid lines "
+                "(docs/SERVING.md §10)"
             )
     if args.decode_comm != "f32" and tp < 2:
         errors.append(
@@ -334,6 +355,20 @@ def parse_args(argv=None):
                              "checkpoint works; off-TPU a bitwise-equal "
                              "lax fallback runs.  Composes with --serve, "
                              "--int8, --kv_int8")
+    parser.add_argument("--structured_decode", action="store_true",
+                        help="structured decode tick (ops/flash.py "
+                             "structured_decode_attention): axial_row/"
+                             "axial_col/conv_like/sparse layers' per-token "
+                             "attention reads ONLY the cache tiles their "
+                             "mask attends at each slot's position (text "
+                             "prefix + grid row / column gather / causal "
+                             "window / block-row layout) — O(√n)-class "
+                             "cache traffic for big canvases.  Compute "
+                             "policy: no extra params, any checkpoint "
+                             "works; off-TPU a bitwise-equal dense "
+                             "fallback over the same analytic mask rows "
+                             "runs.  Composes with --serve, --kv_int8, "
+                             "--fused_decode (full-type layers), --mesh_tp")
     parser.add_argument("--decode_comm", type=str, default="f32",
                         choices=("f32", "bf16", "int8"),
                         help="with --serve --mesh_tp >= 2: wire width of the "
@@ -398,6 +433,7 @@ def main(argv=None):
         model, params = _maybe_int8(args, model, params)
         model = _maybe_kv_int8(args, model)
         model = _maybe_fused_decode(args, model)
+        model = _maybe_structured_decode(args, model)
         loop = _serve_loop if args.serve else _generate_loop
         loop(args, tokenizer, model, params, vae, vae_params,
              cfg, clip=None, clip_params=None)
@@ -475,6 +511,7 @@ def main(argv=None):
     model, params = _maybe_int8(args, model, params)
     model = _maybe_kv_int8(args, model)
     model = _maybe_fused_decode(args, model)
+    model = _maybe_structured_decode(args, model)
     loop = _serve_loop if args.serve else _generate_loop
     loop(args, tokenizer, model, params, vae, vae_params, cfg,
          clip, clip_params)
@@ -528,6 +565,19 @@ def _maybe_fused_decode(args, model):
     print("fused decode: per-layer Pallas decode-attention kernel "
           "(lax fallback off-TPU)")
     return fused_decode_model(model)
+
+
+def _maybe_structured_decode(args, model):
+    """--structured_decode: rebuild the model with the structured decode
+    tick on (params unchanged — it is a compute policy; transformer.py
+    structured_decode)."""
+    if not getattr(args, "structured_decode", False):
+        return model
+    from dalle_tpu.models.quantize import structured_decode_model
+
+    print("structured decode: axial/conv/sparse layers read only their "
+          "attended cache tiles per tick (dense fallback off-TPU)")
+    return structured_decode_model(model)
 
 
 def _load_reference_pt(args):

@@ -220,7 +220,7 @@ def test_policy_sync_skips_foreign_trees(tmp_path):
 
 
 def test_repo_policy_fields_pinned():
-    """The declared compute-policy set IS the eight knobs, everywhere:
+    """The declared compute-policy set IS the nine knobs, everywhere:
     declaration == fingerprint mirror, to_dict drops exactly that set,
     from_dict tolerates old checkpoints that serialized them."""
     from dalle_tpu.models.dalle import COMPUTE_POLICY_FIELDS, DALLEConfig
@@ -229,6 +229,7 @@ def test_repo_policy_fields_pinned():
     expected = {
         "dtype", "stream_dtype", "use_flash", "fused_ff",
         "fused_decode", "tp_overlap", "decode_comm", "fsdp_prefetch",
+        "structured_decode",
     }
     assert set(COMPUTE_POLICY_FIELDS) == expected
     assert tuple(STRIPPED_POLICY_FIELDS) == tuple(COMPUTE_POLICY_FIELDS)

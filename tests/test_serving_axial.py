@@ -1,0 +1,560 @@
+"""Structured decode tests (docs/SERVING.md §11): per-type cache index
+maps gather only the attended rows for the decode tick's single query.
+
+Five pinned layers, mirroring test_serving_sp.py's discipline:
+
+1. **Analytic rows == oracle table rows** — ``ops/structured``'s
+   vectorized predicate (``decode_mask_rows``) restated against the
+   numpy mask oracle, bit-for-bit for every type at every position
+   (scalar and vector ``pos``, including position 0, the row boundaries
+   j=0 / j=f-1, and the virtual-final-cell crop edge), so the dense
+   fallback the flag leaves behind off-kernel is provably the mask-table
+   path it replaced.
+2. **Block tables** — ``decode_row_blocks`` lists exactly the tiles the
+   oracle mask touches, ascending, -1 padded.
+3. **Kernel numerics** — the index-mapped Pallas kernel (interpret mode)
+   against the dense-masked oracle for all four types x {fp, kv_int8},
+   including an f=64 big-canvas smoke at the flagship n=4160 geometry.
+4. **Engine parity** — greedy codes of a --structured_decode engine are
+   BITWISE the flag-off engine per type and on a mixed-type stack
+   (off-kernel both arms share the dense thin-mask read; under interpret
+   the kernel itself decodes the same greedy trajectory), across
+   occupancy churn, pooled admits, and an sp=2 mesh (structured layers
+   route through the cyclic storage tables), with all three jitted seams
+   compiled exactly once.
+5. **Analytic byte model** — ``structured_decode_rows`` restated by
+   hand, the structured arm of ``decode_tick_attn_bytes``, and the
+   decode_axial rung's >= 60% cut at the flagship f=64 shape.
+"""
+
+import dataclasses
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.models.dalle import DALLE, DALLEConfig
+from dalle_tpu.models.quantize import kv_int8_model, structured_decode_model
+from dalle_tpu.ops import structured
+from dalle_tpu.parallel.mesh import make_mesh
+from dalle_tpu.serving import DecodeEngine, PrefixPool, Request
+from dalle_tpu.training.profiler import (
+    decode_tick_attn_bytes,
+    structured_decode_rows,
+)
+
+T, F = 4, 2  # text 4 + image 4 => total_seq_len 8 (sp=2 divides both)
+
+ALL_TYPES = ("full", "mlp") + structured.STRUCTURED_TYPES
+
+
+def build(rng, *, kv_int8=False, structured_decode=False, **kw):
+    kw.setdefault("image_fmap_size", F)
+    kw.setdefault("depth", 2)
+    cfg = DALLEConfig(
+        num_text_tokens=30,
+        text_seq_len=T,
+        num_image_tokens=20,
+        dim=32,
+        heads=2,
+        dim_head=16,
+        **kw,
+    )
+    text = jax.random.randint(rng, (3, T), 1, 30)
+    codes = jax.random.randint(rng, (3, cfg.image_seq_len), 0, 20)
+    model = DALLE(cfg)
+    params = model.init({"params": rng}, text, codes)["params"]
+    if kv_int8:
+        model = kv_int8_model(model)
+    if structured_decode:
+        model = structured_decode_model(model)
+    return model, params
+
+
+def _requests(n, *, seed0=100, temperature=1e-8):
+    texts = np.random.RandomState(0).randint(1, 30, size=(n, T))
+    return [
+        Request(text_tokens=texts[i], seed=seed0 + i,
+                temperature=temperature, request_id=f"r{i}")
+        for i in range(n)
+    ]
+
+
+def _drain(engine, reqs, *, stagger_at=2):
+    """Admit 2, stagger the rest in as slots free — active slots sit at
+    STAGGERED positions by construction."""
+    pending = list(reqs)
+    engine.warmup()
+    engine.admit([pending.pop(0), pending.pop(0)])
+    while pending or engine.num_active:
+        if engine.tick_count >= stagger_at and pending:
+            free = engine.free_slots()
+            take = min(len(free), len(pending))
+            if take:
+                engine.admit([pending.pop(0) for _ in range(take)])
+        engine.step()
+    return {r.request_id: np.asarray(r.codes) for r in reqs}
+
+
+# a mid-size geometry where every structure is non-trivial: 3x3 grid,
+# conv window (k=3) smaller than the grid, sparse blocks (4) splitting
+# the 15-row sequence into 4 blocks with padding
+TSL, FM = 6, 3          # n = 6 + 9 = 15
+SPARSE_KW = dict(sparse_block=4, sparse_local_blocks=1,
+                 sparse_random_blocks=1)
+
+
+def _oracle(attn_type, *, text_seq_len=TSL, fmap_size=FM, **kw):
+    kw.setdefault("kernel_size", 3)
+    for k, v in SPARSE_KW.items():
+        kw.setdefault(k, v)
+    return structured.static_decode_mask(
+        attn_type, text_seq_len, fmap_size, **kw)
+
+
+def _rows_kw(attn_type, n, *, text_seq_len=TSL):
+    kw = dict(text_seq_len=text_seq_len, kernel_size=3)
+    if attn_type == "sparse":
+        kw["sparse_block"] = SPARSE_KW["sparse_block"]
+        kw["sparse_layout"] = structured.padded_sparse_layout(
+            n, text_seq_len, block=SPARSE_KW["sparse_block"],
+            num_local_blocks=SPARSE_KW["sparse_local_blocks"],
+            num_random_blocks=SPARSE_KW["sparse_random_blocks"],
+        )
+    return kw
+
+
+# --- 1. analytic mask rows == the numpy oracle, bit for bit -------------
+
+
+@pytest.mark.parametrize("attn_type", ALL_TYPES)
+def test_decode_mask_rows_match_oracle_all_positions(attn_type):
+    """Every position at once (vector pos, cols = arange(n)): the
+    predicate reproduces the whole oracle table — including position 0,
+    the first/last column of each grid row (j=0 / j=f-1 edges), and the
+    final position n-1 (the virtual-final-cell crop edge)."""
+    mask = _oracle(attn_type)
+    n = mask.shape[0]
+    rows = structured.decode_mask_rows(
+        attn_type, jnp.arange(n, dtype=jnp.int32),
+        jnp.arange(n, dtype=jnp.int32),
+        fmap_size=FM, **_rows_kw(attn_type, n))
+    np.testing.assert_array_equal(np.asarray(rows), mask,
+                                  err_msg=f"{attn_type}: predicate != oracle")
+
+
+@pytest.mark.parametrize("attn_type", ALL_TYPES)
+@pytest.mark.parametrize("pos", [0, TSL - 1, TSL, TSL + FM - 1, 14])
+def test_decode_mask_rows_scalar_pos(attn_type, pos):
+    """Scalar pos (the single-slot decode_step shape) hits the same row."""
+    mask = _oracle(attn_type)
+    n = mask.shape[0]
+    row = structured.decode_mask_rows(
+        attn_type, pos, jnp.arange(n, dtype=jnp.int32),
+        fmap_size=FM, **_rows_kw(attn_type, n))
+    assert row.shape == (n,)
+    np.testing.assert_array_equal(np.asarray(row), mask[pos])
+
+
+def test_decode_mask_rows_permuted_cols():
+    """cols need not be arange: the sp storage table order (cyclic
+    permutation) gathers the same bits, permuted — the sp>1 dense path's
+    exact call shape."""
+    mask = _oracle("axial_col")
+    n = mask.shape[0]
+    perm = np.argsort(np.arange(n) % 2, kind="stable")  # cyclic sp=2 layout
+    rows = structured.decode_mask_rows(
+        "axial_col", jnp.arange(n, dtype=jnp.int32),
+        jnp.asarray(perm, jnp.int32), fmap_size=FM,
+        **_rows_kw("axial_col", n))
+    np.testing.assert_array_equal(np.asarray(rows), mask[:, perm])
+
+
+def test_decode_mask_rows_non_causal_all_true():
+    rows = structured.decode_mask_rows(
+        "axial_row", jnp.arange(15, dtype=jnp.int32),
+        jnp.arange(15, dtype=jnp.int32),
+        text_seq_len=TSL, fmap_size=FM, causal=False)
+    assert bool(np.asarray(rows).all())
+
+
+# --- 2. block tables list exactly the attended tiles --------------------
+
+
+@pytest.mark.parametrize("attn_type", structured.STRUCTURED_TYPES)
+def test_decode_row_blocks_cover_oracle(attn_type):
+    """Row p's non-sentinel entries are exactly the ascending bk-tiles
+    containing an attended key — no tile missed, none extra."""
+    bk = 1  # divides n=15 and sparse_block alike; tiles == single rows
+    mask = _oracle(attn_type)
+    n = mask.shape[0]
+    tbl = structured.decode_row_blocks(
+        attn_type, bk, TSL, FM, causal=True, kernel_size=3, **SPARSE_KW)
+    assert tbl.shape[0] == n and tbl.dtype == np.int32
+    for p in range(n):
+        want = np.unique(np.nonzero(mask[p])[0] // bk)
+        got = tbl[p][tbl[p] >= 0]
+        np.testing.assert_array_equal(got, want, err_msg=f"{attn_type} p={p}")
+        # ascending with the -1 padding strictly at the tail
+        assert (np.diff(got) > 0).all() if len(got) > 1 else True
+        assert (tbl[p][len(got):] == -1).all()
+
+
+def test_structured_block_k_divides_sparse_block():
+    from dalle_tpu.ops.flash import structured_block_k
+
+    assert structured.STRUCTURED_TYPES == (
+        "axial_row", "axial_col", "conv_like", "sparse")
+    bk = structured_block_k(15, "sparse", sparse_block=4)
+    assert 4 % bk == 0 and 15 % bk == 0  # gcd path: both constraints hold
+    assert structured_block_k(1280, "axial_row", target=128) == 128
+
+
+# --- 3. kernel numerics (interpret mode) vs the dense-masked oracle -----
+
+
+def _kernel_case(attn_type, *, quantized, n_override=None):
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops.flash import (
+        structured_block_k, structured_decode_attention,
+    )
+    from dalle_tpu.ops.quant import dequantize_rows, quantize_rows
+
+    tsl, f = (TSL, FM) if n_override is None else n_override
+    n = tsl + f * f
+    b, kv, g, d = 4, 2, 1, 16
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, kv, g, d), jnp.float32)
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, kv, n, d))
+    pos = jnp.arange(b, dtype=jnp.int32) * ((n - 1) // (b - 1))
+    sparse_kw = SPARSE_KW if n_override is None else dict(
+        sparse_block=16, sparse_local_blocks=4, sparse_random_blocks=None)
+    bk = structured_block_k(
+        n, attn_type, sparse_kw["sparse_block"],
+        target=8 if n_override is None else None)
+    tbl = structured.decode_row_blocks(
+        attn_type, bk, tsl, f, causal=True, kernel_size=3, **sparse_kw)
+    blocks = jnp.asarray(tbl)[pos]
+    if quantized:
+        kq, ks = quantize_rows(kc)
+        vq, vs = quantize_rows(vc)
+        out = structured_decode_attention(
+            q, kq, vq, pos, blocks, k_scale=ks, v_scale=vs,
+            attn_type=attn_type, text_seq_len=tsl, fmap_size=f,
+            kernel_size=3, block_k=bk)
+        kd, vd = dequantize_rows(kq, ks), dequantize_rows(vq, vs)
+    else:
+        out = structured_decode_attention(
+            q, kc, vc, pos, blocks, attn_type=attn_type, text_seq_len=tsl,
+            fmap_size=f, kernel_size=3, block_k=bk)
+        kd, vd = kc, vc
+    lay = structured.padded_sparse_layout(
+        n, tsl, block=sparse_kw["sparse_block"],
+        num_local_blocks=sparse_kw["sparse_local_blocks"],
+        num_random_blocks=sparse_kw["sparse_random_blocks"])
+    rows = structured.decode_mask_rows(
+        attn_type, pos, jnp.arange(n, dtype=jnp.int32), text_seq_len=tsl,
+        fmap_size=f, kernel_size=3,
+        sparse_layout=lay if attn_type == "sparse" else None,
+        sparse_block=sparse_kw["sparse_block"])
+    want = A._sdpa(q, kd, vd, rows[:, None, None, :])
+    err = float(jnp.max(jnp.abs(out - want)))
+    assert err < 3e-2, f"{attn_type} quant={quantized}: err {err}"
+
+
+@pytest.mark.parametrize("quantized", [False, True], ids=["fp", "int8"])
+@pytest.mark.parametrize("attn_type", structured.STRUCTURED_TYPES)
+def test_structured_kernel_matches_oracle(pallas_interpret, attn_type,
+                                          quantized):
+    _kernel_case(attn_type, quantized=quantized)
+
+
+@pytest.mark.slow
+def test_structured_kernel_f64_smoke(pallas_interpret):
+    """Big-canvas geometry (f=64, n=4160 — the decode_axial rung's byte
+    table row): the axial_row kernel visits only the text-prefix and
+    grid-row tiles and still matches the dense oracle."""
+    _kernel_case("axial_row", quantized=True, n_override=(64, 64))
+
+
+def test_structured_attention_fallback_off_kernel():
+    """Without interpret/TPU the call routes to the checkpointed dense
+    fallback over the caller's mask — the oracle arm the engine's
+    flag-off path shares (bitwise by construction)."""
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops.flash import structured_decode_attention
+
+    n = TSL + FM * FM
+    b, kv, g, d = 2, 2, 1, 8
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (b, kv, g, d), jnp.float32)
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, kv, n, d))
+    pos = jnp.asarray([0, n - 1], jnp.int32)
+    rows = structured.decode_mask_rows(
+        "axial_row", pos, jnp.arange(n, dtype=jnp.int32),
+        text_seq_len=TSL, fmap_size=FM)
+    mask = rows[:, None, None, :]
+    tbl = structured.decode_row_blocks("axial_row", 1, TSL, FM)
+    out = structured_decode_attention(
+        q, kc, vc, pos, jnp.asarray(tbl)[pos], mask=mask,
+        attn_type="axial_row", text_seq_len=TSL, fmap_size=FM)
+    want = A._sdpa(q, kc, vc, mask)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+# --- 4. engine parity: --structured_decode vs flag-off ------------------
+
+
+@pytest.mark.slow  # tier-1 keeps the mixed-stack + sp=2 engine pins below
+@pytest.mark.parametrize("kv_int8", [False, True], ids=["fp", "kv_int8"])
+@pytest.mark.parametrize("attn_type", structured.STRUCTURED_TYPES)
+def test_engine_per_type_bitwise(rng, devices, attn_type, kv_int8):
+    """A single-type stack decodes the SAME greedy codes with the flag on:
+    off-kernel the structured branch is trace-time inert (both arms take
+    the analytic dense-thin read), so parity is bitwise."""
+    kw = dict(attn_types=(attn_type,), kernel_size=3)
+    base_m, params = build(rng, kv_int8=kv_int8, **kw)
+    on_m, _ = build(rng, kv_int8=kv_int8, structured_decode=True, **kw)
+    base = _drain(DecodeEngine(base_m, params, num_slots=2,
+                               filter_thres=0.0), _requests(3))
+    got = _drain(DecodeEngine(on_m, params, num_slots=2,
+                              filter_thres=0.0), _requests(3))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], got[rid],
+            err_msg=f"{rid}: structured_decode != baseline "
+                    f"({attn_type}, kv_int8={kv_int8})")
+
+
+MIXED = ("full", "axial_row", "axial_col", "conv_like", "sparse")
+
+
+def test_engine_mixed_types_bitwise_and_seams(rng, devices):
+    """The full zoo in one stack (depth 5, one layer each), across
+    occupancy churn: greedy codes bitwise vs flag-off, all three jitted
+    seams compiled exactly once."""
+    kw = dict(attn_types=MIXED, depth=5, kernel_size=3)
+    base_m, params = build(rng, **kw)
+    on_m, _ = build(rng, structured_decode=True, **kw)
+    base = _drain(DecodeEngine(base_m, params, num_slots=2,
+                               filter_thres=0.0), _requests(4))
+    engine = DecodeEngine(on_m, params, num_slots=2, filter_thres=0.0,
+                          prefix_pool=PrefixPool(1 << 20))
+    got = _drain(engine, _requests(4))
+    for rid in base:
+        np.testing.assert_array_equal(base[rid], got[rid], err_msg=rid)
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+
+
+@pytest.mark.slow  # kernel numerics pinned cheaply in _kernel_case above
+def test_engine_interpret_kernel_greedy_parity(rng, devices,
+                                               pallas_interpret):
+    """Under interpret the structured branch IS live — the index-mapped
+    kernel decodes the engine's ticks and must reproduce the flag-off
+    greedy trajectory (f32 bits may differ; the argmax must not)."""
+    kw = dict(attn_types=("axial_row", "sparse"), kernel_size=3,
+              sparse_block=4, sparse_local_blocks=1)
+    base_m, params = build(rng, **kw)
+    on_m, _ = build(rng, structured_decode=True, **kw)
+    base = _drain(DecodeEngine(base_m, params, num_slots=2,
+                               filter_thres=0.0), _requests(3))
+    engine = DecodeEngine(on_m, params, num_slots=2, filter_thres=0.0)
+    got = _drain(engine, _requests(3))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], got[rid],
+            err_msg=f"{rid}: interpret kernel != dense greedy")
+    assert engine._tick_fn._cache_size() == 1
+
+
+@pytest.mark.slow  # sp routing of mask rows pinned cheaply in section 1
+def test_engine_sp2_structured_parity(rng, devices):
+    """sp=2 composition: structured layers fall back to the dense
+    analytic read routed through the cyclic storage tables (the kernel is
+    sp==1 only) — greedy codes still match the unsharded flag-off
+    engine, seams single-entry."""
+    kw = dict(attn_types=MIXED, depth=5, kernel_size=3)
+    base_m, params = build(rng, **kw)
+    on_m, _ = build(rng, structured_decode=True, **kw)
+    base = _drain(DecodeEngine(base_m, params, num_slots=2,
+                               filter_thres=0.0), _requests(3))
+    mesh = make_mesh(dp=1, tp=1, sp=2, devices=jax.devices()[:2])
+    engine = DecodeEngine(on_m, params, num_slots=2, filter_thres=0.0,
+                          mesh=mesh)
+    got = _drain(engine, _requests(3))
+    for rid in base:
+        np.testing.assert_array_equal(
+            base[rid], got[rid],
+            err_msg=f"{rid}: sp=2 structured != unsharded flag-off")
+    assert engine._tick_fn._cache_size() == 1
+    assert engine._admit_fn._cache_size() == 1
+
+
+def test_warn_once_deduplicates():
+    """The "runs DENSE" warnings are hoisted behind a once-per-key gate:
+    a second identical trace does not re-warn."""
+    from dalle_tpu.models import transformer as tr
+
+    key = "test_warn_once:unit"
+    tr._WARNED_ONCE.discard(key)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tr._warn_once(key, "only once")
+        tr._warn_once(key, "only once")
+    assert len(w) == 1
+    tr._WARNED_ONCE.discard(key)
+
+
+# --- 5. analytic byte model ---------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(
+        num_text_tokens=2000, text_seq_len=32, num_image_tokens=1024,
+        image_fmap_size=8, dim=64, depth=4, heads=4, dim_head=16,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def test_structured_decode_rows_closed_forms():
+    cfg = _cfg()  # n = 32 + 64 = 96, tl = 33, f = 8
+    n, tl, f = cfg.total_seq_len, cfg.text_seq_len + 1, cfg.image_fmap_size
+    assert structured_decode_rows(cfg, "full") == n
+    assert structured_decode_rows(cfg, "axial_row") == tl + f
+    assert structured_decode_rows(cfg, "axial_col") == tl + f
+    assert structured_decode_rows(cfg, "conv_like") == tl + 25  # k=5 default
+    # sparse: (local + text + random) blocks of 16 rows, capped at n
+    nb = -(-n // 16)
+    want = min(n, min(nb, 4 + -(-tl // 16) + max(nb // 4, 1)) * 16)
+    assert structured_decode_rows(cfg, "sparse") == want
+    # a tiny canvas can't exceed the dense read
+    tiny = _cfg(num_image_tokens=20, image_fmap_size=2, text_seq_len=4)
+    for at in structured.STRUCTURED_TYPES:
+        assert structured_decode_rows(tiny, at) <= tiny.total_seq_len
+
+
+def test_attn_bytes_structured_arm_closed_form():
+    """One axial_row + one full layer, slots=8: the structured layer
+    streams rows(axial) K+V rows at storage width, nothing else; the
+    full layer is byte-identical to the flag-off model."""
+    cfg = _cfg(attn_types=("full", "axial_row"), depth=2)
+    n, h, dh = cfg.total_seq_len, cfg.heads, cfg.dim_head
+    s_act = 4  # f32 compute dtype in tests
+    qo = 2 * h * dh * s_act
+    rows = structured_decode_rows(cfg, "axial_row")
+    full_layer = 2 * h * n * dh * s_act + qo + 2 * h * n * 4
+    ax_structured = 2 * h * rows * dh * s_act + qo
+    got = decode_tick_attn_bytes(cfg, 8, fused=False, structured=True)
+    assert got == pytest.approx(8 * (full_layer + ax_structured), rel=1e-12)
+    # int8 cache: rows stream at 1 byte + one f32 scale per row, and the
+    # structured arm skips the dequant round-trip the baseline pays
+    qcfg = dataclasses.replace(cfg, kv_int8=True)
+    rows_b = 2 * (h * rows * dh + h * rows * 4) + qo
+    full_q = (2 * (h * n * dh + h * n * 4) + qo
+              + 2 * 2 * h * n * dh * s_act + 2 * h * n * 4)
+    got_q = decode_tick_attn_bytes(qcfg, 8, fused=False, structured=True)
+    assert got_q == pytest.approx(8 * (full_q + rows_b), rel=1e-12)
+
+
+def test_attn_bytes_structured_off_and_sp_guard():
+    """structured=False is the legacy model bit-for-bit, and sp>1
+    disables the structured arm (the kernel is sp==1 only)."""
+    cfg = _cfg(attn_types=("full", "axial_row"))
+    assert decode_tick_attn_bytes(cfg, 8, fused=False) == \
+        decode_tick_attn_bytes(cfg, 8, fused=False, structured=False)
+    assert decode_tick_attn_bytes(cfg, 8, fused=False, sp=2,
+                                  structured=True) == \
+        decode_tick_attn_bytes(cfg, 8, fused=False, sp=2)
+
+
+def test_attn_bytes_structured_cuts_60pct_at_flagship():
+    """The decode_axial rung's off-chip byte gate, restated: the flagship
+    f=64 big-canvas stack (full/axial_row/axial_col/conv_like) cuts
+    per-tick attention bytes >= 60%, fp and kv_int8."""
+    cfg = _cfg(dim=1024, depth=24, heads=16, dim_head=64,
+               num_text_tokens=16384, text_seq_len=64,
+               num_image_tokens=8192, image_fmap_size=64,
+               attn_types=("full", "axial_row", "axial_col", "conv_like"))
+    for quant in (False, True):
+        c = dataclasses.replace(cfg, kv_int8=quant) if quant else cfg
+        dense = decode_tick_attn_bytes(c, 8, fused=False)
+        thin = decode_tick_attn_bytes(c, 8, fused=False, structured=True)
+        cut = 1.0 - thin / dense
+        assert cut >= 0.60, f"cut {cut:.3f} < 0.60 (kv_int8={quant})"
+    # f=32 canvas clears the gate too (the rung's other table row)
+    c32 = dataclasses.replace(cfg, num_image_tokens=8192,
+                              image_fmap_size=32)
+    dense = decode_tick_attn_bytes(c32, 8, fused=False)
+    thin = decode_tick_attn_bytes(c32, 8, fused=False, structured=True)
+    assert 1.0 - thin / dense >= 0.60
+
+
+# --- 6. generate.py validator + plumbing --------------------------------
+
+
+def _serve_args(tmp_path, *extra):
+    import generate
+
+    return generate.parse_args([
+        "--dalle_path", str(tmp_path / "ckpt"),
+        "--serve", "-", *extra,
+    ])
+
+
+def _write_meta(tmp_path, *, text_seq_len=7, image_fmap_size=3,
+                attn_types=None):
+    import json
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir(exist_ok=True)
+    hp = {"text_seq_len": text_seq_len, "image_fmap_size": image_fmap_size}
+    if attn_types is not None:
+        hp["attn_types"] = list(attn_types)
+    (ckpt / "meta.json").write_text(json.dumps({
+        "format": "dalle_tpu/v3", "hparams": hp,
+    }))
+
+
+def test_validate_mesh_sp_vs_grid(tmp_path):
+    """--mesh_sp must divide the image grid when the checkpoint carries
+    structured attention types (seq 7 + 9 = 16 is sp=2-divisible, the
+    3-wide grid is not) — caught from meta.json alone."""
+    import generate
+
+    _write_meta(tmp_path, attn_types=["full", "axial_row"])
+    errs = generate.validate_serve_flags(
+        _serve_args(tmp_path, "--mesh_sp", "2"))
+    assert any("must divide the image grid" in e for e in errs), errs
+    # an all-dense checkpoint at the same geometry passes
+    _write_meta(tmp_path, attn_types=["full"])
+    assert not generate.validate_serve_flags(
+        _serve_args(tmp_path, "--mesh_sp", "2"))
+    # structured types with a dividing grid pass
+    _write_meta(tmp_path, text_seq_len=4, image_fmap_size=2,
+                attn_types=["full", "sparse"])
+    assert not generate.validate_serve_flags(
+        _serve_args(tmp_path, "--mesh_sp", "2"))
+
+
+def test_structured_decode_policy_plumbing(rng):
+    """The compute-policy contract: the flag survives transformer_config,
+    is stripped from to_dict/fingerprints, and tolerated by from_dict."""
+    from dalle_tpu.models.dalle import DALLEConfig
+
+    cfg = DALLEConfig(num_text_tokens=30, text_seq_len=T,
+                      num_image_tokens=20, image_fmap_size=F, dim=32,
+                      depth=2, heads=2, dim_head=16)
+    model = DALLE(cfg)
+    on = structured_decode_model(model)
+    assert on.cfg.structured_decode and not model.cfg.structured_decode
+    assert on.cfg.transformer_config().structured_decode
+    d = on.cfg.to_dict()
+    assert "structured_decode" not in d
+    assert not DALLEConfig.from_dict(d).structured_decode

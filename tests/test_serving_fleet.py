@@ -94,7 +94,15 @@ def _req(text, seed, rid, **kw):
 # --- 1-vs-2-replica bitwise parity --------------------------------------
 
 
-@pytest.mark.parametrize("variant", ["plain", "kv_int8_fused"])
+@pytest.mark.parametrize(
+    "variant",
+    [
+        # plain is the slower arm (~15s) and plain-engine fleet semantics
+        # are pinned by the kill/drain + router tests; CI runs both
+        pytest.param("plain", marks=[pytest.mark.slow]),
+        "kv_int8_fused",
+    ],
+)
 def test_fleet_parity_one_vs_two_replicas(rng, variant):
     """The same 12-request trace through a 1-replica and a 2-replica
     fleet produces bitwise-identical codes per request — including under

@@ -136,8 +136,17 @@ def test_engine_rejects_device_and_mesh(rng):
 # --- 2. tp=2 parity on virtual host devices -----------------------------
 
 
-@pytest.mark.parametrize("variant", ["plain", "kv_int8", "fused_kv_int8"])
-@pytest.mark.parametrize("mode", ["f32", "bf16", "int8"])
+@pytest.mark.parametrize(
+    "mode,variant",
+    [
+        # (int8, kv_int8) is the slowest arm (~18s); tier-1 keeps its
+        # axes via int8-fused_kv_int8 and f32-kv_int8, CI runs the matrix
+        pytest.param(m, v, marks=[pytest.mark.slow]
+                     if (m, v) == ("int8", "kv_int8") else [])
+        for m in ["f32", "bf16", "int8"]
+        for v in ["plain", "kv_int8", "fused_kv_int8"]
+    ],
+)
 def test_tp2_parity(rng, devices, mode, variant):
     """tp=2 over 2 virtual CPU devices: f32 rings are sampled-exact;
     bf16/int8 quantized all-reduces keep the greedy trajectory (and ARE

@@ -65,6 +65,12 @@ CASES = [
     # (flash.decode_softmax_combine) — the sp engine's per-tick hot
     # path with its collective, in one jit
     ("sp_tick_int8_1280", 1280, 64, "bfloat16", False, False),
+    # the STRUCTURED decode tick (docs/SERVING.md §11): the index-mapped
+    # variant (ops/flash.py structured_decode_attention) that gathers only
+    # the attended cache tiles for axial/conv/sparse layers — all four
+    # structured types checked against the dense-masked oracle at the
+    # flagship joint-sequence geometry (tl=256, f=32)
+    ("axial_tick_int8_1280", 1280, 64, "bfloat16", False, False),
     ("causal_bf16_4096", 4096, 64, "bfloat16", False, False),  # VQGAN-f8 scale
 ]
 
@@ -419,6 +425,87 @@ def _run_sp_case(name: str) -> dict:
     }
 
 
+def _run_axial_case(name: str) -> dict:
+    """The structured decode tick: structured_decode_attention at the
+    serving shape (8 slots x 8 kv heads x int8 cache) over the flagship
+    joint-sequence geometry — text prefix tl=256, 32x32 image grid,
+    n=1280.  Each of the four structured types runs through its own
+    block-row table (ops/structured.decode_row_blocks) against the
+    dense-masked sdpa oracle on the SAME analytic mask rows; compile/ms
+    are recorded for the axial_row config (the others share the kernel,
+    only the table and in-kernel predicate differ).  Fwd-only."""
+    jax, jnp, import_s = _import_jax_for_probe()
+
+    from dalle_tpu.ops import attention as A
+    from dalle_tpu.ops import structured
+    from dalle_tpu.ops.flash import (
+        structured_block_k, structured_decode_attention,
+    )
+    from dalle_tpu.ops.quant import dequantize_rows, quantize_rows
+
+    platform = jax.default_backend()
+    n, d = next((n_, d_) for nm, n_, d_, *_ in CASES if nm == name)
+    text_seq_len, f = 256, 32   # n = text_seq_len + f*f (bos in, last cell
+    assert text_seq_len + f * f == n, (text_seq_len, f, n)  # virtual)
+    b, kv, g = 8, 8, 1
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, kv, g, d), jnp.bfloat16)
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, kv, n, d))
+    kq, ks = quantize_rows(kc)
+    vq, vs = quantize_rows(vc)
+    pos = jnp.arange(b, dtype=jnp.int32) * ((n - 1) // (b - 1))
+
+    kd, vd = dequantize_rows(kq, ks), dequantize_rows(vq, vs)
+    cols = jnp.arange(n, dtype=jnp.int32)
+    lay = structured.padded_sparse_layout(
+        n, text_seq_len, block=16, num_local_blocks=4,
+        num_random_blocks=None,
+    )
+    rec = {
+        "case": name, "slots": b, "kv_heads": kv, "n": n, "d": d,
+        "text_seq_len": text_seq_len, "fmap_size": f, "dtype": "bfloat16",
+        "platform": platform, "interpret": platform != "tpu",
+        "import_s": round(import_s, 1),
+    }
+    worst = 0.0
+    for at in structured.STRUCTURED_TYPES:
+        bk = structured_block_k(n, at)
+        tbl = structured.decode_row_blocks(
+            at, bk, text_seq_len, f, causal=True,
+        )
+        blocks = jnp.asarray(tbl)[pos]
+
+        fn = jax.jit(lambda q_, _at=at, _bk=bk: structured_decode_attention(
+            q_, kq, vq, pos, blocks, k_scale=ks, v_scale=vs,
+            attn_type=_at, text_seq_len=text_seq_len, fmap_size=f,
+            block_k=_bk, force_kernel=True))
+        t0 = time.perf_counter()
+        out = fn(q)
+        jax.block_until_ready(out)
+        compile_s = time.perf_counter() - t0
+        if at == "axial_row":
+            iters = 10
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q)
+            jax.block_until_ready(out)
+            rec["fwd_compile_s"] = round(compile_s, 2)
+            rec["fwd_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 3)
+
+        rows = structured.decode_mask_rows(
+            at, pos, cols, text_seq_len=text_seq_len, fmap_size=f,
+            sparse_layout=lay if at == "sparse" else None,
+        )
+        want = A._sdpa(q, kd, vd, rows[:, None, None, :])
+        worst = max(worst, float(jnp.max(jnp.abs(
+            out.astype(jnp.float32) - want.astype(jnp.float32)))))
+    rec["fwd_max_err"] = round(worst, 6)
+    rec["numerics_ok"] = bool(worst < 3e-2)
+    return rec
+
+
 def run_case(name: str) -> dict:
     """Child entry: compile+run fwd and bwd for one case, check numerics."""
     if name.startswith("dequant_int8"):
@@ -431,6 +518,8 @@ def run_case(name: str) -> dict:
         return _run_shard_case(name)
     if name.startswith("sp_tick"):
         return _run_sp_case(name)
+    if name.startswith("axial_tick"):
+        return _run_axial_case(name)
     n, d, dtype_name, sparse, masked = next(
         (n_, d_, dt, sp, mk) for nm, n_, d_, dt, sp, mk in CASES if nm == name
     )

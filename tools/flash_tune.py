@@ -186,6 +186,92 @@ def run_decode_sweep(args) -> dict:
     return summary
 
 
+def run_axial_sweep(args) -> dict:
+    """--kernel axial: sweep the STRUCTURED decode kernel's (kv-block
+    length x kv-head tiling) at the serving shape — one query row per
+    slot gathering only the attended cache tiles of an axial_row layer
+    through its block-row table (ops/flash.py structured_decode_attention;
+    the --structured_decode per-tick hot path).  The block-row table is
+    rebuilt per bk (table and grid must agree), so the sweep covers the
+    real trade: smaller tiles read fewer wasted rows but take more grid
+    steps.  Winners print as DALLE_TPU_AXIAL_BLOCK_K/_H exports, which
+    the kernel reads as its defaults (``default_axial_block``)."""
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dalle_tpu.ops import structured
+    from dalle_tpu.ops.flash import structured_decode_attention
+    from dalle_tpu.ops.quant import quantize_rows
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    b, kv, g, d, n = args.slots, args.kv_heads, args.gq, args.d, args.n
+    # the largest square grid fitting under n fixes the text prefix:
+    # n = text_seq_len + f*f (bos in, final image cell virtual)
+    f = 1
+    while (f + 1) * (f + 1) < n:
+        f += 1
+    text_seq_len = n - f * f
+    assert text_seq_len >= 1, (n, f)
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, kv, g, d), dtype)
+    kc = jax.random.normal(jax.random.fold_in(rng, 1), (b, kv, n, d))
+    vc = jax.random.normal(jax.random.fold_in(rng, 2), (b, kv, n, d))
+    kq, ks = quantize_rows(kc)
+    vq, vs = quantize_rows(vc)
+    # staggered occupancy: slots spread across the whole cache depth
+    pos = (jnp.arange(b, dtype=jnp.int32) * ((n - 1) // max(b - 1, 1)))
+
+    bks = [bk for bk in (32, 64, 128, 256) if bk <= n and n % bk == 0]
+    bhs = [bh for bh in (1, 2, 4, 8) if bh <= kv and kv % bh == 0]
+    if args.smoke:
+        bks, bhs = bks[:2], bhs[:2]
+    os.makedirs(os.path.dirname(args.log), exist_ok=True)
+    results = []
+    for bk, bh in itertools.product(bks, bhs):
+        rec = {"kernel": "axial", "attn_type": "axial_row", "bk": bk,
+               "bh": bh, "slots": b, "kv_heads": kv, "gq": g, "n": n,
+               "d": d, "text_seq_len": text_seq_len, "fmap_size": f,
+               "dtype": args.dtype, "on_tpu": on_tpu, "t": time.time()}
+        try:
+            tbl = structured.decode_row_blocks(
+                "axial_row", bk, text_seq_len, f, causal=True)
+            blocks = jnp.asarray(tbl)[pos]
+            rec["table_width"] = int(tbl.shape[1])
+            tick = jax.jit(
+                lambda q, blocks, _bk=bk, _bh=bh: structured_decode_attention(
+                    q, kq, vq, pos, blocks, k_scale=ks, v_scale=vs,
+                    attn_type="axial_row", text_seq_len=text_seq_len,
+                    fmap_size=f, block_k=_bk, block_kv_heads=_bh,
+                    force_kernel=not on_tpu))
+            rec["compile_s"], rec["tick_ms"] = _time_case(
+                tick, (q, blocks), args.iters)
+            rec["ok"] = True
+        except Exception as e:
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"[-300:]
+        results.append(rec)
+        _record(args.log, rec,
+                f"bk={bk} bh={bh}: "
+                + (f"{rec.get('tick_ms')}ms" if rec["ok"] else rec["error"]))
+    ok = [r for r in results if r.get("ok")]
+    summary = {"tool": "flash_tune", "kernel": "axial", "slots": b,
+               "kv_heads": kv, "gq": g, "n": n, "d": d,
+               "text_seq_len": text_seq_len, "fmap_size": f,
+               "on_tpu": on_tpu, "configs_ok": len(ok),
+               "configs_total": len(results)}
+    if ok:
+        best = min(ok, key=lambda r: r["tick_ms"])
+        summary["best"] = {k: best[k] for k in ("bk", "bh", "tick_ms")}
+        summary["export"] = (
+            f"export DALLE_TPU_AXIAL_BLOCK_K={best['bk']} "
+            f"DALLE_TPU_AXIAL_BLOCK_H={best['bh']}"
+        )
+    return summary
+
+
 def run_sweep(args) -> dict:
     import jax
     import jax.numpy as jnp
@@ -261,12 +347,13 @@ def main():
     ap.add_argument("--log", default=DEFAULT_LOG)
     ap.add_argument("--smoke", action="store_true",
                     help="2x2 configs at the given shapes (harness check)")
-    ap.add_argument("--kernel", choices=("flash", "dequant", "decode"),
+    ap.add_argument("--kernel", choices=("flash", "dequant", "decode", "axial"),
                     default="flash",
                     help="which Pallas kernel to sweep: flash attention "
-                         "blocks, the weight-only int8 dequant matmul, or "
-                         "the decode-attention kernel (kv block x head "
-                         "tiling)")
+                         "blocks, the weight-only int8 dequant matmul, the "
+                         "decode-attention kernel (kv block x head tiling), "
+                         "or the structured decode kernel (attended-tile "
+                         "gather; --structured_decode hot path)")
     ap.add_argument("--m", type=int, default=512,
                     help="dequant sweep: activation rows (batch*tokens)")
     ap.add_argument("--dq_d", type=int, default=512,
@@ -292,6 +379,10 @@ def main():
         return 0 if summary["configs_ok"] else 2
     if args.kernel == "decode":
         summary = run_decode_sweep(args)
+        print(json.dumps(summary))
+        return 0 if summary["configs_ok"] else 2
+    if args.kernel == "axial":
+        summary = run_axial_sweep(args)
         print(json.dumps(summary))
         return 0 if summary["configs_ok"] else 2
     summary = run_sweep(args)
