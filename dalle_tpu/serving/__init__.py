@@ -27,6 +27,14 @@ from dalle_tpu.serving.fleet import (
     Router,
     fleet_replay_trace,
 )
+from dalle_tpu.serving.protocol import (
+    apply_result_wire,
+    parse_serve_request,
+    request_from_wire,
+    request_to_wire,
+    result_to_wire,
+    validate_serve_flags,
+)
 from dalle_tpu.serving.queue import (
     Request,
     RequestError,
@@ -45,8 +53,17 @@ from dalle_tpu.serving.scheduler import (
     request_stats,
     save_trace,
 )
+# last: the gateway builds on queue/protocol/scheduler above
+from dalle_tpu.serving.gateway import Gateway  # noqa: E402
 
 __all__ = [
+    "Gateway",
+    "apply_result_wire",
+    "parse_serve_request",
+    "request_from_wire",
+    "request_to_wire",
+    "result_to_wire",
+    "validate_serve_flags",
     "DecodeEngine",
     "EngineState",
     "Fleet",

@@ -40,6 +40,27 @@ from dalle_tpu import telemetry
 from dalle_tpu.serving.queue import Request, RequestQueue
 from dalle_tpu.training.logging import log_event
 
+# Fallback seconds-per-tick before any replica has reported a measured
+# tick EWMA (first polls of a cold fleet / first load reports of a cold
+# gateway worker).
+DEFAULT_TICK_S = 1e-3
+
+
+def est_finish_s(busy_ticks: float, backlog: int, ticks_per_request: int,
+                 tick_s: Optional[float]) -> float:
+    """Estimated seconds until a replica finishes everything it holds.
+
+    ``busy_ticks`` decode ticks still owed by admitted slots plus
+    ``backlog`` not-yet-admitted requests at ``ticks_per_request`` each,
+    scaled by the replica's measured seconds-per-tick.  The ONE placement
+    formula: the in-thread :class:`Router` computes it from fresh poll
+    snapshots, the gateway's admission layer from periodic process-level
+    load reports — both deal work least-estimated-finish-first.
+    """
+    return (busy_ticks + backlog * ticks_per_request) * (
+        tick_s if tick_s else DEFAULT_TICK_S
+    )
+
 
 class Router:
     """Places shared-queue work onto the least-loaded alive replica.
@@ -92,11 +113,13 @@ class Router:
         if t:
             return t
         known = [v[2] for v in self._load.values() if v[2]]
-        return sum(known) / len(known) if known else 1e-3
+        return sum(known) / len(known) if known else DEFAULT_TICK_S
 
     def _est_finish_s(self, rid: int) -> float:
         busy, _, _ = self._load[rid]
-        return (busy + len(self._stash[rid]) * self.S) * self._tick_s(rid)
+        return est_finish_s(
+            busy, len(self._stash[rid]), self.S, self._tick_s(rid)
+        )
 
     def _grant(self, rid: int, want: int) -> int:
         """How many NEW shared-queue pops ``rid`` may keep right now.

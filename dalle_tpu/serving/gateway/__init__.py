@@ -1,0 +1,36 @@
+"""HTTP front door + multi-process fleet (docs/SERVING.md §12).
+
+The process-level counterpart of ``serving/fleet``: replicas are worker
+*processes* (own interpreter, own jax backend, pinned platform) behind
+one :class:`Gateway` that owns admission, the federated observability
+surface, and crash drain across process death.  Stdlib networking only.
+"""
+
+from dalle_tpu.serving.gateway.admission import AdmissionPolicy
+from dalle_tpu.serving.gateway.cachehost import (
+    CacheHost,
+    RemotePrefixPool,
+    RemoteResultCache,
+)
+from dalle_tpu.serving.gateway.gateway import Gateway, WorkerHandle
+from dalle_tpu.serving.gateway.wire import (
+    FramedSocket,
+    decode_array,
+    encode_array,
+    recv_frame,
+    send_frame,
+)
+
+__all__ = [
+    "AdmissionPolicy",
+    "CacheHost",
+    "FramedSocket",
+    "Gateway",
+    "RemotePrefixPool",
+    "RemoteResultCache",
+    "WorkerHandle",
+    "decode_array",
+    "encode_array",
+    "recv_frame",
+    "send_frame",
+]

@@ -1,0 +1,154 @@
+"""Gateway admission: least-estimated-finish dealing over load reports.
+
+The in-thread fleet :class:`~dalle_tpu.serving.fleet.router.Router` is a
+*pull* design — replicas poll a shared queue with fresh load snapshots.
+Across processes the gateway *pushes*: workers stream periodic load
+reports over their control socket (busy decode ticks, free slots,
+seconds-per-tick EWMA) and the gateway places each arriving request on
+the worker whose :func:`~dalle_tpu.serving.fleet.router.est_finish_s` —
+the SAME formula the router uses — is lowest, counting work the gateway
+has dispatched but not yet seen reported back (otherwise a burst between
+two load reports would all land on one worker).
+
+Busy ticks are EWMA-smoothed here rather than trusted raw: a process
+report is hundreds of ticks stale by arrival, and a single in-flight
+snapshot whipsaws placement; the EWMA (same spirit as the scheduler's
+tick-time EWMA) makes dealing stable under report jitter.
+
+``replica_hint`` keeps its advisory fleet semantics: honored when the
+hinted worker is alive and has free capacity, ignored otherwise.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from dalle_tpu.serving.fleet.router import est_finish_s
+
+
+class WorkerLoad:
+    """Last reported + dispatch-adjusted load of one worker process."""
+
+    __slots__ = ("busy_ewma", "free_slots", "tick_s", "pending",
+                 "in_flight", "reports")
+
+    def __init__(self, num_slots: int):
+        self.busy_ewma = 0.0
+        self.free_slots = num_slots
+        self.tick_s: Optional[float] = None
+        self.pending = 0
+        # requests dispatched by the gateway and not yet completed —
+        # the "live" half of the estimate between two load reports
+        self.in_flight = 0
+        self.reports = 0
+
+
+class AdmissionPolicy:
+    """Places each request on the least-estimated-finish alive worker."""
+
+    def __init__(self, *, ticks_per_request: int, alpha: float = 0.4):
+        self.S = int(ticks_per_request)
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._loads: Dict[int, WorkerLoad] = {}  # guarded-by: _lock
+        self.dealt = 0  # guarded-by: _lock
+        self.hinted = 0  # guarded-by: _lock
+
+    # --- membership ------------------------------------------------------
+    def register(self, rid: int, num_slots: int) -> None:
+        with self._lock:
+            self._loads[rid] = WorkerLoad(num_slots)
+
+    def retire(self, rid: int) -> None:
+        with self._lock:
+            self._loads.pop(rid, None)
+
+    def alive(self) -> List[int]:
+        with self._lock:
+            return sorted(self._loads)
+
+    # --- load reports ----------------------------------------------------
+    def report(self, rid: int, *, busy_ticks: float, free_slots: int,
+               tick_s: Optional[float], pending: int) -> None:
+        """Fold one process-level load report into the book (a report
+        from a worker retired between send and receive is dropped)."""
+        with self._lock:
+            load = self._loads.get(rid)
+            if load is None:
+                return
+            if load.reports == 0:
+                load.busy_ewma = float(busy_ticks)
+            else:
+                load.busy_ewma += self.alpha * (
+                    float(busy_ticks) - load.busy_ewma
+                )
+            load.free_slots = int(free_slots)
+            if tick_s:
+                load.tick_s = float(tick_s)
+            load.pending = int(pending)
+            load.reports += 1
+
+    def completed(self, rid: int) -> None:
+        """Release one unit of dispatch-adjusted load (result arrived,
+        OR the dispatch failed after :meth:`pick` reserved the unit)."""
+        with self._lock:
+            if rid in self._loads:
+                load = self._loads[rid]
+                load.in_flight = max(0, load.in_flight - 1)
+
+    # --- placement -------------------------------------------------------
+    def _est(self, load: WorkerLoad, tick_fallback: Optional[float]) -> float:
+        return est_finish_s(
+            load.busy_ewma, load.in_flight, self.S,
+            load.tick_s or tick_fallback,
+        )
+
+    def pick(self, replica_hint: Optional[int] = None) -> Optional[int]:
+        """The worker to hand the next request (None: no workers alive).
+
+        Hint first (alive + free capacity beyond what the gateway already
+        dispatched), then least estimated finish; deterministic id
+        tie-break like the router's, so equally idle workers are dealt
+        round-robin-stably rather than by dict order."""
+        with self._lock:
+            if not self._loads:
+                return None
+            if replica_hint is not None:
+                hinted = self._loads.get(replica_hint)
+                if hinted is not None and hinted.free_slots > hinted.in_flight:
+                    self.hinted += 1
+                    hinted.in_flight += 1
+                    return replica_hint
+            known = [l.tick_s for l in self._loads.values() if l.tick_s]
+            fallback = sum(known) / len(known) if known else None
+            # prefer workers with uncommitted capacity; when every worker
+            # is saturated the least-finish one still takes the request
+            # (gateway-side queueing happens in the worker's own queue)
+            free = [
+                r for r, l in self._loads.items()
+                if l.free_slots > l.in_flight
+            ]
+            pool = free if free else list(self._loads)
+            rid = min(
+                pool,
+                key=lambda r: (self._est(self._loads[r], fallback), r),
+            )
+            self._loads[rid].in_flight += 1
+            self.dealt += 1
+            return rid
+
+    # --- introspection ---------------------------------------------------
+    def load_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                str(r): {
+                    "busy_ewma": round(l.busy_ewma, 3),
+                    "free_slots": l.free_slots,
+                    "tick_ewma_s": l.tick_s,
+                    "in_flight": l.in_flight,
+                    "pending": l.pending,
+                    "reports": l.reports,
+                }
+                for r, l in sorted(self._loads.items())
+            }

@@ -1,0 +1,350 @@
+"""The cache host: result cache + prefix pool as their own process.
+
+PR 8's :class:`ResultCache` and :class:`PrefixPool` are in-memory LRU
+maps keyed by fingerprinted content addresses
+(``request_key``/``text_key`` — pure functions of model fingerprint,
+text tokens, seed and sampling).  Rehosting them behind a socket keeps
+coherence trivial: every worker process computes the SAME key for the
+same work, so the shared maps need no invalidation protocol — a
+checkpoint/step change rolls the fingerprint and with it every key,
+exactly as in-process (docs/SERVING.md §7).
+
+Topology: the host binds an ephemeral service port, reports it to the
+gateway over the control socket, and worker processes connect as plain
+request/response clients (one frame in, one frame out).  Array payloads
+ride the base64 envelope from :mod:`.wire` — no pickle.
+
+Failure mode is *graceful degradation*, not availability coupling: the
+client classes (:class:`RemoteResultCache`, :class:`RemotePrefixPool`)
+duck-type their in-process counterparts and turn any socket failure
+into a cache miss / dropped put, with one reconnect attempt per backoff
+window.  Killing the cache host mid-flood costs hit rate, never
+correctness and never a hang (the process-level cache-crash chaos
+scenario pins this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dalle_tpu.serving.cache.prefix import PrefixEntry, PrefixPool
+from dalle_tpu.serving.cache.results import ResultCache
+from dalle_tpu.serving.gateway import wire
+
+
+class CacheHost:
+    """Serves ONE ResultCache + ONE PrefixPool over framed sockets."""
+
+    def __init__(self, *, result_bytes: int, prefix_bytes: int,
+                 host: str = "127.0.0.1"):
+        self.results = ResultCache(result_bytes) if result_bytes else None
+        self.prefixes = PrefixPool(prefix_bytes) if prefix_bytes else None
+        self._listener = socket.create_server((host, 0))
+        self.port = self._listener.getsockname()[1]
+        self._lock = threading.Lock()
+        self._stop = False  # guarded-by: _lock
+        self._threads: List[threading.Thread] = []  # guarded-by: _lock
+
+    # --- the request/response surface ------------------------------------
+    def handle(self, msg: dict) -> dict:
+        op = msg.get("op")
+        # every data-op reply carries the map's current byte count so
+        # clients can mirror `.bytes` without a dedicated roundtrip
+        rbytes = self.results.bytes if self.results is not None else 0
+        pbytes = self.prefixes.bytes if self.prefixes is not None else 0
+        if op == "rget":
+            codes = (self.results.get(str(msg["key"]))
+                     if self.results is not None else None)
+            return {"ok": True, "bytes": rbytes,
+                    "codes": (None if codes is None
+                              else wire.encode_array(codes))}
+        if op == "rput":
+            if self.results is not None:
+                self.results.put(str(msg["key"]),
+                                 wire.decode_array(msg["codes"]))
+                rbytes = self.results.bytes
+            return {"ok": True, "bytes": rbytes}
+        if op == "pget":
+            entry = (self.prefixes.get(str(msg["key"]))
+                     if self.prefixes is not None else None)
+            if entry is None:
+                return {"ok": True, "bytes": pbytes, "entry": None}
+            return {"ok": True, "bytes": pbytes, "entry": {
+                "leaves": [wire.encode_array(a) for a in entry.leaves],
+                "first": int(entry.first),
+            }}
+        if op == "pput":
+            if self.prefixes is not None:
+                self.prefixes.put(
+                    str(msg["key"]),
+                    [wire.decode_array(d) for d in msg["leaves"]],
+                    int(msg["first"]),
+                )
+                pbytes = self.prefixes.bytes
+            return {"ok": True, "bytes": pbytes}
+        if op == "stats":
+            return {"ok": True,
+                    "results": (self.results.stats()
+                                if self.results is not None else None),
+                    "prefixes": (self.prefixes.stats()
+                                 if self.prefixes is not None else None)}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = wire.recv_frame(conn)
+                if msg is None:
+                    return
+                try:
+                    out = self.handle(msg)
+                except Exception as e:  # one bad op must not kill the host
+                    out = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                wire.send_frame(conn, out)
+        except ConnectionError:
+            return  # client died; its state is just map entries — fine
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def serve_forever(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            with self._lock:
+                if self._stop:
+                    conn.close()
+                    return
+                t = threading.Thread(
+                    target=self._client_loop, args=(conn,), daemon=True
+                )
+                self._threads.append(t)
+            t.start()
+
+    def start(self) -> "CacheHost":
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+# --- worker-side clients ----------------------------------------------------
+
+
+class _CacheClient:
+    """One framed request/response connection with degrade-to-miss.
+
+    Every op serializes under the client lock (request/response pairs on
+    one socket must not interleave).  A dead host costs one failed op,
+    then misses until the backoff window elapses and a reconnect is
+    attempted — the serving path never blocks on cache availability
+    beyond a socket timeout.
+    """
+
+    def __init__(self, addr: Tuple[str, int], *, timeout_s: float = 2.0,
+                 retry_after_s: float = 5.0):
+        self.addr = (addr[0], int(addr[1]))
+        self.timeout_s = timeout_s
+        self.retry_after_s = retry_after_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None  # guarded-by: _lock
+        self._next_retry = 0.0  # guarded-by: _lock
+        self.errors = 0  # guarded-by: _lock
+
+    def _connect_locked(self) -> Optional[socket.socket]:
+        if self._sock is not None:
+            return self._sock
+        now = time.monotonic()
+        if now < self._next_retry:
+            return None
+        try:
+            s = socket.create_connection(self.addr, timeout=self.timeout_s)
+            s.settimeout(self.timeout_s)
+            self._sock = s
+            return s
+        except OSError:
+            self.errors += 1
+            self._next_retry = now + self.retry_after_s
+            return None
+
+    def call(self, msg: dict) -> Optional[dict]:
+        """One op; None when the host is unreachable (degrade to miss)."""
+        with self._lock:
+            s = self._connect_locked()
+            if s is None:
+                return None
+            try:
+                wire.send_frame(s, msg)
+                out = wire.recv_frame(s)
+            except (ConnectionError, socket.timeout, OSError):
+                out = None
+            if out is None or not out.get("ok"):
+                self.errors += 1
+                self._next_retry = time.monotonic() + self.retry_after_s
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                self._sock = None
+                return None
+            return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+class RemoteResultCache:
+    """Duck-types :class:`ResultCache` over a cache-host connection."""
+
+    def __init__(self, addr: Tuple[str, int], **kw):
+        self._c = _CacheClient(addr, **kw)
+        # mirrored from op replies; scheduler telemetry reads this on
+        # the hot path, so it must never trigger a network roundtrip
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        out = self._c.call({"op": "rget", "key": key})
+        if out is not None:
+            self.bytes = int(out.get("bytes", self.bytes))
+        if out is None or out.get("codes") is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        codes = wire.decode_array(out["codes"])
+        codes.setflags(write=False)
+        return codes
+
+    def put(self, key: str, codes) -> None:
+        arr = np.asarray(codes)
+        out = self._c.call({"op": "rput", "key": key,
+                           "codes": wire.encode_array(arr)})
+        if out is not None:
+            self.bytes = int(out.get("bytes", self.bytes))
+
+    def stats(self) -> dict:
+        out = self._c.call({"op": "stats"})
+        base = (out or {}).get("results") or {}
+        return {**base, "remote_errors": self._c.errors}
+
+    def close(self) -> None:
+        self._c.close()
+
+
+class RemotePrefixPool:
+    """Duck-types :class:`PrefixPool` over a cache-host connection."""
+
+    def __init__(self, addr: Tuple[str, int], **kw):
+        self._c = _CacheClient(addr, **kw)
+        self.bytes = 0  # mirrored from op replies, see RemoteResultCache
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> Optional[PrefixEntry]:
+        out = self._c.call({"op": "pget", "key": key})
+        if out is not None:
+            self.bytes = int(out.get("bytes", self.bytes))
+        entry = (out or {}).get("entry")
+        if entry is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        leaves = [wire.decode_array(d) for d in entry["leaves"]]
+        return PrefixEntry(
+            leaves=leaves, first=int(entry["first"]),
+            nbytes=sum(a.nbytes for a in leaves),
+        )
+
+    def put(self, key: str, leaves, first: int) -> None:
+        out = self._c.call({
+            "op": "pput", "key": key,
+            "leaves": [wire.encode_array(np.asarray(a)) for a in leaves],
+            "first": int(first),
+        })
+        if out is not None:
+            self.bytes = int(out.get("bytes", self.bytes))
+
+    def stats(self) -> dict:
+        out = self._c.call({"op": "stats"})
+        base = (out or {}).get("prefixes") or {}
+        return {**base, "remote_errors": self._c.errors}
+
+    def close(self) -> None:
+        self._c.close()
+
+
+# --- process entry point ----------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """``python -m dalle_tpu.serving.gateway.cachehost`` — spawned by the
+    gateway.  Binds the service port, reports it over the gateway control
+    socket, then serves until the control connection drops (gateway gone
+    → exit; an orphan cache host has nothing to serve)."""
+    p = argparse.ArgumentParser()
+    p.add_argument("--connect", required=True, metavar="HOST:PORT",
+                   help="gateway control address to report the port to")
+    p.add_argument("--token", required=True)
+    p.add_argument("--result_bytes", type=int, default=64 << 20)
+    p.add_argument("--prefix_bytes", type=int, default=64 << 20)
+    args = p.parse_args(argv)
+
+    host = CacheHost(
+        result_bytes=args.result_bytes, prefix_bytes=args.prefix_bytes,
+    ).start()
+    chost, cport = args.connect.rsplit(":", 1)
+    ctl = socket.create_connection((chost, int(cport)), timeout=10.0)
+    # connect timeout only: the control recv below blocks for the
+    # gateway's whole lifetime — a lingering per-op timeout here would
+    # read as ConnectionError and silently retire the host
+    ctl.settimeout(None)
+    wire.send_frame(ctl, {
+        "type": "hello", "role": "cache", "token": args.token,
+        "port": host.port, "pid": os.getpid(),
+    })
+    try:
+        while True:
+            msg = wire.recv_frame(ctl)
+            if msg is None:
+                break  # gateway closed the control plane
+            if msg.get("type") == "stats":
+                wire.send_frame(ctl, {
+                    "type": "stats", **host.handle({"op": "stats"}),
+                })
+            elif msg.get("type") == "shutdown":
+                break
+    except ConnectionError:
+        pass
+    host.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

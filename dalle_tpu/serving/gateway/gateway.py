@@ -1,0 +1,745 @@
+"""The gateway: HTTP front door + worker-process supervisor.
+
+One process owns the service surface and ZERO device state:
+
+* spawns N worker processes (``gateway/worker.py`` — each its own
+  Scheduler + DecodeEngine behind a framed control socket) and one cache
+  host (``gateway/cachehost.py``), collecting ``hello`` handshakes that
+  carry each worker's pid, model fingerprint, and ephemeral telemetry
+  port;
+* admits requests through :class:`AdmissionPolicy` — the fleet router's
+  least-estimated-finish dealing, fed by periodic process-level load
+  reports instead of in-thread polls;
+* serves ``POST /v1/generate`` (JSONL in, streamed JSONL out),
+  ``/healthz``, ``/statusz``, and a federated ``/metrics`` where every
+  worker scrape passes the strict ``parse_prometheus`` oracle before a
+  single line of it reaches the fleet page;
+* carries the fleet's crash semantics across process death: a dead
+  control socket (or a reaped pid) retires the worker, its last
+  flight-recorder dump is collected, and its unacknowledged in-flight
+  requests are replayed on survivors *in submission order* — bitwise
+  safe because codes are a pure function of (text, seed, sampling) and
+  every worker holds identical params by spec determinism.
+
+Everything here is stdlib networking + host bookkeeping; this module
+itself never touches jax (workers do, after pinning their platform) —
+though importing the ``dalle_tpu.serving`` package still pulls the
+in-process engine, so gateway *worker* processes pin JAX_PLATFORMS
+via env before any import.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from dalle_tpu.serving import protocol
+from dalle_tpu.serving.gateway.admission import AdmissionPolicy
+from dalle_tpu.serving.gateway.wire import FramedSocket, recv_frame
+from dalle_tpu.serving.queue import Request
+from dalle_tpu.telemetry import MetricsRegistry, exposition
+from dalle_tpu.training.logging import log_event
+
+DEFAULT_REPLAY_BUDGET = 2  # process deaths one request may survive
+
+
+class WorkerHandle:
+    """Gateway-side state of one worker process.
+
+    ``in_flight`` is the crash-drain ledger: a request lives here from
+    dispatch until its result frame arrives, so whatever remains when
+    the socket dies is EXACTLY the set to replay on survivors (TCP
+    delivers sent results before EOF — an acknowledged result can never
+    be replayed).  Insertion order is submission order, which is the
+    replay order."""
+
+    def __init__(self, rid: int, proc: subprocess.Popen, run_dir: str):
+        self.rid = rid
+        self.proc = proc
+        self.run_dir = run_dir
+        self.sock: Optional[FramedSocket] = None
+        self.pid: Optional[int] = None
+        self.slots: Optional[int] = None
+        self.telemetry_port: Optional[int] = None
+        self.fingerprint: Optional[str] = None
+        self.image_seq_len: Optional[int] = None
+        self.dead = False  # guarded-by: (Gateway) _lock
+        self.in_flight: Dict[str, Request] = {}  # guarded-by: (Gateway) _lock
+        # last scrape that PASSED parse_prometheus — served frozen after
+        # death / during a torn scrape so federated counters stay
+        # monotonic per series
+        self.last_scrape: Optional[dict] = None  # guarded-by: (Gateway) _lock
+        self.final_stats: Optional[dict] = None
+
+
+class Gateway:
+    """Front door + supervisor over a multi-process serving fleet."""
+
+    def __init__(
+        self,
+        model_spec: dict,
+        *,
+        num_workers: int = 2,
+        slots: int = 3,
+        platform: str = "cpu",
+        use_top_p: bool = False,
+        filter_thres: float = 0.9,
+        cache_result_bytes: int = 64 << 20,
+        cache_prefix_bytes: int = 64 << 20,
+        max_in_flight: Optional[int] = None,
+        replay_budget: int = DEFAULT_REPLAY_BUDGET,
+        run_dir: Optional[str] = None,
+        http_port: Optional[int] = None,
+        load_report_interval_s: float = 0.1,
+        scheduler_kw: Optional[dict] = None,
+        worker_env: Optional[dict] = None,
+        tokenizer=None,
+        text_seq_len: Optional[int] = None,
+        ready_timeout_s: float = 600.0,
+    ):
+        assert num_workers >= 1, f"num_workers must be >= 1, got {num_workers}"
+        self.model_spec = dict(model_spec)
+        self.num_workers = int(num_workers)
+        self.slots = int(slots)
+        self.platform = platform
+        self.use_top_p = use_top_p
+        self.filter_thres = filter_thres
+        self.cache_result_bytes = int(cache_result_bytes)
+        self.cache_prefix_bytes = int(cache_prefix_bytes)
+        self.max_in_flight = max_in_flight
+        self.replay_budget = int(replay_budget)
+        self.run_dir = run_dir or tempfile.mkdtemp(prefix="gateway_")
+        self.http_port = http_port
+        self.load_report_interval_s = float(load_report_interval_s)
+        self.scheduler_kw = dict(scheduler_kw or {})
+        self.worker_env = dict(worker_env or {})
+        self.tokenizer = tokenizer
+        self.text_seq_len = text_seq_len
+        self.ready_timeout_s = float(ready_timeout_s)
+
+        self._token = uuid.uuid4().hex
+        self._lock = threading.RLock()
+        self._handles: Dict[int, WorkerHandle] = {}  # guarded-by: _lock
+        self._cache_proc: Optional[subprocess.Popen] = None
+        self._cache_addr = None  # set once by the cache hello
+        self._cache_ctl: Optional[FramedSocket] = None
+        self.policy = AdmissionPolicy(ticks_per_request=1)
+        self.completed: List[Request] = []  # guarded-by: _lock
+        self.flight_dumps: Dict[int, dict] = {}  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._listener: Optional[socket.socket] = None
+        self._http: Optional[ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+        self._ready = threading.Event()
+        self._cache_ready = threading.Event()
+
+        m = MetricsRegistry()
+        self.metrics = m
+        self._c_submitted = m.counter("gateway_submitted")
+        self._c_completed = m.counter("gateway_completed")
+        self._c_failed = m.counter("gateway_failed")
+        self._c_shed = m.counter("gateway_shed")
+        self._c_replayed = m.counter("gateway_replayed")
+        self._c_deaths = m.counter("gateway_worker_deaths")
+        self._c_scrape_errors = m.counter("gateway_scrape_errors")
+        self._g_alive = m.gauge("gateway_workers_alive")
+
+    # --- process spawning -------------------------------------------------
+    def _spawn_cache(self) -> None:
+        if self.cache_result_bytes <= 0 and self.cache_prefix_bytes <= 0:
+            return
+        log = open(os.path.join(self.run_dir, "cachehost.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dalle_tpu.serving.gateway.cachehost",
+             "--connect", f"127.0.0.1:{self._ctl_port}",
+             "--token", self._token,
+             "--result_bytes", str(self.cache_result_bytes),
+             "--prefix_bytes", str(self.cache_prefix_bytes)],
+            stdout=log, stderr=log, cwd=_repo_root(),
+        )
+        with self._lock:
+            self._cache_proc = proc
+        log.close()
+
+    def _spawn_worker(self, rid: int) -> WorkerHandle:
+        wdir = os.path.join(self.run_dir, f"worker{rid}")
+        os.makedirs(wdir, exist_ok=True)
+        spec = {
+            "replica_id": rid,
+            "token": self._token,
+            "control_addr": ["127.0.0.1", self._ctl_port],
+            "cache_addr": self._cache_addr,
+            "platform": self.platform,
+            "env": self.worker_env,
+            "model": self.model_spec,
+            "slots": self.slots,
+            "use_top_p": self.use_top_p,
+            "filter_thres": self.filter_thres,
+            "telemetry_dir": wdir,
+            "load_report_interval_s": self.load_report_interval_s,
+            "scheduler": self.scheduler_kw,
+        }
+        spec_path = os.path.join(wdir, "spec.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        log = open(os.path.join(wdir, "worker.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dalle_tpu.serving.gateway.worker",
+             "--spec", spec_path],
+            stdout=log, stderr=log, cwd=_repo_root(),
+        )
+        log.close()
+        return WorkerHandle(rid, proc, wdir)
+
+    # --- handshakes -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._handshake, args=(conn,), daemon=True
+            ).start()
+
+    def _handshake(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30.0)
+            hello = recv_frame(conn)
+        except ConnectionError:
+            conn.close()
+            return
+        if not hello or hello.get("token") != self._token:
+            conn.close()
+            return
+        conn.settimeout(None)
+        role = hello.get("role")
+        if role == "cache":
+            self._cache_addr = ["127.0.0.1", int(hello["port"])]
+            self._cache_ctl = FramedSocket(conn)
+            self._cache_ready.set()
+            return
+        if role != "worker":
+            conn.close()
+            return
+        rid = int(hello["replica"])
+        with self._lock:
+            h = self._handles.get(rid)
+            if h is None or h.sock is not None:
+                conn.close()
+                return
+            h.sock = FramedSocket(conn)
+            h.pid = int(hello["pid"])
+            h.slots = int(hello["slots"])
+            h.telemetry_port = hello.get("telemetry_port")
+            h.fingerprint = hello.get("fingerprint")
+            h.image_seq_len = hello.get("image_seq_len")
+            if h.image_seq_len:
+                # ticks-per-request for the est-finish formula: one
+                # request costs one image sequence of decode ticks
+                self.policy.S = int(h.image_seq_len)
+            self.policy.register(rid, h.slots)
+            self._g_alive.set(len(self._alive_locked()))
+        log_event("gateway_worker_up", replica=rid, pid=h.pid,
+                  telemetry_port=h.telemetry_port)
+        t = threading.Thread(
+            target=self._reader_loop, args=(h,), daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        with self._lock:
+            if all(hh.sock is not None for hh in self._handles.values()):
+                self._ready.set()
+
+    # --- per-worker reader ------------------------------------------------
+    def _reader_loop(self, h: WorkerHandle) -> None:
+        while True:
+            try:
+                msg = h.sock.recv()
+            except ConnectionError:
+                msg = None
+            if msg is None:
+                self._on_worker_dead(h, why="socket closed")
+                return
+            kind = msg.get("type")
+            if kind == "result":
+                self._on_result(h, msg["req"])
+            elif kind == "load":
+                self.policy.report(
+                    h.rid,
+                    busy_ticks=msg.get("busy_ticks", 0),
+                    free_slots=msg.get("free_slots", 0),
+                    tick_s=msg.get("tick_s"),
+                    pending=msg.get("pending", 0),
+                )
+            elif kind == "bye":
+                h.final_stats = msg.get("stats")
+            elif kind == "fatal":
+                log_event("gateway_worker_fatal", replica=h.rid,
+                          error=msg.get("error"))
+
+    def _on_result(self, h: WorkerHandle, wire_req: dict) -> None:
+        rid_key = str(wire_req.get("request_id"))
+        now = time.monotonic()
+        with self._lock:
+            req = h.in_flight.pop(rid_key, None)
+            if req is None:
+                return  # replayed elsewhere after a false-positive death
+            self.policy.completed(h.rid)
+            # the replay count is GATEWAY state: the worker serving a
+            # replacement dispatch reports retries=0 (it never knew the
+            # original), so the wire value must not clobber the ledger
+            retries = req.retries
+            protocol.apply_result_wire(req, wire_req, finish_time=now)
+            req.retries = max(req.retries, retries)
+            req.replica = h.rid
+            self.completed.append(req)
+        if req.error is None:
+            self._c_completed.inc()
+        else:
+            self._c_failed.inc()
+
+    # --- death + replay ---------------------------------------------------
+    def _on_worker_dead(self, h: WorkerHandle, *, why: str) -> None:
+        with self._lock:
+            if h.dead:
+                return
+            h.dead = True
+            self.policy.retire(h.rid)
+            victims = list(h.in_flight.values())
+            h.in_flight.clear()
+            for v in victims:
+                self.policy.completed(h.rid)
+            self._g_alive.set(len(self._alive_locked()))
+            closed = self._closed
+        self._c_deaths.inc()
+        if h.sock is not None:
+            h.sock.close()
+        self._collect_flight_dump(h)
+        log_event("gateway_worker_dead", replica=h.rid, why=why,
+                  in_flight=len(victims))
+        if closed:
+            for v in victims:
+                v._fail(f"gateway shutdown while replica {h.rid} died")
+            return
+        # Replay IN SUBMISSION ORDER on survivors: deterministic decode
+        # makes the re-run bitwise, so the only observable of the death
+        # is latency (and the retries count on the request).
+        for v in victims:
+            v.retries += 1
+            if v.retries > self.replay_budget:
+                v._fail(
+                    f"replica {h.rid} died; replay budget "
+                    f"({self.replay_budget}) exhausted"
+                )
+                self._c_failed.inc()
+                continue
+            v.codes = None
+            v.finish_time = None
+            v.admit_time = None
+            v.slot = None
+            self._c_replayed.inc()
+            self._dispatch(v)
+
+    def _collect_flight_dump(self, h: WorkerHandle) -> None:
+        """The dead worker's last flight-recorder dump, read post-mortem
+        from its telemetry run dir (best-effort: a kill -9 leaves only
+        what was already flushed)."""
+        dumps = sorted(glob.glob(os.path.join(h.run_dir, "flight_*.json")))
+        if not dumps:
+            return
+        path = dumps[-1]
+        doc = None
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        with self._lock:
+            self.flight_dumps[h.rid] = {"path": path, "doc": doc}
+
+    def _supervisor_loop(self) -> None:
+        """Reaps worker pids: catches a worker that died before its
+        handshake (no socket to detect) and keeps zombies from piling
+        up.  The socket reader usually wins the race; this is the
+        backstop."""
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+                handles = list(self._handles.values())
+            for h in handles:
+                if not h.dead and h.proc.poll() is not None:
+                    self._on_worker_dead(
+                        h, why=f"process exited {h.proc.returncode}"
+                    )
+            time.sleep(0.1)
+
+    # --- admission --------------------------------------------------------
+    def _alive_locked(self) -> List[int]:
+        return [r for r, h in self._handles.items() if not h.dead
+                and h.sock is not None]
+
+    def workers_alive(self) -> List[int]:
+        with self._lock:
+            return sorted(self._alive_locked())
+
+    def _dispatch(self, req: Request) -> None:
+        """Place ``req`` on a worker (admission already passed).  Called
+        for fresh submissions and crash replays alike."""
+        while True:
+            rid = self.policy.pick(req.replica_hint)
+            if rid is None:
+                req._fail("no workers alive")
+                self._c_failed.inc()
+                return
+            with self._lock:
+                h = self._handles.get(rid)
+                if h is None or h.dead or h.sock is None:
+                    self.policy.completed(rid)
+                    continue
+                h.in_flight[req.request_id] = req
+                sock = h.sock
+            try:
+                sock.send({
+                    "type": "submit",
+                    "req": protocol.request_to_wire(req),
+                })
+                return
+            except ConnectionError:
+                # racing a death the reader hasn't seen yet: pull the
+                # request back (the dead-path replay must not double it)
+                with self._lock:
+                    h.in_flight.pop(req.request_id, None)
+                    self.policy.completed(rid)
+                self._on_worker_dead(h, why="send failed")
+
+    def submit(self, req) -> Request:
+        """Admit one request (a :class:`Request`, a wire dict, or a text
+        line when the gateway holds a tokenizer).  Returns the local
+        Request; its ``result()`` terminates on completion, shed, or
+        fleet-wide failure — never hangs."""
+        if isinstance(req, dict):
+            if "text_tokens" in req:
+                req = protocol.request_from_wire(req)
+            else:
+                if self.tokenizer is None:
+                    raise ValueError(
+                        "text requests need a gateway tokenizer; send "
+                        "pre-tokenized 'text_tokens'"
+                    )
+                # the default request_id is "req{i}" and the in-flight
+                # ledger keys on it: i must be unique across the
+                # gateway's lifetime, not a per-call constant
+                with self._lock:
+                    i = self._seq
+                    self._seq += 1
+                req = protocol.parse_serve_request(
+                    req, i, tokenizer=self.tokenizer,
+                    text_seq_len=self.text_seq_len,
+                )
+        if req.arrival_time is None:
+            req.arrival_time = time.monotonic()
+        self._c_submitted.inc()
+        if self.max_in_flight is not None:
+            with self._lock:
+                open_n = sum(
+                    len(h.in_flight) for h in self._handles.values()
+                )
+            if open_n >= self.max_in_flight:
+                self._c_shed.inc()
+                req._fail(
+                    f"shed: gateway at capacity "
+                    f"(max_in_flight={self.max_in_flight})"
+                )
+                log_event("gateway_shed", request_id=req.request_id,
+                          max_in_flight=self.max_in_flight)
+                return req
+        self._dispatch(req)
+        return req
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self, *, wait_ready: bool = True) -> "Gateway":
+        os.makedirs(self.run_dir, exist_ok=True)
+        listener = socket.create_server(("127.0.0.1", 0))
+        with self._lock:
+            self._listener = listener
+        self._ctl_port = listener.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._spawn_cache()
+        if self._cache_proc is not None:
+            # worker specs embed the cache address: the cache's hello
+            # (which carries its ephemeral service port) must land
+            # before any spec is written, or workers run cacheless
+            if not self._cache_ready.wait(30.0):
+                self.close(drain=False)
+                raise TimeoutError(
+                    "cache host missed the handshake within 30s "
+                    f"(see cachehost.log in {self.run_dir})"
+                )
+        with self._lock:
+            for rid in range(self.num_workers):
+                self._handles[rid] = self._spawn_worker(rid)
+        t = threading.Thread(target=self._supervisor_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.http_port is not None:
+            self._start_http()
+        if wait_ready and not self._ready.wait(self.ready_timeout_s):
+            missing = [
+                r for r, h in self._handles.items() if h.sock is None
+            ]
+            self.close(drain=False)
+            raise TimeoutError(
+                f"workers {missing} missed the handshake within "
+                f"{self.ready_timeout_s}s (see worker.log in "
+                f"{self.run_dir})"
+            )
+        if wait_ready:
+            with self._lock:
+                prints = {h.fingerprint for h in self._handles.values()
+                          if not h.dead}
+            if len(prints) > 1:
+                self.close(drain=False)
+                raise RuntimeError(
+                    f"worker fingerprints diverge: {sorted(prints)} — "
+                    "bitwise crash drain needs identical models"
+                )
+        return self
+
+    def kill_worker(self, rid: int, sig: int = signal.SIGKILL) -> None:
+        """Chaos switch: kill -9 the worker process.  Detection and the
+        bitwise drain ride the normal death path."""
+        with self._lock:
+            h = self._handles.get(rid)
+        if h is not None and h.proc.poll() is None:
+            os.kill(h.proc.pid, sig)
+
+    def close(self, *, drain: bool = True, timeout_s: float = 60.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            handles = list(self._handles.values())
+        for h in handles:
+            if h.sock is not None and not h.dead:
+                try:
+                    h.sock.send({"type": "shutdown"})
+                except ConnectionError:
+                    pass
+        deadline = time.monotonic() + timeout_s
+        for h in handles:
+            if h.proc.poll() is None and drain:
+                try:
+                    h.proc.wait(max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    pass
+            if h.proc.poll() is None:
+                h.proc.kill()
+                h.proc.wait()
+            if h.sock is not None:
+                h.sock.close()
+        if self._cache_proc is not None:
+            if self._cache_proc.poll() is None:
+                self._cache_proc.kill()
+            self._cache_proc.wait()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        # nothing may hang on a closed gateway: fail whatever is left
+        with self._lock:
+            leftovers = [
+                r for h in handles for r in h.in_flight.values()
+            ]
+        for r in leftovers:
+            r._fail("gateway closed")
+
+    # --- observability ----------------------------------------------------
+    def _scrape_worker(self, h: WorkerHandle) -> Optional[dict]:
+        if h.dead or h.telemetry_port is None:
+            return None
+        url = f"http://127.0.0.1:{h.telemetry_port}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=2.0) as r:
+                text = r.read().decode("utf-8")
+            return exposition.parse_prometheus(text)  # the strict oracle
+        except (OSError, ValueError):
+            self._c_scrape_errors.inc()
+            return None
+
+    def scrape_metrics(self) -> str:
+        """The federated /metrics page: the gateway's own registry
+        (unlabeled) + every worker's scrape relabeled ``replica="N"``.
+        A worker scrape enters ONLY via ``parse_prometheus`` — torn
+        output is dropped whole and the worker's last good scrape is
+        served frozen (same after death), so each federated series stays
+        present and monotonic across a kill."""
+        scrapes: Dict[str, dict] = {}
+        with self._lock:
+            handles = list(self._handles.values())
+        for h in handles:
+            parsed = self._scrape_worker(h)
+            with self._lock:
+                if parsed is not None:
+                    h.last_scrape = parsed
+                if h.last_scrape is not None:
+                    scrapes[str(h.rid)] = h.last_scrape
+        own = exposition.render_prometheus(
+            self.metrics.exposition_snapshot()
+        )
+        return own + exposition.federate_prometheus(scrapes)
+
+    def healthz(self) -> dict:
+        with self._lock:
+            workers = {
+                str(h.rid): {
+                    "ok": not h.dead and h.sock is not None,
+                    "pid": h.pid,
+                    "telemetry_port": h.telemetry_port,
+                    "in_flight": len(h.in_flight),
+                }
+                for h in self._handles.values()
+            }
+        ok = any(w["ok"] for w in workers.values())
+        return {"ok": ok, "workers": workers,
+                "cache": self._cache_addr is not None}
+
+    def statusz(self) -> dict:
+        with self._lock:
+            dumps = {str(r): d["path"] for r, d in self.flight_dumps.items()}
+            completed = len(self.completed)
+        return {
+            "workers_alive": self.workers_alive(),
+            "admission": self.policy.load_snapshot(),
+            "completed": completed,
+            "flight_dumps": dumps,
+            "counters": {
+                "submitted": self._c_submitted.value,
+                "completed": self._c_completed.value,
+                "failed": self._c_failed.value,
+                "shed": self._c_shed.value,
+                "replayed": self._c_replayed.value,
+                "worker_deaths": self._c_deaths.value,
+            },
+        }
+
+    # --- HTTP surface -----------------------------------------------------
+    def _start_http(self) -> None:
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            timeout = 30.0
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._reply(200, gw.scrape_metrics().encode(),
+                                "text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    h = gw.healthz()
+                    self._reply(200 if h["ok"] else 503,
+                                json.dumps(h).encode(), "application/json")
+                elif self.path == "/statusz":
+                    self._reply(200, json.dumps(gw.statusz()).encode(),
+                                "application/json")
+                else:
+                    self._reply(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                if self.path.split("?")[0] != "/v1/generate":
+                    self._reply(404, b"not found", "text/plain")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode("utf-8", "replace")
+                reqs: List[Request] = []
+                errors: List[dict] = []
+                for i, line in enumerate(body.splitlines()):
+                    if not line.strip():
+                        continue
+                    try:
+                        reqs.append(gw.submit(json.loads(line)))
+                    except (ValueError, TypeError) as e:
+                        errors.append({"id": f"line{i}", "error": str(e)})
+                # stream results back as JSONL, completion order
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj):
+                    data = (json.dumps(obj, separators=(",", ":"))
+                            + "\n").encode()
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                    )
+
+                for e in errors:
+                    chunk({"ok": False, **e})
+                pending = {r.request_id: r for r in reqs}
+                while pending:
+                    done = [r for r in pending.values()
+                            if r._done.is_set()]
+                    if not done:
+                        time.sleep(0.02)
+                        continue
+                    for r in done:
+                        del pending[r.request_id]
+                        out = protocol.result_to_wire(r)
+                        out["ok"] = r.error is None
+                        out["ttlt_s"] = r.ttlt
+                        chunk(out)
+                self.wfile.write(b"0\r\n\r\n")
+
+        self._http = ThreadingHTTPServer(
+            ("127.0.0.1", self.http_port), Handler
+        )
+        self._http.daemon_threads = True
+        self.http_port = self._http.server_address[1]
+        t = threading.Thread(target=self._http.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def __enter__(self) -> "Gateway":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _repo_root() -> str:
+    """Spawned modules must import dalle_tpu: run children from the
+    package root (the gateway may itself be launched from anywhere)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )))

@@ -1,0 +1,141 @@
+"""Length-prefixed JSON framing for the gateway's control sockets.
+
+Every gateway-internal connection (gateway <-> worker, worker <-> cache
+host) speaks the same frame: a 4-byte big-endian length followed by one
+UTF-8 JSON object.  Length-prefixing (rather than newline-delimited
+JSON) makes torn writes detectable: a socket that dies mid-frame yields
+a short read, which surfaces as :class:`ConnectionError` — never a
+half-parsed message acted on as if complete.
+
+Numpy payloads (prefix-pool KV leaves, result codes in the cache host)
+ride inside the JSON as ``{"__nd__": <b64>, "dtype": ..., "shape": ...}``
+envelopes via :func:`encode_array`/:func:`decode_array` — raw bytes, no
+pickle, so a compromised peer can at worst corrupt an array, not execute
+code.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+# One frame must fit a prefix-pool block for a big model (tens of MB of
+# int8 KV rows); 256 MB is far above any legitimate frame and small
+# enough to fail fast on a corrupt length prefix.
+MAX_FRAME_BYTES = 256 << 20
+
+_LEN = struct.Struct(">I")
+
+
+def encode_array(a) -> dict:
+    """A numpy array as a JSON-safe base64 envelope (C-order bytes)."""
+    a = np.ascontiguousarray(a)
+    return {
+        "__nd__": base64.b64encode(a.tobytes()).decode("ascii"),
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+    }
+
+
+def decode_array(d: dict) -> np.ndarray:
+    """Inverse of :func:`encode_array` (bitwise: same bytes, same dtype)."""
+    raw = base64.b64decode(d["__nd__"])
+    return np.frombuffer(raw, dtype=np.dtype(d["dtype"])).reshape(
+        d["shape"]
+    ).copy()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes.  None on clean EOF at a frame boundary
+    (n asked, 0 read so far); ConnectionError on a torn frame."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError as e:
+            raise ConnectionError(f"socket read failed: {e}") from e
+        if not chunk:
+            if not buf:
+                return None
+            raise ConnectionError(
+                f"torn frame: EOF after {len(buf)}/{n} bytes"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(data) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame too large: {len(data)} bytes")
+    try:
+        sock.sendall(_LEN.pack(len(data)) + data)
+    except OSError as e:
+        raise ConnectionError(f"socket write failed: {e}") from e
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    """One frame; None on clean EOF (peer closed between frames)."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionError(f"frame length {n} exceeds cap")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise ConnectionError("torn frame: EOF before body")
+    try:
+        return json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ConnectionError(f"undecodable frame: {e}") from e
+
+
+class FramedSocket:
+    """A socket with framed send/recv and a write lock.
+
+    Sends can come from any thread (scheduler loop, detok worker, load
+    reporter all forward over ONE worker socket); frames must not
+    interleave, so every send serializes under the write lock.  Receives
+    are single-reader by construction (each side runs one reader
+    thread), so the read path is lock-free.
+    """
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock  # guarded-by: _wlock
+        self._wlock = threading.Lock()
+        self._closed = False  # guarded-by: _wlock
+
+    def send(self, obj: dict) -> None:
+        with self._wlock:
+            if self._closed:
+                raise ConnectionError("socket closed")
+            send_frame(self._sock, obj)
+
+    def recv(self) -> Optional[dict]:
+        return recv_frame(self._sock)
+
+    def close(self) -> None:
+        with self._wlock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    @property
+    def closed(self) -> bool:
+        with self._wlock:
+            return self._closed

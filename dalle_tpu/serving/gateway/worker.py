@@ -1,0 +1,311 @@
+"""One gateway worker: a whole serving process behind a control socket.
+
+``python -m dalle_tpu.serving.gateway.worker --spec <json>`` is spawned
+by the gateway.  The spec pins the accelerator BEFORE jax imports
+(``JAX_PLATFORMS`` + any extra env like device visibility), then the
+process builds its own model + :class:`DecodeEngine` + :class:`Scheduler`
+— the exact single-replica serve loop, with the queue fed from the
+control socket instead of stdin:
+
+* ``hello`` handshake up: replica id, pid, model fingerprint, slot
+  count, and the worker's *ephemeral* telemetry port (every worker binds
+  port 0 and reports what it got — fixed ports collide the moment two
+  workers share a host; the gateway's ``/metrics`` federates the
+  reported ports);
+* ``submit`` frames down (wire-codec requests), ``result`` frames up as
+  requests complete — forwarded from the scheduler's ``on_result`` seam,
+  with a sweeper thread catching terminal states that bypass detok
+  (shed/evicted/crash-budget failures release waiters directly);
+* ``load`` frames up every report interval: the
+  :meth:`Scheduler.load_report` snapshot the gateway deals placement on;
+* ``shutdown`` closes the local queue; the scheduler drains and the
+  process exits with a ``bye`` carrying final stats.
+
+Caches come from the spec's cache-host address as
+:class:`RemoteResultCache`/:class:`RemotePrefixPool` clients — every
+worker computes the same fingerprinted keys, so the shared maps are
+coherent by construction.
+
+A ``kill -9`` here is the designed failure: nothing is journaled,
+because nothing needs to be — codes are a pure function of
+(text, seed, sampling), so the gateway replays unacknowledged requests
+on surviving workers and gets bitwise-identical results.  The flight
+recorder's last dump (telemetry run dir assigned by the gateway) is the
+post-mortem artifact the gateway collects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+
+def build_model(model_spec: dict):
+    """(model, params) from a spec dict — deterministic per spec, so
+    every worker in a gateway fleet holds bitwise-identical params.
+
+    * ``{"kind": "quick", "seed": s, "config": {...}}`` — a smoke model
+      initialized from a fixed PRNG (bench rungs, chaos, tests);
+    * ``{"kind": "checkpoint", "dalle_path": p}`` — the shared eval-load
+      path (EMA-preferring, layout-flattened) generate.py uses.
+    """
+    kind = model_spec.get("kind", "quick")
+    if kind == "quick":
+        import jax
+
+        from dalle_tpu.models.dalle import DALLE, DALLEConfig
+
+        cfg_kw = dict(model_spec.get("config") or {})
+        if "attn_types" in cfg_kw:
+            cfg_kw["attn_types"] = tuple(cfg_kw["attn_types"])
+        cfg = DALLEConfig(**cfg_kw)
+        model = DALLE(cfg)
+        rng = jax.random.PRNGKey(int(model_spec.get("seed", 0)))
+        text = jax.random.randint(
+            rng, (1, cfg.text_seq_len), 1, cfg.num_text_tokens
+        )
+        codes = jax.random.randint(
+            rng, (1, cfg.image_seq_len), 0, cfg.num_image_tokens
+        )
+        params = model.init({"params": rng}, text, codes)["params"]
+        return model, params
+    if kind == "checkpoint":
+        from dalle_tpu.training.checkpoint import load_dalle_for_eval
+
+        model, params, _meta, _notes = load_dalle_for_eval(
+            model_spec["dalle_path"],
+            prefer_ema=bool(model_spec.get("prefer_ema", True)),
+        )
+        return model, params
+    raise ValueError(f"unknown model spec kind {model_spec.get('kind')!r}")
+
+
+class GatewayWorker:
+    """The in-process half: queue + scheduler + socket plumbing."""
+
+    def __init__(self, spec: dict, ctl):
+        from dalle_tpu.serving.queue import RequestQueue
+
+        self.spec = spec
+        self.ctl = ctl  # FramedSocket to the gateway
+        self.rid = int(spec["replica_id"])
+        self.queue = RequestQueue()
+        self.sched = None  # built in run() after the model exists
+        self._lock = threading.Lock()
+        # request_id -> local Request, removed once its result frame has
+        # been sent (the sweeper must forward each terminal state once)
+        self._open: dict = {}  # guarded-by: _lock
+
+    # --- result forwarding ----------------------------------------------
+    def _forward(self, req) -> None:
+        from dalle_tpu.serving import protocol
+
+        with self._lock:
+            if self._open.pop(req.request_id, None) is None:
+                return  # internal child (variations fan-out) or already sent
+        self.ctl.send({
+            "type": "result", "replica": self.rid,
+            "req": protocol.result_to_wire(req),
+        })
+
+    def _sweep_loop(self) -> None:
+        """Forward terminal requests that never pass ``on_result`` —
+        `_fail` paths (evicted, crash budget, drain-fail) release waiters
+        without touching the detok worker."""
+        while not self.ctl.closed:
+            with self._lock:
+                done = [r for r in self._open.values()
+                        if r._done.is_set()]
+            for r in done:
+                try:
+                    self._forward(r)
+                except ConnectionError:
+                    return
+            time.sleep(0.05)
+
+    # --- control-plane threads -------------------------------------------
+    def _reader_loop(self) -> None:
+        from dalle_tpu.serving import protocol
+
+        while True:
+            try:
+                msg = self.ctl.recv()
+            except ConnectionError:
+                msg = None
+            if msg is None:
+                # gateway gone: nothing to serve results to — drain out
+                self.queue.close()
+                return
+            kind = msg.get("type")
+            if kind == "submit":
+                try:
+                    req = protocol.request_from_wire(msg["req"])
+                except (ValueError, TypeError, KeyError) as e:
+                    self.ctl.send({
+                        "type": "result", "replica": self.rid,
+                        "req": {"request_id": str(
+                            (msg.get("req") or {}).get("request_id", "?")
+                        ), "dropped": True, "codes": None,
+                            "error": f"bad wire request: {e}"},
+                    })
+                    continue
+                with self._lock:
+                    self._open[req.request_id] = req
+                self.queue.submit(req)
+            elif kind == "shutdown":
+                self.queue.close()
+                return
+
+    def _load_loop(self, interval_s: float) -> None:
+        while not self.queue.closed or self.queue.pending():
+            try:
+                self.ctl.send({
+                    "type": "load", "replica": self.rid,
+                    **self.sched.load_report(),
+                })
+            except ConnectionError:
+                return
+            time.sleep(interval_s)
+
+    # --- main -------------------------------------------------------------
+    def run(self) -> dict:
+        from dalle_tpu import telemetry
+        from dalle_tpu.serving.cache import model_fingerprint
+        from dalle_tpu.serving.engine import DecodeEngine
+        from dalle_tpu.serving.gateway.cachehost import (
+            RemotePrefixPool,
+            RemoteResultCache,
+        )
+        from dalle_tpu.serving.scheduler import Scheduler
+
+        spec = self.spec
+        session = telemetry.configure(
+            run_dir=spec.get("telemetry_dir"),
+            metrics_interval_s=float(spec.get("metrics_interval_s", 2.0)),
+            http_port=0,  # ALWAYS ephemeral: fixed ports collide per-host
+        )
+        model, params = build_model(spec.get("model") or {})
+        cache_addr = spec.get("cache_addr")
+        result_cache = prefix_pool = None
+        if cache_addr is not None:
+            if spec.get("result_cache", True):
+                result_cache = RemoteResultCache(tuple(cache_addr))
+            if spec.get("prefix_pool", True):
+                prefix_pool = RemotePrefixPool(tuple(cache_addr))
+        engine = DecodeEngine(
+            model, params,
+            num_slots=int(spec.get("slots", 3)),
+            filter_thres=float(spec.get("filter_thres", 0.9)),
+            use_top_p=bool(spec.get("use_top_p", False)),
+            prefix_pool=prefix_pool,
+            replica_id=self.rid,
+        )
+        engine.warmup()
+        sched_kw = dict(spec.get("scheduler") or {})
+        self.sched = Scheduler(
+            engine, self.queue, policy="continuous",
+            on_result=self._forward, replica_id=self.rid,
+            result_cache=result_cache,
+            fingerprint=(model_fingerprint(model.cfg)
+                         if result_cache is not None else None),
+            **sched_kw,
+        )
+        self.ctl.send({
+            "type": "hello", "role": "worker", "replica": self.rid,
+            "token": spec["token"], "pid": os.getpid(),
+            "slots": engine.num_slots,
+            "telemetry_port": (session.server.port
+                               if session.server is not None else None),
+            "fingerprint": model_fingerprint(model.cfg),
+            "image_seq_len": engine.S,
+        })
+        # a ready-state flight dump: kill -9 flushes nothing, so write
+        # the post-mortem floor NOW — the gateway always has at least
+        # this dump to collect for an abruptly dead worker
+        fr = telemetry.flight_recorder()
+        if fr is not None:
+            fr.dump("worker_ready")
+        threading.Thread(target=self._reader_loop, daemon=True).start()
+        threading.Thread(target=self._sweep_loop, daemon=True).start()
+        threading.Thread(
+            target=self._load_loop,
+            args=(float(spec.get("load_report_interval_s", 0.2)),),
+            daemon=True,
+        ).start()
+        try:
+            stats = self.sched.run()
+        finally:
+            # every still-open request got failed by the scheduler's
+            # exit path — forward those terminal states before bye
+            with self._lock:
+                leftovers = list(self._open.values())
+            for r in leftovers:
+                if r._done.is_set():
+                    try:
+                        self._forward(r)
+                    except ConnectionError:
+                        break
+        try:
+            self.ctl.send({"type": "bye", "replica": self.rid,
+                           "stats": _json_safe(stats)})
+        except ConnectionError:
+            pass
+        telemetry.shutdown()
+        return stats
+
+
+def _json_safe(obj):
+    """Stats dicts hold numpy scalars; strip them for the wire."""
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if hasattr(obj, "item"):
+        return obj.item()
+    return obj
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--spec", required=True,
+                   help="path to the JSON worker spec")
+    args = p.parse_args(argv)
+    with open(args.spec) as f:
+        spec = json.load(f)
+
+    # accelerator pinning MUST precede any jax import: JAX_PLATFORMS
+    # picks the backend, extra env (e.g. TPU chip visibility or XLA
+    # flags) scopes this process to its slice of the host
+    os.environ.setdefault("JAX_PLATFORMS", spec.get("platform", "cpu"))
+    for k, v in (spec.get("env") or {}).items():
+        os.environ[k] = str(v)
+
+    from dalle_tpu.serving.gateway.wire import FramedSocket
+
+    host, port = spec["control_addr"]
+    sock = socket.create_connection((host, int(port)), timeout=30.0)
+    sock.settimeout(None)
+    worker = GatewayWorker(spec, FramedSocket(sock))
+    try:
+        worker.run()
+    except Exception as e:  # noqa: BLE001 — report, then die loudly
+        print(f"[gateway-worker {spec.get('replica_id')}] fatal: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        try:
+            worker.ctl.send({
+                "type": "fatal", "replica": int(spec["replica_id"]),
+                "error": f"{type(e).__name__}: {e}",
+            })
+        except ConnectionError:
+            pass
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,356 @@
+"""The serve request schema: parsing, flag validation, and wire codec.
+
+ONE schema, two front doors.  ``generate.py --serve`` (stdin/file JSONL)
+and the HTTP gateway (``POST /v1/generate``) both validate client lines
+through :func:`parse_serve_request` and serve-mode flags through
+:func:`validate_serve_flags` — hoisted here from generate.py so the two
+entry points cannot drift (generate.py keeps thin import shims).
+
+The second half is the explicit wire codec for :class:`Request`.
+In-process, a Request is shared by identity (``eq=False`` — numpy
+payloads break ``==``); across a process boundary it must be JSON.  The
+codec splits the dataclass into the two directions that actually cross
+the wire:
+
+* **submission** (:func:`request_to_wire` / :func:`request_from_wire`)
+  — the client-facing fields the gateway forwards to a worker process:
+  ``text_tokens`` (int list on the wire, int32 numpy in memory), seed,
+  sampling, ``request_id``, ``deadline_s``, ``variations``,
+  ``replica_hint``;
+* **completion** (:func:`result_to_wire` / :func:`apply_result_wire`)
+  — everything a worker stamps: codes (bitwise-exact — integer VQ codes
+  survive JSON), error/dropped, cache/timing/slot bookkeeping.
+
+Threading state (``_done``/``_vlock``) and the variations object graph
+(``parent``/``variants``) never cross the wire: each side owns fresh
+local instances, and :func:`apply_result_wire` releases the local
+``result()`` waiters via the request's own terminal transition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dalle_tpu.serving.queue import Request
+
+# Submission-direction fields, in Request field order.  Pinned by
+# tests/test_serving_protocol.py: adding a client-facing Request field
+# without teaching the codec is a test failure, not a silent drop.
+REQUEST_WIRE_FIELDS = (
+    "text_tokens", "seed", "temperature", "top_p", "request_id",
+    "deadline_s", "variations", "replica_hint",
+)
+
+# Completion-direction fields a worker reports back.  arrival_time is
+# deliberately absent: the submitting side owns its arrival clock
+# (time.monotonic is per-process; a worker's clock means nothing here).
+RESULT_WIRE_FIELDS = (
+    "request_id", "codes", "admit_time", "finish_time", "detok_time",
+    "clip_score", "dropped", "error", "retries", "service_tier",
+    "slot", "replica", "cache_hit", "cache_key",
+)
+
+
+def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
+                        default_temperature=1.0, default_top_p=None):
+    """One JSONL serve line (already json-decoded) -> a validated
+    ``Request``.  Raises ValueError/TypeError on malformed input — the
+    serve loop converts that into a structured error record instead of
+    letting one bad client line kill the stream (docs/SERVING.md §5)."""
+    if not isinstance(d, dict):
+        raise ValueError("request must be a JSON object")
+    text = d.get("text")
+    if not isinstance(text, str) or not text.strip():
+        raise ValueError("missing or empty 'text'")
+    temperature = float(d.get("temperature", default_temperature))
+    if not (temperature > 0):
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    # per-request top_p only in a top-p engine; otherwise the CLI's
+    # static sampling mode applies to everyone
+    top_p = (d.get("top_p", default_top_p)
+             if default_top_p is not None else None)
+    if top_p is not None:
+        top_p = float(top_p)
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    deadline_s = d.get("deadline_s")
+    if deadline_s is not None:
+        deadline_s = float(deadline_s)
+        if deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+    variations = int(d.get("variations", 1))
+    if not (1 <= variations <= 64):
+        raise ValueError(
+            f"variations must be in [1, 64], got {variations}"
+        )
+    replica_hint = d.get("replica_hint")
+    if replica_hint is not None:
+        replica_hint = int(replica_hint)
+        if replica_hint < 0:
+            raise ValueError(
+                f"replica_hint must be >= 0, got {replica_hint}"
+            )
+    tokens = tokenizer.tokenize(
+        text, text_seq_len, truncate_text=True
+    ).astype(np.int32)[0]
+    return Request(
+        text_tokens=tokens,
+        seed=int(d.get("seed", default_seed + i)),
+        temperature=temperature,
+        top_p=top_p,
+        deadline_s=deadline_s,
+        request_id=str(d.get("id", f"req{i}")),
+        variations=variations,
+        replica_hint=replica_hint,
+    )
+
+
+def validate_serve_flags(args) -> list:
+    """Serve-mode flag validation (beyond argparse choices).  Returns a
+    list of error strings; ``main`` mirrors each into
+    ``<outputs_dir>/serve/errors.jsonl`` before exiting non-zero, so an
+    operator scripting the server finds misconfigurations in the same
+    structured stream as malformed requests."""
+    errors = []
+    if args.max_queue is not None and args.max_queue < 1:
+        errors.append(
+            f"--max_queue must be >= 1, got {args.max_queue}"
+        )
+    if args.shed_policy != "reject" and args.max_queue is None:
+        errors.append(
+            f"--shed_policy {args.shed_policy} requires --max_queue "
+            "(an unbounded queue never sheds)"
+        )
+    if args.cache_bytes < 0:
+        errors.append(
+            f"--cache_bytes must be >= 0 (0 disables), got "
+            f"{args.cache_bytes}"
+        )
+    if args.prefix_pool_bytes < 0:
+        errors.append(
+            f"--prefix_pool_bytes must be >= 0 (0 disables), got "
+            f"{args.prefix_pool_bytes}"
+        )
+    if args.replicas < 1:
+        errors.append(f"--replicas must be >= 1, got {args.replicas}")
+    gw = getattr(args, "gateway_workers", 0) or 0
+    if gw < 0:
+        errors.append(f"--gateway_workers must be >= 0, got {gw}")
+    if gw:
+        # the gateway IS the multi-replica story at the process level:
+        # composing it with the in-process fleet or a decode mesh would
+        # nest two placement layers (docs/SERVING.md §12)
+        if args.replicas > 1:
+            errors.append(
+                f"--gateway_workers {gw} replaces --replicas "
+                f"{args.replicas} (process-level fleet; drop --replicas)"
+            )
+        if (args.mesh_tp or 1) != 1 or (args.mesh_sp or 1) != 1:
+            errors.append(
+                f"--gateway_workers {gw} does not yet compose with "
+                "--mesh_tp/--mesh_sp (single-device worker processes)"
+            )
+        if args.serve_policy != "continuous":
+            errors.append(
+                f"--gateway_workers {gw} requires --serve_policy "
+                f"continuous, got {args.serve_policy}"
+            )
+    tp = args.mesh_tp or 1
+    sp = args.mesh_sp or 1
+    if args.replicas > 1:
+        if args.serve_policy != "continuous":
+            errors.append(
+                f"--replicas {args.replicas} requires --serve_policy "
+                f"continuous (got {args.serve_policy}; sequential/"
+                "full_batch are single-engine batching experiments)"
+            )
+        # scale-out x scale-up composition (docs/SERVING.md §9-10): each
+        # replica is a (tp x sp)-group of devices, partitioned
+        # replica-major — replica r owns devices [r*tp*sp, (r+1)*tp*sp).
+        # Only the decode mesh axes compose; the training-only axes have
+        # no per-replica meaning.
+        bad_axes = [
+            ax for ax in ("dp", "fsdp", "pp", "ep")
+            if (getattr(args, f"mesh_{ax}") or 1) != 1
+        ]
+        if bad_axes:
+            errors.append(
+                f"--replicas composes only with --mesh_tp/--mesh_sp "
+                f"(replica-major decode groups, docs/SERVING.md §9-10) — "
+                "drop " + ", ".join(f"--mesh_{ax}" for ax in bad_axes)
+            )
+    if tp * sp > 1 or args.replicas > 1:
+        import jax as _jax
+
+        have = len(_jax.devices())
+        if args.replicas * tp * sp > have:
+            errors.append(
+                f"--replicas {args.replicas} x --mesh_tp {tp} x "
+                f"--mesh_sp {sp} needs {args.replicas * tp * sp} "
+                f"devices, have {have}"
+            )
+    if sp > 1:
+        # seq divisibility needs the checkpoint geometry — peek at
+        # meta.json only (cheap; params untouched), and let a missing /
+        # torch-format checkpoint fall through to its own load-time error
+        seq = None
+        hp = {}
+        try:
+            from dalle_tpu.training.checkpoint import load_meta
+
+            hp = load_meta(args.dalle_path).get("hparams") or {}
+            seq = int(hp["text_seq_len"]) + int(hp["image_fmap_size"]) ** 2
+        except Exception:
+            hp = {}
+        if seq is not None and seq % sp:
+            errors.append(
+                f"--mesh_sp {sp} must divide the decode cache seq length "
+                f"{seq} (text_seq_len + image_fmap_size**2 of the "
+                "checkpoint; docs/SERVING.md §10)"
+            )
+        # structured attention types shard by whole grid lines: the
+        # row-slice / column / window locality that makes their
+        # sequence-parallel paths (and structured decode's index maps)
+        # line up needs f % sp == 0
+        structured = sorted({
+            t for t in (hp.get("attn_types") or ())
+            if t in ("axial_row", "axial_col", "conv_like", "sparse")
+        })
+        try:
+            f_sz = int(hp["image_fmap_size"])
+        except Exception:
+            f_sz = None
+        if structured and f_sz is not None and f_sz % sp:
+            errors.append(
+                f"--mesh_sp {sp} must divide the image grid "
+                f"(image_fmap_size {f_sz}) for this checkpoint's "
+                f"structured attention types ({', '.join(structured)}) — "
+                "their row-slice locality shards by whole grid lines "
+                "(docs/SERVING.md §10)"
+            )
+    if args.decode_comm != "f32" and tp < 2:
+        errors.append(
+            f"--decode_comm {args.decode_comm} requires --mesh_tp >= 2 "
+            "(the quantized decode collectives ride the tp all-reduce; "
+            "docs/SERVING.md §9)"
+        )
+    return errors
+
+
+# --- wire codec -------------------------------------------------------------
+
+
+def request_to_wire(req: Request) -> dict:
+    """Submission fields of ``req`` as a JSON-safe dict."""
+    return {
+        "text_tokens": np.asarray(req.text_tokens).astype(int).tolist(),
+        "seed": int(req.seed),
+        "temperature": float(req.temperature),
+        "top_p": None if req.top_p is None else float(req.top_p),
+        "request_id": str(req.request_id),
+        "deadline_s": (None if req.deadline_s is None
+                       else float(req.deadline_s)),
+        "variations": int(req.variations),
+        "replica_hint": (None if req.replica_hint is None
+                         else int(req.replica_hint)),
+    }
+
+
+def request_from_wire(d: dict) -> Request:
+    """A fresh :class:`Request` from a submission-direction wire dict.
+
+    Validates shape/ranges the same way :func:`parse_serve_request` does
+    for text lines — the gateway accepts pre-tokenized requests through
+    this path, and a malformed token list must fail loudly here, not as
+    an engine shape error three hops later."""
+    if not isinstance(d, dict):
+        raise ValueError("wire request must be a JSON object")
+    toks = d.get("text_tokens")
+    if (not isinstance(toks, (list, tuple)) or not toks
+            or not all(isinstance(t, int) and t >= 0 for t in toks)):
+        raise ValueError(
+            "text_tokens must be a non-empty list of non-negative ints"
+        )
+    temperature = float(d.get("temperature", 1.0))
+    if not (temperature > 0):
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    top_p = d.get("top_p")
+    if top_p is not None:
+        top_p = float(top_p)
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    deadline_s = d.get("deadline_s")
+    if deadline_s is not None:
+        deadline_s = float(deadline_s)
+        if deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+    variations = int(d.get("variations", 1))
+    if not (1 <= variations <= 64):
+        raise ValueError(f"variations must be in [1, 64], got {variations}")
+    replica_hint = d.get("replica_hint")
+    if replica_hint is not None:
+        replica_hint = int(replica_hint)
+        if replica_hint < 0:
+            raise ValueError(f"replica_hint must be >= 0, got {replica_hint}")
+    return Request(
+        text_tokens=np.asarray(toks, dtype=np.int32),
+        seed=int(d.get("seed", 0)),
+        temperature=temperature,
+        top_p=top_p,
+        request_id=str(d.get("request_id") or d.get("id") or ""),
+        deadline_s=deadline_s,
+        variations=variations,
+        replica_hint=replica_hint,
+    )
+
+
+def result_to_wire(req: Request) -> dict:
+    """Completion fields of ``req`` as a JSON-safe dict (codes become a
+    nested int list — integer VQ codes roundtrip JSON bitwise)."""
+    return {
+        "request_id": str(req.request_id),
+        "codes": (None if req.codes is None
+                  else np.asarray(req.codes).astype(int).tolist()),
+        "admit_time": req.admit_time,
+        "finish_time": req.finish_time,
+        "detok_time": req.detok_time,
+        "clip_score": (None if req.clip_score is None
+                       else float(req.clip_score)),
+        "dropped": bool(req.dropped),
+        "error": req.error,
+        "retries": int(req.retries),
+        "service_tier": int(req.service_tier),
+        "slot": req.slot,
+        "replica": req.replica,
+        "cache_hit": bool(req.cache_hit),
+        "cache_key": req.cache_key,
+    }
+
+
+def apply_result_wire(req: Request, d: dict, *,
+                      finish_time=None) -> Request:
+    """Stamp a completion-direction wire dict onto the local ``req`` and
+    release its ``result()`` waiters.
+
+    ``arrival_time`` is never touched (the local side owns its clock);
+    ``finish_time`` defaults to the worker-reported value but callers on
+    a different monotonic clock pass their own (the gateway maps the
+    worker-measured duration onto its local arrival)."""
+    codes = d.get("codes")
+    req.codes = None if codes is None else np.asarray(codes, dtype=np.int32)
+    req.admit_time = d.get("admit_time")
+    req.finish_time = (d.get("finish_time") if finish_time is None
+                       else finish_time)
+    req.detok_time = d.get("detok_time")
+    req.clip_score = d.get("clip_score")
+    req.dropped = bool(d.get("dropped", False))
+    if d.get("error") is not None and req.error is None:
+        req.error = str(d["error"])
+    req.retries = int(d.get("retries", req.retries))
+    req.service_tier = int(d.get("service_tier", 0))
+    req.slot = d.get("slot")
+    req.replica = d.get("replica")
+    req.cache_hit = bool(d.get("cache_hit", False))
+    req.cache_key = d.get("cache_key")
+    req._mark_done()
+    return req

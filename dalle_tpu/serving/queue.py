@@ -101,6 +101,22 @@ class Request:
             return math.inf
         return self.arrival_time + self.deadline_s
 
+    def to_wire(self) -> dict:
+        """Submission fields as a JSON-safe dict (the explicit wire
+        codec in :mod:`dalle_tpu.serving.protocol` — threading state and
+        numpy payloads never cross a process boundary by identity)."""
+        from dalle_tpu.serving.protocol import request_to_wire
+
+        return request_to_wire(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Request":
+        """Inverse of :meth:`to_wire`: a fresh request with its own
+        threading state (``result()`` waiters are local to each side)."""
+        from dalle_tpu.serving.protocol import request_from_wire
+
+        return request_from_wire(d)
+
     def result(self, timeout: Optional[float] = None,
                raise_on_error: bool = False) -> "Request":
         """Block until the request is fully processed (or dropped).

@@ -799,6 +799,24 @@ class Scheduler:
             self._fail_unfinished()
             exposition.unregister_provider(provider)
 
+    def load_report(self) -> dict:
+        """The process-level load snapshot the gateway's admission layer
+        deals on (busy decode ticks, free slots, tick EWMA, backlog) —
+        the same quantities a fleet ``ReplicaView`` reports per-poll,
+        shipped periodically over a worker's control socket instead.
+        Cheap lock-free reads: runs on the worker's load-reporter thread,
+        racing the serve loop."""
+        eng = self.engine
+        busy = sum(
+            eng.remaining_ticks(b) or 0 for b in range(eng.num_slots)
+        )
+        return {
+            "busy_ticks": busy,
+            "free_slots": len(eng.free_slots()),
+            "tick_s": self._tick_ewma,
+            "pending": self.queue.pending(),
+        }
+
     # --- live introspection ----------------------------------------------
     def status_snapshot(self) -> dict:
         """The /statusz row for this scheduler: cheap reads only — this

@@ -131,24 +131,62 @@ def render_prometheus(snap: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+_LABELSET = r'\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}'
+_SAMPLE_RE = re.compile(
+    r'([a-zA-Z_:][a-zA-Z0-9_:]*(?:' + _LABELSET + r')?)\s+(\S+)\Z'
+)
+
+
 def parse_prometheus(text: str) -> dict:
     """Minimal exposition parser (the scrape tests' oracle): returns
-    ``{metric_or_series: float}`` with bucket series keyed as
-    ``name_bucket{le="..."}``.  Raises ``ValueError`` on any line that
-    is neither a comment nor a well-formed sample."""
+    ``{metric_or_series: float}`` with labeled series keyed verbatim,
+    e.g. ``name_bucket{le="..."}`` or the gateway's federated
+    ``name{replica="0"}``.  Raises ``ValueError`` on any line that
+    is neither a comment nor a well-formed sample — a torn scrape must
+    fail parsing, never half-load."""
     out: Dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        m = re.match(
-            r'([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{le="[^"]*"\})?)\s+(\S+)\Z',
-            line,
-        )
+        m = _SAMPLE_RE.match(line)
         if not m:
             raise ValueError(f"unparseable exposition line: {line!r}")
         out[m.group(1)] = float(m.group(2))
     return out
+
+
+def label_series(series_key: str, label: str, value) -> str:
+    """Inject ``label="value"`` into a parsed series key (prepended so
+    an existing ``le`` label keeps its position): ``decode_ticks`` →
+    ``decode_ticks{replica="0"}``; ``ttlt_bucket{le="1.0"}`` →
+    ``ttlt_bucket{replica="0",le="1.0"}``."""
+    pair = f'{label}="{value}"'
+    if "{" in series_key:
+        head, rest = series_key.split("{", 1)
+        return f"{head}{{{pair},{rest}"
+    return f"{series_key}{{{pair}}}"
+
+
+def federate_prometheus(scrapes: Dict[str, Dict[str, float]]) -> str:
+    """One fleet-wide exposition page from per-replica parsed scrapes.
+
+    ``scrapes`` maps a replica label value to a dict from
+    :func:`parse_prometheus` — the gateway parses each worker scrape
+    through that strict oracle FIRST, so a torn or garbage worker page
+    is rejected whole (the gateway substitutes the worker's last good
+    scrape) and can never poison the federated page.  Per-replica series
+    keep per-replica monotonicity: counters are never summed across
+    workers, because a dead worker's disappearing contribution would
+    read as a counter reset fleet-wide."""
+    lines: List[str] = []
+    for rep in sorted(scrapes):
+        series = scrapes[rep]
+        for key in sorted(series):
+            lines.append(
+                f"{label_series(key, 'replica', rep)} {_fmt(series[key])}"
+            )
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 # --- the server itself ------------------------------------------------------

@@ -70,7 +70,14 @@ EVENT_KINDS: Dict[str, str] = {
     "fleet_rebalance": "router steered admission away from a loaded "
                        "replica (least-loaded placement)",
     "fleet_summary": "final Fleet.stats() emitted at fleet shutdown",
-    # --- telemetry / profiling (dalle_tpu/telemetry/) --------------------
+    # --- serving gateway (dalle_tpu/serving/gateway/) --------------------
+    "gateway_worker_up": "replica worker process sent hello and finished "
+                         "warmup (ready for dispatch)",
+    "gateway_worker_dead": "worker control socket died; in-flight ledger "
+                           "replayed on survivors",
+    "gateway_worker_fatal": "worker reported an unrecoverable fault and "
+                            "is retiring",
+    "gateway_shed": "gateway refused a submit at max_in_flight capacity",
     "telemetry_enabled": "telemetry session configured (run dir, "
                          "snapshot interval)",
     "xla_profile_start": "jax.profiler trace capture window opened",
@@ -128,6 +135,18 @@ METRIC_NAMES: Dict[str, str] = {
     # --- serving fleet (dalle_tpu/serving/fleet/) ------------------------
     "fleet_replica_crashes": "counter: replica deaths (fault or kill)",
     "fleet_drained_requests": "counter: requests drained onto survivors",
+    # --- serving gateway (dalle_tpu/serving/gateway/) --------------------
+    "gateway_submitted": "counter: requests accepted by the gateway",
+    "gateway_completed": "counter: requests finished with codes",
+    "gateway_failed": "counter: requests failed (validation/replay "
+                      "exhausted/no workers)",
+    "gateway_shed": "counter: requests refused at max_in_flight",
+    "gateway_replayed": "counter: in-flight requests replayed after a "
+                        "worker death",
+    "gateway_worker_deaths": "counter: worker control sockets lost",
+    "gateway_scrape_errors": "counter: worker /metrics scrapes that "
+                             "failed strict parse",
+    "gateway_workers_alive": "gauge: live replica worker processes",
     # --- SLO engine (dalle_tpu/telemetry/slo.py) -------------------------
     "slo_deadline_total": "counter: deadlined requests accounted",
     "slo_deadline_missed": "counter: deadlined requests that missed",

@@ -30,170 +30,14 @@ from dalle_tpu.training.checkpoint import is_checkpoint
 from dalle_tpu.tokenizers import get_tokenizer
 
 
-def parse_serve_request(d, i, *, tokenizer, text_seq_len, default_seed=0,
-                        default_temperature=1.0, default_top_p=None):
-    """One JSONL serve line (already json-decoded) -> a validated
-    ``Request``.  Raises ValueError/TypeError on malformed input — the
-    serve loop converts that into a structured error record instead of
-    letting one bad client line kill the stream (docs/SERVING.md §5)."""
-    from dalle_tpu.serving import Request
-
-    if not isinstance(d, dict):
-        raise ValueError("request must be a JSON object")
-    text = d.get("text")
-    if not isinstance(text, str) or not text.strip():
-        raise ValueError("missing or empty 'text'")
-    temperature = float(d.get("temperature", default_temperature))
-    if not (temperature > 0):
-        raise ValueError(f"temperature must be > 0, got {temperature}")
-    # per-request top_p only in a top-p engine; otherwise the CLI's
-    # static sampling mode applies to everyone
-    top_p = (d.get("top_p", default_top_p)
-             if default_top_p is not None else None)
-    if top_p is not None:
-        top_p = float(top_p)
-        if not (0.0 < top_p <= 1.0):
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    deadline_s = d.get("deadline_s")
-    if deadline_s is not None:
-        deadline_s = float(deadline_s)
-        if deadline_s < 0:
-            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
-    variations = int(d.get("variations", 1))
-    if not (1 <= variations <= 64):
-        raise ValueError(
-            f"variations must be in [1, 64], got {variations}"
-        )
-    replica_hint = d.get("replica_hint")
-    if replica_hint is not None:
-        replica_hint = int(replica_hint)
-        if replica_hint < 0:
-            raise ValueError(
-                f"replica_hint must be >= 0, got {replica_hint}"
-            )
-    tokens = tokenizer.tokenize(
-        text, text_seq_len, truncate_text=True
-    ).astype(np.int32)[0]
-    return Request(
-        text_tokens=tokens,
-        seed=int(d.get("seed", default_seed + i)),
-        temperature=temperature,
-        top_p=top_p,
-        deadline_s=deadline_s,
-        request_id=str(d.get("id", f"req{i}")),
-        variations=variations,
-        replica_hint=replica_hint,
-    )
-
-
-def validate_serve_flags(args) -> list:
-    """Serve-mode flag validation (beyond argparse choices).  Returns a
-    list of error strings; ``main`` mirrors each into
-    ``<outputs_dir>/serve/errors.jsonl`` before exiting non-zero, so an
-    operator scripting the server finds misconfigurations in the same
-    structured stream as malformed requests."""
-    errors = []
-    if args.max_queue is not None and args.max_queue < 1:
-        errors.append(
-            f"--max_queue must be >= 1, got {args.max_queue}"
-        )
-    if args.shed_policy != "reject" and args.max_queue is None:
-        errors.append(
-            f"--shed_policy {args.shed_policy} requires --max_queue "
-            "(an unbounded queue never sheds)"
-        )
-    if args.cache_bytes < 0:
-        errors.append(
-            f"--cache_bytes must be >= 0 (0 disables), got "
-            f"{args.cache_bytes}"
-        )
-    if args.prefix_pool_bytes < 0:
-        errors.append(
-            f"--prefix_pool_bytes must be >= 0 (0 disables), got "
-            f"{args.prefix_pool_bytes}"
-        )
-    if args.replicas < 1:
-        errors.append(f"--replicas must be >= 1, got {args.replicas}")
-    tp = args.mesh_tp or 1
-    sp = args.mesh_sp or 1
-    if args.replicas > 1:
-        if args.serve_policy != "continuous":
-            errors.append(
-                f"--replicas {args.replicas} requires --serve_policy "
-                f"continuous (got {args.serve_policy}; sequential/"
-                "full_batch are single-engine batching experiments)"
-            )
-        # scale-out x scale-up composition (docs/SERVING.md §9-10): each
-        # replica is a (tp x sp)-group of devices, partitioned
-        # replica-major — replica r owns devices [r*tp*sp, (r+1)*tp*sp).
-        # Only the decode mesh axes compose; the training-only axes have
-        # no per-replica meaning.
-        bad_axes = [
-            ax for ax in ("dp", "fsdp", "pp", "ep")
-            if (getattr(args, f"mesh_{ax}") or 1) != 1
-        ]
-        if bad_axes:
-            errors.append(
-                f"--replicas composes only with --mesh_tp/--mesh_sp "
-                f"(replica-major decode groups, docs/SERVING.md §9-10) — "
-                "drop " + ", ".join(f"--mesh_{ax}" for ax in bad_axes)
-            )
-    if tp * sp > 1 or args.replicas > 1:
-        import jax as _jax
-
-        have = len(_jax.devices())
-        if args.replicas * tp * sp > have:
-            errors.append(
-                f"--replicas {args.replicas} x --mesh_tp {tp} x "
-                f"--mesh_sp {sp} needs {args.replicas * tp * sp} "
-                f"devices, have {have}"
-            )
-    if sp > 1:
-        # seq divisibility needs the checkpoint geometry — peek at
-        # meta.json only (cheap; params untouched), and let a missing /
-        # torch-format checkpoint fall through to its own load-time error
-        seq = None
-        hp = {}
-        try:
-            from dalle_tpu.training.checkpoint import load_meta
-
-            hp = load_meta(args.dalle_path).get("hparams") or {}
-            seq = int(hp["text_seq_len"]) + int(hp["image_fmap_size"]) ** 2
-        except Exception:
-            hp = {}
-        if seq is not None and seq % sp:
-            errors.append(
-                f"--mesh_sp {sp} must divide the decode cache seq length "
-                f"{seq} (text_seq_len + image_fmap_size**2 of the "
-                "checkpoint; docs/SERVING.md §10)"
-            )
-        # structured attention types shard by whole grid lines: the
-        # row-slice / column / window locality that makes their
-        # sequence-parallel paths (and structured decode's index maps)
-        # line up needs f % sp == 0
-        structured = sorted({
-            t for t in (hp.get("attn_types") or ())
-            if t in ("axial_row", "axial_col", "conv_like", "sparse")
-        })
-        try:
-            f_sz = int(hp["image_fmap_size"])
-        except Exception:
-            f_sz = None
-        if structured and f_sz is not None and f_sz % sp:
-            errors.append(
-                f"--mesh_sp {sp} must divide the image grid "
-                f"(image_fmap_size {f_sz}) for this checkpoint's "
-                f"structured attention types ({', '.join(structured)}) — "
-                "their row-slice locality shards by whole grid lines "
-                "(docs/SERVING.md §10)"
-            )
-    if args.decode_comm != "f32" and tp < 2:
-        errors.append(
-            f"--decode_comm {args.decode_comm} requires --mesh_tp >= 2 "
-            "(the quantized decode collectives ride the tp all-reduce; "
-            "docs/SERVING.md §9)"
-        )
-    return errors
+# Serve request parsing + flag validation live in the shared schema
+# module (dalle_tpu/serving/protocol.py) — the HTTP gateway and this CLI
+# validate through ONE schema.  Re-exported so `from generate import
+# parse_serve_request` keeps working for tests and operator scripts.
+from dalle_tpu.serving.protocol import (  # noqa: F401,E402
+    parse_serve_request,
+    validate_serve_flags,
+)
 
 
 def parse_args(argv=None):
@@ -223,6 +67,21 @@ def parse_args(argv=None):
                              "replica-major, replica r owning the "
                              "contiguous tp-group [r*T, (r+1)*T); other "
                              "--mesh_* axes do not compose")
+    parser.add_argument("--gateway_workers", type=int, default=0,
+                        help="N > 0: serve through the multi-PROCESS "
+                             "gateway instead of in-process — N worker "
+                             "processes (each its own interpreter, jax "
+                             "backend, engine + scheduler) behind an "
+                             "HTTP front door with federated /metrics "
+                             "and bitwise crash drain across kill -9 "
+                             "(docs/SERVING.md §12).  Codes-only: "
+                             "workers do not detokenize; results stream "
+                             "back as JSONL.  Excludes --replicas and "
+                             "--mesh_* (scale-out across processes, not "
+                             "within one)")
+    parser.add_argument("--gateway_port", type=int, default=0,
+                        help="front-door HTTP port for --gateway_workers "
+                             "(0 = ephemeral, printed at startup)")
     parser.add_argument("--serve_policy", type=str, default="continuous",
                         choices=("continuous", "full_batch", "sequential"),
                         help="admission policy (sequential/full_batch exist "
@@ -659,6 +518,9 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
     import sys
     import threading
 
+    if getattr(args, "gateway_workers", 0):
+        return _gateway_serve_loop(args, tokenizer, cfg)
+
     from dalle_tpu.parallel.mesh import mesh_kwargs_from_args
     from dalle_tpu.serving import DecodeEngine, Request, RequestQueue, Scheduler
 
@@ -876,6 +738,78 @@ def _serve_loop(args, tokenizer, model, params, vae, vae_params, cfg,
             print(f"telemetry: {outdir / 'telemetry'} "
                   f"(trace: {trace_path})")
         stack.close()
+
+
+def _gateway_serve_loop(args, tokenizer, cfg):
+    """--serve --gateway_workers N: the multi-process fleet
+    (docs/SERVING.md §12).  Each worker process loads the checkpoint
+    itself (same eval-load path, so all replicas hold bitwise-identical
+    params) and the front door serves HTTP + the JSONL stream.  Workers
+    emit codes, not images — detok stays out of the crash-drain path;
+    results land in ``<outputs_dir>/serve/results.jsonl``."""
+    import json
+    import sys
+
+    from dalle_tpu.serving.gateway import Gateway
+
+    outdir = Path(args.outputs_dir) / "serve"
+    outdir.mkdir(parents=True, exist_ok=True)
+    gw = Gateway(
+        {"kind": "checkpoint", "dalle_path": args.dalle_path},
+        num_workers=args.gateway_workers,
+        slots=args.serve_slots,
+        use_top_p=args.top_p is not None,
+        filter_thres=args.top_k,
+        cache_result_bytes=args.cache_bytes,
+        cache_prefix_bytes=args.prefix_pool_bytes,
+        run_dir=str(outdir / "gateway"),
+        http_port=args.gateway_port,
+        tokenizer=tokenizer,
+        text_seq_len=cfg.text_seq_len,
+    ).start()
+    print(f"gateway: {args.gateway_workers} worker processes x "
+          f"{args.serve_slots} slots, front door "
+          f"http://127.0.0.1:{gw.http_port} "
+          f"(/v1/generate /metrics /healthz /statusz), "
+          f"run dir {gw.run_dir}")
+    results_path = outdir / "results.jsonl"
+    try:
+        stream = sys.stdin if args.serve == "-" else open(args.serve)
+        reqs = []
+        try:
+            for i, line in enumerate(stream):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    # text dicts keep the serve-schema "id" field
+                    # (parse_serve_request reads it; id-less requests
+                    # get a gateway-unique default, shared with the
+                    # HTTP front door so the two paths never collide)
+                    reqs.append(gw.submit(json.loads(line)))
+                except (TypeError, ValueError) as e:
+                    print(f"[line{i}] rejected: {e}")
+        finally:
+            if stream is not sys.stdin:
+                stream.close()
+        with open(results_path, "w") as f:
+            for r in reqs:
+                r.result()
+                out = {"id": r.request_id, "ok": r.error is None,
+                       "replica": r.replica, "retries": r.retries,
+                       "cache_hit": bool(r.cache_hit),
+                       "error": r.error,
+                       "codes": (None if r.codes is None
+                                 else np.asarray(r.codes).tolist())}
+                f.write(json.dumps(out) + "\n")
+                state = ("done" if r.error is None else f"failed: {r.error}")
+                print(f"[{r.request_id}] {state} "
+                      f"(replica {r.replica}, ttlt="
+                      f"{r.ttlt if r.ttlt is None else round(r.ttlt, 3)}s)")
+        print(json.dumps(gw.statusz()["counters"]))
+        print(f"results: {results_path}")
+    finally:
+        gw.close()
 
 
 def _generate_loop(args, tokenizer, model, params, vae, vae_params, cfg,

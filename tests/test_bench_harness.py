@@ -74,6 +74,9 @@ def test_diagnostic_repo_bug_exit_code(monkeypatch, tmp_path, capsys):
     assert json.loads(capsys.readouterr().out.strip())["device_state"] == "healthy"
 
 
+# slow tier: test_checkpoint.py keeps two tier-1 analytic-vs-XLA-cost
+# pins (clip_flops_close_to_xla, xla_cost_analysis_close_to_analytic)
+@pytest.mark.slow
 def test_analytic_flops_matches_xla_cost_model(rng):
     """MFU honesty guard: the analytic FLOP count bench.py divides by must
     track XLA's own cost model (within 15%) and never exceed it by much —

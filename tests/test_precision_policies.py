@@ -106,11 +106,11 @@ def baselines(vae_and_params, mesh1):
 @pytest.mark.parametrize(
     "name",
     [
-        # the two heaviest multi-step trajectory cases run in the slow tier
+        # the heaviest multi-step trajectory cases run in the slow tier
         pytest.param(
             n,
             marks=[pytest.mark.slow]
-            if n in ("remat_nothing", "scan_remat_ff_only")
+            if n in ("remat_nothing", "scan_remat_ff_only", "remat_dots")
             else [],
         )
         for n in POLICY_CASES

@@ -122,7 +122,16 @@ def test_decode_step_vector_pos_matches_scalar(rng, layout):
         fed = jnp.argmax(log_s, axis=-1).astype(jnp.int32)
 
 
-@pytest.mark.parametrize("layout", ["full", "shift_rot", "kv_int8"])
+@pytest.mark.parametrize(
+    "layout",
+    [
+        # the full-attn arm is ~2x the others on 1 CPU core; shift_rot
+        # and kv_int8 keep tier-1 coverage of staggered-lane admission
+        pytest.param("full", marks=pytest.mark.slow),
+        "shift_rot",
+        "kv_int8",
+    ],
+)
 def test_decode_step_staggered_lanes_match_solo(rng, layout):
     """Lanes decoding at DIFFERENT positions in one vector step produce
     exactly the logits each would produce solo — per-lane cache rows,

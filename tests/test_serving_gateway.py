@@ -1,0 +1,404 @@
+"""Gateway fast units (no subprocesses, no engine): wire framing,
+admission dealing, load-report folding, dead-socket crash drain, and the
+federated-metrics oracle (docs/SERVING.md §12).
+
+The process-level behaviors these feed — a real kill -9 against real
+worker processes — live in the slow tier (test_serving_gateway_e2e.py)
+and the ``serving_gateway`` bench rung; these units pin the host-side
+logic those runs depend on, at tier-1 speed.
+"""
+
+import os
+import socket
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dalle_tpu.serving.gateway.admission import AdmissionPolicy
+from dalle_tpu.serving.gateway.gateway import Gateway, WorkerHandle
+from dalle_tpu.serving.gateway.wire import (
+    FramedSocket,
+    decode_array,
+    encode_array,
+    recv_frame,
+    send_frame,
+)
+from dalle_tpu.telemetry.exposition import (
+    federate_prometheus,
+    label_series,
+    parse_prometheus,
+)
+
+
+# --- wire framing ------------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_frame_roundtrip():
+    a, b = _pair()
+    try:
+        send_frame(a, {"type": "hello", "n": 3, "xs": [1, 2, 3]})
+        assert recv_frame(b) == {"type": "hello", "n": 3, "xs": [1, 2, 3]}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_clean_eof_is_none():
+    a, b = _pair()
+    a.close()
+    try:
+        assert recv_frame(b) is None
+    finally:
+        b.close()
+
+
+def test_torn_frame_raises():
+    a, b = _pair()
+    try:
+        # a length prefix promising 100 bytes, then death mid-body
+        import struct
+
+        a.sendall(struct.pack(">I", 100) + b"only-ten-b")
+        a.close()
+        with pytest.raises(ConnectionError, match="torn"):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_oversized_frame_rejected():
+    a, b = _pair()
+    try:
+        import struct
+
+        a.sendall(struct.pack(">I", (1 << 31)))
+        with pytest.raises(ConnectionError, match="exceeds"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.parametrize("dtype", ["int32", "uint8", "float32", "bool"])
+def test_array_envelope_bitwise(dtype):
+    rng = np.random.RandomState(3)
+    a = (rng.rand(4, 7) * 100).astype(dtype)
+    back = decode_array(encode_array(a))
+    assert back.dtype == a.dtype and back.shape == a.shape
+    np.testing.assert_array_equal(back, a)
+    # decode must yield an owned, writable array (cache entries mutate
+    # LRU state around it; a frombuffer view would be read-only)
+    back[0, 0] = back[0, 0]
+
+
+def test_framed_socket_concurrent_sends_do_not_interleave():
+    a, b = _pair()
+    fs = FramedSocket(a)
+    n_threads, per = 8, 25
+    threads = [
+        threading.Thread(
+            target=lambda t=t: [
+                fs.send({"t": t, "i": i, "pad": "x" * 512})
+                for i in range(per)
+            ],
+            daemon=True,
+        )
+        for t in range(n_threads)
+    ]
+    got = []
+
+    def reader():
+        while len(got) < n_threads * per:
+            got.append(recv_frame(b))
+
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rt.join(timeout=10)
+    assert len(got) == n_threads * per
+    assert all(g["pad"] == "x" * 512 for g in got)
+    fs.close()
+    b.close()
+
+
+# --- admission ---------------------------------------------------------
+
+
+def mk_policy(workers=3, slots=3, S=16):
+    p = AdmissionPolicy(ticks_per_request=S)
+    for r in range(workers):
+        p.register(r, slots)
+    return p
+
+
+def test_pick_round_robins_idle_workers():
+    p = mk_policy()
+    assert [p.pick() for _ in range(6)] == [0, 1, 2, 0, 1, 2]
+
+
+def test_pick_avoids_busy_worker():
+    p = mk_policy()
+    # worker 0 reports a deep backlog; 1 and 2 are idle
+    p.report(0, busy_ticks=1000, free_slots=0, tick_s=1e-3, pending=5)
+    p.report(1, busy_ticks=0, free_slots=3, tick_s=1e-3, pending=0)
+    p.report(2, busy_ticks=0, free_slots=3, tick_s=1e-3, pending=0)
+    picks = [p.pick() for _ in range(4)]
+    assert 0 not in picks
+
+
+def test_report_ewma_first_seeds_then_smooths():
+    p = mk_policy(workers=1)
+    p.report(0, busy_ticks=100, free_slots=3, tick_s=1e-3, pending=0)
+    snap = p.load_snapshot()["0"]
+    assert snap["busy_ewma"] == 100.0  # first report seeds, no smoothing
+    p.report(0, busy_ticks=0, free_slots=3, tick_s=1e-3, pending=0)
+    snap = p.load_snapshot()["0"]
+    # alpha=0.4 fold toward 0: 100 + 0.4*(0-100) = 60
+    assert snap["busy_ewma"] == pytest.approx(60.0)
+
+
+def test_report_for_retired_worker_is_dropped():
+    p = mk_policy(workers=2)
+    p.retire(1)
+    p.report(1, busy_ticks=50, free_slots=1, tick_s=1e-3, pending=0)
+    assert "1" not in p.load_snapshot()
+
+
+def test_hint_honored_with_capacity_ignored_without():
+    p = mk_policy(workers=2, slots=2)
+    assert p.pick(replica_hint=1) == 1
+    assert p.pick(replica_hint=1) == 1
+    # hinted worker saturated (in_flight == free_slots): hint ignored
+    assert p.pick(replica_hint=1) == 0
+    # dead hint: ignored
+    p.retire(0)
+    p.completed(1)
+    assert p.pick(replica_hint=0) == 1
+
+
+def test_completed_releases_capacity():
+    p = mk_policy(workers=1, slots=1)
+    assert p.pick() == 0
+    p.completed(0)
+    snap = p.load_snapshot()["0"]
+    assert snap["in_flight"] == 0
+
+
+def test_pick_none_when_empty():
+    p = AdmissionPolicy(ticks_per_request=4)
+    assert p.pick() is None
+
+
+# --- dead-socket detect -> replay --------------------------------------
+
+
+class FakeSock:
+    """Records frames; optionally dies on send."""
+
+    def __init__(self):
+        self.frames = []
+        self.dead = False
+
+    def send(self, obj):
+        if self.dead:
+            raise ConnectionError("fake dead socket")
+        self.frames.append(obj)
+
+    def close(self):
+        self.dead = True
+
+
+def _quiet_gateway(tmp_path, **kw):
+    """A Gateway object with NO processes: handles are stitched by hand."""
+    kw.setdefault("num_workers", 2)
+    kw.setdefault("cache_result_bytes", 0)
+    kw.setdefault("cache_prefix_bytes", 0)
+    gw = Gateway({"kind": "quick"}, run_dir=str(tmp_path), **kw)
+    return gw
+
+
+def _wire_handle(gw, rid, tmp_path, slots=3):
+    h = WorkerHandle(rid, SimpleNamespace(poll=lambda: None, pid=1000 + rid),
+                     str(tmp_path / f"worker{rid}"))
+    os.makedirs(h.run_dir, exist_ok=True)
+    h.sock = FakeSock()
+    h.slots = slots
+    gw._handles[rid] = h
+    gw.policy.register(rid, slots)
+    return h
+
+
+def _req(i):
+    return {"text_tokens": [1 + i, 2, 3], "seed": i,
+            "request_id": f"q{i}", "temperature": 0.5}
+
+
+def test_dead_socket_replays_in_submission_order(tmp_path):
+    gw = _quiet_gateway(tmp_path)
+    h0 = _wire_handle(gw, 0, tmp_path)
+    reqs = [gw.submit(_req(i)) for i in range(5)]  # only w0 exists
+    assert [f["req"]["request_id"] for f in h0.sock.frames] == [
+        f"q{i}" for i in range(5)
+    ]
+    h1 = _wire_handle(gw, 1, tmp_path)
+    # one result acknowledged BEFORE the death: q2 must NOT be replayed
+    gw._on_result(h0, {"request_id": "q2", "codes": [7, 7]})
+    gw._on_worker_dead(h0, why="test kill")
+    replayed = [f["req"]["request_id"] for f in h1.sock.frames]
+    assert replayed == ["q0", "q1", "q3", "q4"]  # submission order
+    for r in reqs:
+        if r.request_id == "q2":
+            assert r.retries == 0 and r.codes is not None
+        else:
+            assert r.retries == 1
+    assert gw.statusz()["counters"]["replayed"] == 4
+    assert gw.statusz()["counters"]["worker_deaths"] == 1
+
+
+def test_dead_socket_is_idempotent(tmp_path):
+    gw = _quiet_gateway(tmp_path)
+    h0 = _wire_handle(gw, 0, tmp_path)
+    _wire_handle(gw, 1, tmp_path)
+    gw.submit(_req(0))
+    gw._on_worker_dead(h0, why="reader EOF")
+    gw._on_worker_dead(h0, why="supervisor reap")  # the race: both fire
+    assert gw.statusz()["counters"]["worker_deaths"] == 1
+    assert gw.statusz()["counters"]["replayed"] == 1
+
+
+def test_replay_budget_exhaustion_fails_terminally(tmp_path):
+    gw = _quiet_gateway(tmp_path, replay_budget=1)
+    h0 = _wire_handle(gw, 0, tmp_path)
+    req = gw.submit(_req(0))
+    h1 = _wire_handle(gw, 1, tmp_path)
+    gw._on_worker_dead(h0, why="kill 1")
+    assert req.retries == 1 and not req._done.is_set()
+    gw._on_worker_dead(h1, why="kill 2")
+    # budget 1: the second death exhausts it — terminal error, no hang
+    assert req._done.is_set()
+    assert "replay budget" in req.error
+
+
+def test_all_workers_dead_fails_not_hangs(tmp_path):
+    gw = _quiet_gateway(tmp_path)
+    h0 = _wire_handle(gw, 0, tmp_path)
+    req = gw.submit(_req(0))
+    gw._on_worker_dead(h0, why="kill")
+    assert req._done.is_set()
+    assert "no workers alive" in req.error
+
+
+def test_send_failure_redispatches_to_survivor(tmp_path):
+    gw = _quiet_gateway(tmp_path)
+    h0 = _wire_handle(gw, 0, tmp_path)
+    h1 = _wire_handle(gw, 1, tmp_path)
+    h0.sock.dead = True  # dies between pick and send
+    req = gw.submit(_req(0))
+    assert [f["req"]["request_id"] for f in h1.sock.frames] == ["q0"]
+    assert h0.dead and not req._done.is_set()
+
+
+def test_flight_dump_collected_on_death(tmp_path):
+    gw = _quiet_gateway(tmp_path)
+    h0 = _wire_handle(gw, 0, tmp_path)
+    _wire_handle(gw, 1, tmp_path)
+    dump = os.path.join(h0.run_dir, "flight_123_1.json")
+    with open(dump, "w") as f:
+        f.write('{"reason": "worker_ready"}')
+    gw._on_worker_dead(h0, why="kill")
+    assert gw.statusz()["flight_dumps"]["0"] == dump
+    assert gw.flight_dumps[0]["doc"] == {"reason": "worker_ready"}
+
+
+def test_gateway_shed_at_capacity(tmp_path):
+    gw = _quiet_gateway(tmp_path, max_in_flight=2)
+    _wire_handle(gw, 0, tmp_path)
+    r1, r2, r3 = (gw.submit(_req(i)) for i in range(3))
+    assert not r1._done.is_set() and not r2._done.is_set()
+    assert r3._done.is_set() and "shed" in r3.error
+    assert gw.statusz()["counters"]["shed"] == 1
+
+
+class _Vocab:
+    def tokenize(self, text, seq_len, truncate_text=True):
+        toks = [(hash(w) % 100) + 1 for w in text.split()][:seq_len]
+        arr = np.zeros((1, seq_len), dtype=np.int32)
+        arr[0, : len(toks)] = toks
+        return arr
+
+
+def test_text_submit_default_ids_are_unique(tmp_path):
+    """id-less text dicts must get gateway-lifetime-unique request_ids —
+    the in-flight ledger keys on request_id, so a per-call constant
+    would silently collide two concurrent requests."""
+    gw = _quiet_gateway(tmp_path, tokenizer=_Vocab(), text_seq_len=8)
+    h = _wire_handle(gw, 0, tmp_path)
+    ra = gw.submit({"text": "a cat"})
+    rb = gw.submit({"text": "a dog"})
+    rc = gw.submit({"text": "a fox", "id": "mine"})
+    assert ra.request_id == "req0" and rb.request_id == "req1"
+    assert rc.request_id == "mine"
+    assert set(h.in_flight) == {"req0", "req1", "mine"}
+    # distinct default seeds too (parse seeds default_seed + i)
+    assert ra.seed != rb.seed
+
+
+# --- federated metrics oracle ------------------------------------------
+
+
+def test_parse_prometheus_accepts_general_labels():
+    text = ('serve_completed{replica="0"} 5\n'
+            'ttlt_bucket{replica="1",le="0.5"} 3\n'
+            "plain_metric 1\n")
+    out = parse_prometheus(text)
+    assert out['serve_completed{replica="0"}'] == 5.0
+    assert out['ttlt_bucket{replica="1",le="0.5"}'] == 3.0
+    assert out["plain_metric"] == 1.0
+
+
+def test_parse_prometheus_rejects_torn_output():
+    with pytest.raises(ValueError):
+        parse_prometheus("serve_completed 5\nserve_comp")
+    with pytest.raises(ValueError):
+        parse_prometheus('x{replica="0} 1')
+
+
+def test_label_series_prepends_before_le():
+    assert label_series("decode_ticks", "replica", 0) == (
+        'decode_ticks{replica="0"}'
+    )
+    assert label_series('ttlt_bucket{le="1.0"}', "replica", 2) == (
+        'ttlt_bucket{replica="2",le="1.0"}'
+    )
+
+
+def test_federate_never_sums_counters():
+    scrapes = {
+        "0": {"serve_completed": 5.0},
+        "1": {"serve_completed": 7.0},
+    }
+    page = federate_prometheus(scrapes)
+    parsed = parse_prometheus(page)
+    # per-replica series, NOT a sum (a dead worker's disappearing
+    # contribution would read as a counter reset)
+    assert parsed['serve_completed{replica="0"}'] == 5.0
+    assert parsed['serve_completed{replica="1"}'] == 7.0
+    assert "serve_completed 12" not in page
+
+
+def test_federated_page_roundtrips_through_the_oracle():
+    scrapes = {"0": {"a": 1.0, 'h_bucket{le="+Inf"}': 4.0}}
+    assert parse_prometheus(federate_prometheus(scrapes)) == {
+        'a{replica="0"}': 1.0,
+        'h_bucket{replica="0",le="+Inf"}': 4.0,
+    }

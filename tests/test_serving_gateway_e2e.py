@@ -1,0 +1,308 @@
+"""Process-level gateway chaos: the six serving_chaos scenarios re-run
+against REAL worker processes and kill -9 (docs/SERVING.md §12).
+
+The in-process chaos suite (tools/serving_chaos.py) injects faults into
+one engine; these tests aim the same scenarios at the multi-process
+fleet, where the failure unit is a whole worker process and the drain
+mechanism is the gateway's in-flight ledger + bitwise replay:
+
+* warm fleet burst, bitwise vs an in-process reference engine
+* federated /metrics through the strict ``parse_prometheus`` oracle
+* flood against ``max_in_flight`` — shed, bounded, admitted complete
+* kill -9 a worker WITH work in flight — drain replays bitwise
+* warm cross-process caches keep serving hits/reuses after the kill
+* federated counters stay per-series monotonic across the kill
+* kill the whole fleet — every ``result()`` terminates, none hang
+* kill the cache host — degrade to miss, never to error or hang
+
+Tests on the module fleet are ORDERED (test_01..test_07): each phase
+builds on fleet state the previous one created (warm caches, the first
+metrics snapshot, the first kill).  They run in file order under the
+repo pytest config (no test randomization).
+
+Slow tier: one 3-worker fleet + one 2-worker fleet + an in-process
+reference engine — minutes of model builds, excluded from tier-1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dalle_tpu.serving import protocol
+from dalle_tpu.serving.gateway import Gateway
+from dalle_tpu.serving.gateway.worker import build_model
+from dalle_tpu.telemetry.exposition import parse_prometheus
+
+pytestmark = pytest.mark.slow
+
+QUICK_SPEC = {
+    "kind": "quick",
+    "seed": 0,
+    "config": dict(
+        num_text_tokens=64, text_seq_len=16, num_image_tokens=128,
+        image_fmap_size=8, dim=32, depth=2, heads=2, dim_head=16,
+        attn_types=["full"],
+    ),
+}
+
+# cross-test fleet state: wave-1 wire items + codes, metrics snapshot
+STATE = {}
+
+
+def _mk_wire(n, *, tag, seed0, text_seed, num_texts=None):
+    cfg = QUICK_SPEC["config"]
+    rng = np.random.RandomState(text_seed)
+    num_texts = num_texts or n
+    texts = rng.randint(
+        1, cfg["num_text_tokens"], size=(num_texts, cfg["text_seq_len"])
+    )
+    return [
+        {
+            "text_tokens": [int(x) for x in texts[i % num_texts]],
+            "seed": seed0 + i,
+            "temperature": 1e-8,  # greedy: replay must be bitwise
+            "request_id": f"{tag}{i}",
+        }
+        for i in range(n)
+    ]
+
+
+def _drain(reqs, timeout_s=180.0):
+    """Wait for every request; return ids that HUNG (the one forbidden
+    outcome — errors are a legal terminal state, hangs never are)."""
+    deadline = time.monotonic() + timeout_s
+    hangs = []
+    for r in reqs:
+        r.result(timeout=max(0.0, deadline - time.monotonic()))
+        if not r._done.is_set():
+            hangs.append(r.request_id)
+    return hangs
+
+
+def _kill_when_busy(gw, rid, fired, timeout_s=60.0):
+    """kill -9 ``rid`` the moment it holds dispatched work — the quick
+    model drains a burst in well under a second, so a fixed-sleep kill
+    lands after the work is gone and tests nothing."""
+    h = gw._handles[rid]
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if h.dead:
+            return
+        if len(h.in_flight) > 0:
+            gw.kill_worker(rid)
+            fired.set()
+            return
+        time.sleep(0.0005)
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    gw = Gateway(
+        QUICK_SPEC, num_workers=3, slots=3, filter_thres=0.0,
+        run_dir=str(tmp_path_factory.mktemp("gateway_e2e")),
+        load_report_interval_s=0.05,
+    )
+    gw.start()
+    yield gw
+    gw.close(drain=False)
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """An in-process single-engine run of the SAME quick model: the
+    bitwise oracle every fleet result is compared against."""
+    from dalle_tpu.serving import DecodeEngine, RequestQueue, Scheduler
+
+    model, params = build_model(QUICK_SPEC)
+
+    def run(wire_items):
+        engine = DecodeEngine(
+            model, params, num_slots=3, filter_thres=0.0
+        )
+        engine.warmup()
+        q = RequestQueue()
+        reqs = [protocol.request_from_wire(dict(d)) for d in wire_items]
+        for r in reqs:
+            q.submit(r)
+        q.close()
+        Scheduler(engine, q, policy="continuous").run()
+        return {r.request_id: np.asarray(r.codes) for r in reqs}
+
+    return run
+
+
+def _assert_bitwise(reqs, ref):
+    for r in reqs:
+        assert r.error is None, f"{r.request_id}: {r.error}"
+        np.testing.assert_array_equal(
+            np.asarray(r.codes), ref[r.request_id],
+            err_msg=f"{r.request_id} diverged from the reference engine",
+        )
+
+
+def test_01_warm_fleet_burst_bitwise(fleet, reference):
+    wave1 = _mk_wire(12, tag="w", seed0=100, text_seed=7, num_texts=6)
+    reqs = [fleet.submit(dict(d)) for d in wave1]
+    assert _drain(reqs) == []
+    ref = reference(wave1)
+    _assert_bitwise(reqs, ref)
+    # the burst was dealt, not funneled to one worker
+    assert len({r.replica for r in reqs}) >= 2
+    STATE["wave1"] = wave1
+    STATE["wave1_codes"] = {r.request_id: np.asarray(r.codes)
+                            for r in reqs}
+
+
+def test_02_federated_metrics_strict_parse(fleet):
+    if "wave1" not in STATE:
+        pytest.skip("fleet warm-up failed earlier")
+    parsed = parse_prometheus(fleet.scrape_metrics())  # oracle: raises
+    # every worker contributes relabeled series; the gateway its own
+    for rid in fleet.workers_alive():
+        assert any(f'replica="{rid}"' in k for k in parsed), rid
+    assert parsed["gateway_submitted"] >= 12.0
+    STATE["scrape1"] = parsed
+
+
+def test_03_flood_sheds_and_admitted_complete(fleet):
+    if "wave1" not in STATE:
+        pytest.skip("fleet warm-up failed earlier")
+    fleet.max_in_flight = 2
+    try:
+        flood = _mk_wire(10, tag="f", seed0=500, text_seed=13)
+        reqs = [fleet.submit(dict(d)) for d in flood]
+        assert _drain(reqs) == []
+    finally:
+        fleet.max_in_flight = None
+    shed = [r for r in reqs if r.error and "shed" in r.error]
+    served = [r for r in reqs if r.error is None]
+    assert shed, "a 10-burst against max_in_flight=2 must shed"
+    assert served, "admitted requests must still complete"
+    assert all(r.codes is not None for r in served)
+    assert fleet.statusz()["counters"]["shed"] >= len(shed)
+
+
+def test_04_kill9_mid_burst_drains_bitwise(fleet, reference):
+    if "wave1" not in STATE:
+        pytest.skip("fleet warm-up failed earlier")
+    victim = fleet.workers_alive()[0]
+    fired = threading.Event()
+    killer = threading.Thread(
+        target=_kill_when_busy, args=(fleet, victim, fired), daemon=True
+    )
+    killer.start()
+    wave = _mk_wire(12, tag="k", seed0=300, text_seed=11, num_texts=6)
+    reqs = [fleet.submit(dict(d)) for d in wave]
+    assert _drain(reqs) == [], "kill -9 must never hang a result()"
+    killer.join(timeout=60)
+    assert fired.is_set(), "kill never fired while work was in flight"
+    _assert_bitwise(reqs, reference(wave))
+    counters = fleet.statusz()["counters"]
+    assert counters["worker_deaths"] == 1
+    assert counters["replayed"] >= 1
+    assert sum(r.retries for r in reqs) >= 1
+    # the dead worker's flight-recorder dump was collected post-mortem
+    assert str(victim) in fleet.statusz()["flight_dumps"]
+    assert victim not in fleet.workers_alive()
+
+
+def test_05_warm_caches_survive_the_kill(fleet, reference):
+    if "wave1_codes" not in STATE:
+        pytest.skip("fleet warm-up failed earlier")
+    # exact wave-1 repeats: the cache host (its own process) still holds
+    # the results the dead worker helped produce
+    reqs = [fleet.submit(dict(d)) for d in STATE["wave1"]]
+    assert _drain(reqs) == []
+    assert sum(1 for r in reqs if r.cache_hit) > 0, (
+        "warm replay must hit the cross-process result cache"
+    )
+    for r in reqs:
+        assert r.error is None
+        np.testing.assert_array_equal(
+            np.asarray(r.codes), STATE["wave1_codes"][r.request_id]
+        )
+    # same texts, NEW seeds: decode on survivors reusing hosted prefixes
+    wave_p = _mk_wire(6, tag="p", seed0=900, text_seed=7, num_texts=6)
+    reqs_p = [fleet.submit(dict(d)) for d in wave_p]
+    assert _drain(reqs_p) == []
+    _assert_bitwise(reqs_p, reference(wave_p))
+    from dalle_tpu.serving.gateway.cachehost import RemotePrefixPool
+
+    stats = RemotePrefixPool(tuple(fleet._cache_addr)).stats()
+    assert stats.get("hits", 0) > 0, (
+        f"new seeds over warm texts must reuse hosted prefixes: {stats}"
+    )
+
+
+def test_06_federated_counters_monotonic_across_kill(fleet):
+    if "scrape1" not in STATE:
+        pytest.skip("no pre-kill scrape to compare against")
+    from tools.serving_chaos import _is_monotonic_series
+
+    parsed = parse_prometheus(fleet.scrape_metrics())
+    for key, before in STATE["scrape1"].items():
+        if not _is_monotonic_series(key):
+            continue
+        # the dead worker's series are served frozen, not dropped: a
+        # disappearing contribution would read as a counter reset
+        assert key in parsed, f"series {key} vanished after the kill"
+        assert parsed[key] >= before, (
+            f"{key} went backwards: {before} -> {parsed[key]}"
+        )
+
+
+def test_07_fleet_wide_kill_fails_fast_never_hangs(fleet):
+    if "wave1" not in STATE:
+        pytest.skip("fleet warm-up failed earlier")
+    alive = fleet.workers_alive()
+    assert alive, "previous tests left no workers to kill"
+    wave = _mk_wire(9, tag="z", seed0=700, text_seed=17)
+    reqs = [fleet.submit(dict(d)) for d in wave]
+    # kill EVERY worker the moment any of them holds work
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if any(len(fleet._handles[r].in_flight) > 0 for r in alive):
+            break
+        time.sleep(0.0005)
+    for rid in alive:
+        fleet.kill_worker(rid)
+    assert _drain(reqs) == [], (
+        "a fleet-wide kill must fail results, never hang them"
+    )
+    failed = [r for r in reqs if r.error is not None]
+    assert failed, "killing every worker mid-burst must fail something"
+    for r in failed:
+        assert ("no workers alive" in r.error
+                or "replay budget" in r.error), r.error
+    assert fleet.healthz()["ok"] is False
+    # survivors of the race (completed before their worker died) are
+    # fine; what's forbidden is a hang or a silent drop
+    assert all(r._done.is_set() for r in reqs)
+
+
+def test_cache_host_crash_degrades_to_miss(tmp_path, reference):
+    gw = Gateway(
+        QUICK_SPEC, num_workers=2, slots=3, filter_thres=0.0,
+        run_dir=str(tmp_path), load_report_interval_s=0.05,
+    )
+    with gw:
+        warm = _mk_wire(4, tag="a", seed0=100, text_seed=29)
+        reqs = [gw.submit(dict(d)) for d in warm]
+        assert _drain(reqs) == []
+        assert gw._cache_proc is not None
+        gw._cache_proc.kill()
+        gw._cache_proc.wait(timeout=30)
+        # repeats + fresh work against a dead cache host: every op
+        # degrades to a miss, nothing errors, nothing hangs
+        again = [gw.submit(dict(d)) for d in warm]
+        fresh_wire = _mk_wire(4, tag="b", seed0=400, text_seed=31)
+        fresh = [gw.submit(dict(d)) for d in fresh_wire]
+        assert _drain(again + fresh) == []
+        ref = reference(warm)
+        ref.update(reference(fresh_wire))
+        _assert_bitwise(again + fresh, ref)
+        assert gw.workers_alive(), (
+            "a cache-host crash must not take workers down"
+        )

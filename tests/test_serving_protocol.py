@@ -1,0 +1,257 @@
+"""Serving protocol: hoisted parse/validate + the gateway wire codec.
+
+Pins the PR-15 contracts (docs/SERVING.md §12):
+
+* the request/result wire field lists are FROZEN — a field added or
+  renamed without updating these tuples breaks mixed-version fleets
+  mid-rollout, so the test fails before the wire does;
+* ``request_to_wire``/``request_from_wire`` roundtrip field-for-field,
+  including a numpy ``text_tokens`` payload (the gateway submits
+  pre-tokenized int32 arrays, not text);
+* ``apply_result_wire`` stamps every completion field, releases
+  ``result()`` waiters, and never touches the local arrival clock;
+* ``parse_serve_request``/``validate_serve_flags`` stay importable from
+  ``generate`` (operator scripts) AND ``dalle_tpu.serving.protocol``
+  (the gateway) as the SAME objects;
+* gateway flags validate: ``--gateway_workers`` excludes ``--replicas``,
+  ``--mesh_tp/sp`` and non-continuous policies.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dalle_tpu.serving import protocol
+from dalle_tpu.serving.protocol import (
+    REQUEST_WIRE_FIELDS,
+    RESULT_WIRE_FIELDS,
+    apply_result_wire,
+    request_from_wire,
+    request_to_wire,
+    result_to_wire,
+)
+from dalle_tpu.serving.queue import Request
+
+
+# --- frozen field lists ------------------------------------------------
+
+
+def test_wire_field_lists_pinned():
+    # renaming/adding a wire field is a cross-version protocol change:
+    # update BOTH the codec and this pin, in the same PR
+    assert REQUEST_WIRE_FIELDS == (
+        "text_tokens", "seed", "temperature", "top_p", "request_id",
+        "deadline_s", "variations", "replica_hint",
+    )
+    assert RESULT_WIRE_FIELDS == (
+        "request_id", "codes", "admit_time", "finish_time", "detok_time",
+        "clip_score", "dropped", "error", "retries", "service_tier",
+        "slot", "replica", "cache_hit", "cache_key",
+    )
+
+
+def test_wire_dicts_carry_exactly_the_pinned_fields():
+    req = Request(text_tokens=np.arange(4, dtype=np.int32), seed=1,
+                  temperature=0.5, request_id="x")
+    assert tuple(request_to_wire(req)) == REQUEST_WIRE_FIELDS
+    assert tuple(result_to_wire(req)) == RESULT_WIRE_FIELDS
+
+
+# --- request roundtrip -------------------------------------------------
+
+
+def test_request_roundtrip_field_for_field_numpy_payload():
+    req = Request(
+        text_tokens=np.array([3, 1, 4, 1, 5, 9], dtype=np.int32),
+        seed=42, temperature=0.7, top_p=0.95, request_id="job-17",
+        deadline_s=30.0, variations=4, replica_hint=2,
+    )
+    wire = request_to_wire(req)
+    # JSON-safe: a numpy payload must not leak numpy scalars
+    import json
+
+    json.dumps(wire)
+    back = request_from_wire(json.loads(json.dumps(wire)))
+    for f in REQUEST_WIRE_FIELDS:
+        a, b = getattr(req, f), getattr(back, f)
+        if f == "text_tokens":
+            assert b.dtype == np.int32
+            np.testing.assert_array_equal(a, b)
+        else:
+            assert a == b, f"field {f}: {a!r} != {b!r}"
+
+
+def test_request_roundtrip_defaults():
+    wire = {"text_tokens": [1, 2, 3], "request_id": "d"}
+    back = request_from_wire(wire)
+    assert back.seed == 0 and back.temperature == 1.0
+    assert back.top_p is None and back.deadline_s is None
+    assert back.variations == 1 and back.replica_hint is None
+    again = request_from_wire(request_to_wire(back))
+    for f in REQUEST_WIRE_FIELDS:
+        a, b = getattr(back, f), getattr(again, f)
+        if f == "text_tokens":
+            np.testing.assert_array_equal(a, b)
+        else:
+            assert a == b
+
+
+@pytest.mark.parametrize("patch,msg", [
+    ({"text_tokens": []}, "text_tokens"),
+    ({"text_tokens": ["a"]}, "text_tokens"),
+    ({"text_tokens": None}, "text_tokens"),
+    ({"temperature": 0.0}, "temperature"),
+    ({"temperature": -1.0}, "temperature"),
+    ({"top_p": 0.0}, "top_p"),
+    ({"top_p": 1.5}, "top_p"),
+    ({"deadline_s": -2.0}, "deadline_s"),
+    ({"variations": 0}, "variations"),
+    ({"variations": 65}, "variations"),
+    ({"replica_hint": -1}, "replica_hint"),
+])
+def test_request_from_wire_validates(patch, msg):
+    base = {"text_tokens": [1, 2], "request_id": "v"}
+    with pytest.raises(ValueError, match=msg):
+        request_from_wire({**base, **patch})
+
+
+# --- result roundtrip --------------------------------------------------
+
+
+def test_result_roundtrip_and_waiter_release():
+    src = Request(text_tokens=np.arange(3, dtype=np.int32),
+                  request_id="r1")
+    src.codes = np.arange(16, dtype=np.int32).reshape(4, 4)
+    src.admit_time = 1.5
+    src.finish_time = 2.5
+    src.detok_time = 0.25
+    src.clip_score = 0.5
+    src.retries = 1
+    src.service_tier = 1
+    src.slot = 3
+    src.replica = 2
+    src.cache_hit = True
+    src.cache_key = "abc123"
+
+    dst = Request(text_tokens=np.arange(3, dtype=np.int32),
+                  request_id="r1")
+    dst.arrival_time = 123.0
+    waited = {}
+
+    def waiter():
+        waited["codes"] = dst.result(timeout=10).codes
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    time.sleep(0.02)
+    import json
+
+    wire = json.loads(json.dumps(result_to_wire(src)))
+    apply_result_wire(dst, wire)
+    th.join(timeout=10)
+    assert not th.is_alive(), "apply_result_wire must release result()"
+    np.testing.assert_array_equal(waited["codes"], src.codes)
+    assert dst.codes.dtype == np.int32
+    # the local arrival clock is never overwritten by the wire
+    assert dst.arrival_time == 123.0
+    for f in RESULT_WIRE_FIELDS:
+        if f == "codes":
+            np.testing.assert_array_equal(dst.codes, src.codes)
+        else:
+            assert getattr(dst, f) == getattr(src, f), f
+
+
+def test_apply_result_wire_finish_time_override():
+    dst = Request(text_tokens=np.arange(3, dtype=np.int32),
+                  request_id="r2")
+    apply_result_wire(dst, {"request_id": "r2", "codes": [1, 2]},
+                      finish_time=99.0)
+    assert dst.finish_time == 99.0
+    assert dst._done.is_set()
+
+
+def test_apply_result_wire_error_path():
+    dst = Request(text_tokens=np.arange(3, dtype=np.int32),
+                  request_id="r3")
+    apply_result_wire(dst, {"request_id": "r3", "codes": None,
+                            "error": "boom"})
+    assert dst.error == "boom" and dst.codes is None
+    assert dst.result(timeout=1) is dst  # terminates, no hang
+
+
+def test_request_method_shims():
+    # Request.to_wire()/from_wire() delegate to the protocol codec
+    req = Request(text_tokens=np.arange(4, dtype=np.int32), seed=7,
+                  request_id="m")
+    assert req.to_wire() == request_to_wire(req)
+    back = Request.from_wire(req.to_wire())
+    np.testing.assert_array_equal(back.text_tokens, req.text_tokens)
+    assert back.seed == 7
+
+
+# --- hoisted parse/validate -------------------------------------------
+
+
+def test_generate_shims_are_the_protocol_objects():
+    import generate
+
+    assert generate.parse_serve_request is protocol.parse_serve_request
+    assert generate.validate_serve_flags is protocol.validate_serve_flags
+
+
+class _Vocab:
+    def tokenize(self, text, seq_len, truncate_text=True):
+        toks = [(hash(w) % 100) + 1 for w in text.split()][:seq_len]
+        arr = np.zeros((1, seq_len), dtype=np.int32)
+        arr[0, : len(toks)] = toks
+        return arr
+
+
+def test_parse_serve_request_from_protocol():
+    req = protocol.parse_serve_request(
+        {"text": "a cat", "seed": 3, "id": "c1"}, 0,
+        tokenizer=_Vocab(), text_seq_len=8,
+    )
+    assert req.request_id == "c1" and req.seed == 3
+    assert req.text_tokens.shape == (8,)
+
+
+def _flag_ns(**kw):
+    base = dict(
+        serve="-", serve_slots=4, replicas=1, serve_policy="continuous",
+        mesh_tp=1, mesh_sp=1, mesh_dp=1, mesh_fsdp=1, mesh_pp=1,
+        mesh_ep=1, top_p=None, top_k=0.9, cache_bytes=0,
+        prefix_pool_bytes=0, max_queue=None, shed_policy="reject",
+        degrade="off", slo_objective=None, decode_comm="f32",
+        gateway_workers=0, gateway_port=0,
+    )
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def test_validate_gateway_flags_ok():
+    assert protocol.validate_serve_flags(_flag_ns(gateway_workers=2)) == []
+
+
+def test_validate_gateway_excludes_replicas():
+    errs = protocol.validate_serve_flags(
+        _flag_ns(gateway_workers=2, replicas=2)
+    )
+    assert any("--replicas" in e for e in errs)
+
+
+def test_validate_gateway_excludes_mesh():
+    errs = protocol.validate_serve_flags(
+        _flag_ns(gateway_workers=2, mesh_tp=2)
+    )
+    assert any("--mesh_tp" in e for e in errs)
+
+
+def test_validate_gateway_needs_continuous_policy():
+    errs = protocol.validate_serve_flags(
+        _flag_ns(gateway_workers=2, serve_policy="fcfs")
+    )
+    assert any("continuous" in e for e in errs)

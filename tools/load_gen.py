@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Load generator for the serving gateway (docs/SERVING.md §12).
+
+Drives a :class:`~dalle_tpu.serving.gateway.Gateway` — in-process or over
+its HTTP front door — with the same Zipf-popularity traffic the
+single-process bench uses (``make_zipf_trace``), in two shapes:
+
+* **closed loop** (default): ``--concurrency`` clients, each submitting
+  its next request only after the previous one completed.  Offered load
+  adapts to service rate, so the fleet is measured at saturation without
+  unbounded queue growth — the right shape for p99-vs-workers scaling
+  and for the ``serving_gateway`` bench rung.
+* **open loop**: requests fire at the trace's recorded arrival offsets
+  regardless of completions — the right shape for overload/shedding
+  studies, where closed-loop self-throttling would hide the backlog.
+
+Usage (against a gateway you already started)::
+
+    python tools/load_gen.py --url http://127.0.0.1:8900 --n 200 \
+        --concurrency 8 --alpha 1.1
+
+or self-contained (spawns a quick-model CPU fleet, drives it, tears it
+down)::
+
+    python tools/load_gen.py --spawn_workers 4 --n 200 --concurrency 8
+
+Output: one JSON summary on stdout (count, error count, p50/p95/p99
+latency, wall time, throughput), suitable for piping into jq or the
+bench harness.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from typing import List, Optional
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def trace_to_wire(item) -> dict:
+    """One TraceItem as a gateway submit dict (protocol wire fields)."""
+    d = {
+        "text_tokens": [int(x) for x in np.asarray(item.text_tokens)],
+        "seed": int(item.seed),
+        "temperature": float(item.temperature),
+        "request_id": item.request_id,
+    }
+    if item.top_p is not None:
+        d["top_p"] = float(item.top_p)
+    if item.deadline_s is not None:
+        d["deadline_s"] = float(item.deadline_s)
+    if item.variations != 1:
+        d["variations"] = int(item.variations)
+    if item.replica_hint is not None:
+        d["replica_hint"] = int(item.replica_hint)
+    return d
+
+
+class HTTPTarget:
+    """Submits requests through ``POST /v1/generate`` (one per call)."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+
+    def submit_and_wait(self, d: dict, timeout_s: float) -> dict:
+        body = (json.dumps(d, separators=(",", ":")) + "\n").encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/v1/generate", data=body,
+            headers={"Content-Type": "application/jsonl"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                for line in r:
+                    # one request per POST: the first JSONL line is ours
+                    return json.loads(line.decode("utf-8"))
+        except OSError as e:
+            return {"request_id": d["request_id"], "ok": False,
+                    "error": f"http: {e}"}
+        return {"request_id": d["request_id"], "ok": False,
+                "error": "empty response"}
+
+
+class InProcessTarget:
+    """Submits directly on a Gateway object (bench harness path)."""
+
+    def __init__(self, gateway):
+        self.gateway = gateway
+
+    def submit_and_wait(self, d: dict, timeout_s: float) -> dict:
+        try:
+            r = self.gateway.submit(dict(d))
+        except (ValueError, TypeError) as e:
+            return {"request_id": d.get("request_id"), "ok": False,
+                    "error": str(e)}
+        r.result(timeout=timeout_s)
+        if not r._done.is_set():
+            return {"request_id": r.request_id, "ok": False,
+                    "error": f"timeout after {timeout_s}s", "hang": True}
+        return {"request_id": r.request_id, "ok": r.error is None,
+                "error": r.error, "ttlt_s": r.ttlt,
+                "cache_hit": bool(getattr(r, "cache_hit", False)),
+                "replica": r.replica, "retries": r.retries,
+                "codes": None if r.codes is None
+                else np.asarray(r.codes)}
+
+
+def run_closed_loop(target, wire_items: List[dict], *, concurrency: int,
+                    timeout_s: float = 120.0) -> List[dict]:
+    """``concurrency`` clients draining a shared work list, one request
+    in flight per client.  Returns one record per item (submission
+    order), each with client-observed ``latency_s``."""
+    records: List[Optional[dict]] = [None] * len(wire_items)
+    cursor = [0]
+    lock = threading.Lock()
+
+    def client():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(wire_items):
+                    return
+                cursor[0] += 1
+            t0 = time.monotonic()
+            out = target.submit_and_wait(wire_items[i], timeout_s)
+            out["latency_s"] = time.monotonic() - t0
+            records[i] = out
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [r for r in records if r is not None]
+
+
+def run_open_loop(target, wire_items: List[dict], arrivals_s: List[float],
+                  *, timeout_s: float = 120.0) -> List[dict]:
+    """Fire each request at its trace offset; wait for all completions."""
+    records: List[Optional[dict]] = [None] * len(wire_items)
+    threads = []
+    t0 = time.monotonic()
+
+    def one(i: int):
+        t1 = time.monotonic()
+        out = target.submit_and_wait(wire_items[i], timeout_s)
+        out["latency_s"] = time.monotonic() - t1
+        records[i] = out
+
+    for i, (d, a) in enumerate(zip(wire_items, arrivals_s)):
+        lag = t0 + a - time.monotonic()
+        if lag > 0:
+            time.sleep(lag)
+        t = threading.Thread(target=one, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    return [r for r in records if r is not None]
+
+
+def summarize(records: List[dict], wall_s: float) -> dict:
+    lats = sorted(r["latency_s"] for r in records)
+    errs = [r for r in records if not r.get("ok", False)]
+    hangs = [r for r in records if r.get("hang")]
+
+    def pct(p):
+        return float(np.percentile(lats, p)) if lats else None
+
+    return {
+        "count": len(records),
+        "errors": len(errs),
+        "hangs": len(hangs),
+        "cache_hits": sum(1 for r in records if r.get("cache_hit")),
+        "replays": sum(int(r.get("retries") or 0) for r in records),
+        "p50_s": pct(50), "p95_s": pct(95), "p99_s": pct(99),
+        "wall_s": wall_s,
+        "throughput_rps": len(records) / wall_s if wall_s > 0 else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Zipf load generator for the serving gateway"
+    )
+    tgt = ap.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--url", type=str, default=None,
+                     help="base URL of a running gateway front door")
+    tgt.add_argument("--spawn_workers", type=int, default=None,
+                     help="spawn a quick-model CPU fleet of N workers")
+    ap.add_argument("--n", type=int, default=100,
+                    help="number of requests")
+    ap.add_argument("--rate_hz", type=float, default=50.0,
+                    help="open-loop arrival rate (trace offsets)")
+    ap.add_argument("--alpha", type=float, default=1.1,
+                    help="Zipf popularity exponent (> 1)")
+    ap.add_argument("--prompts", type=int, default=32,
+                    help="distinct prompt count behind the Zipf law")
+    ap.add_argument("--seeds_per_prompt", type=int, default=4)
+    ap.add_argument("--text_seq_len", type=int, default=16)
+    ap.add_argument("--num_text_tokens", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="trace seed (same seed -> same traffic)")
+    ap.add_argument("--mode", choices=("closed", "open"),
+                    default="closed")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop client count")
+    ap.add_argument("--timeout_s", type=float, default=120.0)
+    ap.add_argument("--slots", type=int, default=3,
+                    help="decode slots per spawned worker")
+    args = ap.parse_args(argv)
+
+    from dalle_tpu.serving.scheduler import make_zipf_trace
+
+    trace = make_zipf_trace(
+        args.n, args.rate_hz, args.text_seq_len, args.num_text_tokens,
+        alpha=args.alpha, num_prompts=args.prompts,
+        seeds_per_prompt=args.seeds_per_prompt, seed=args.seed,
+    )
+    wire_items = [trace_to_wire(it) for it in trace]
+    # greedy decode: keeps the traffic replayable bit-for-bit
+    for d in wire_items:
+        d["temperature"] = 1e-8
+
+    gateway = None
+    try:
+        if args.url is not None:
+            target = HTTPTarget(args.url)
+        else:
+            from dalle_tpu.serving.gateway import Gateway
+
+            quick = {"kind": "quick", "seed": 0, "config": dict(
+                num_text_tokens=args.num_text_tokens,
+                text_seq_len=args.text_seq_len,
+                num_image_tokens=128, image_fmap_size=8, dim=32,
+                depth=2, heads=2, dim_head=16, attn_types=["full"],
+            )}
+            gateway = Gateway(
+                quick, num_workers=args.spawn_workers, slots=args.slots,
+            ).start()
+            target = InProcessTarget(gateway)
+
+        t0 = time.monotonic()
+        if args.mode == "closed":
+            records = run_closed_loop(
+                target, wire_items, concurrency=args.concurrency,
+                timeout_s=args.timeout_s,
+            )
+        else:
+            records = run_open_loop(
+                target, wire_items,
+                [it.arrival_s for it in trace], timeout_s=args.timeout_s,
+            )
+        wall = time.monotonic() - t0
+        for r in records:
+            r.pop("codes", None)  # not JSON; summary only on the CLI
+        print(json.dumps(summarize(records, wall), indent=2))
+        return 0
+    finally:
+        if gateway is not None:
+            gateway.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
